@@ -1,0 +1,19 @@
+from dynamo_tpu.tokens.blocks import (
+    BLOCK_HASH_SEED,
+    PartialTokenBlock,
+    TokenBlock,
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_seq_hashes,
+    tokens_to_blocks,
+)
+
+__all__ = [
+    "BLOCK_HASH_SEED",
+    "PartialTokenBlock",
+    "TokenBlock",
+    "TokenBlockSequence",
+    "compute_block_hash",
+    "compute_seq_hashes",
+    "tokens_to_blocks",
+]

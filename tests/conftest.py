@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip shardings compile
+and execute without TPU hardware), mirroring the reference's cluster-free
+test strategy (SURVEY.md §4: mocker engine + real control-plane fixtures).
+Must set env before anything imports jax.
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async test support (no pytest-asyncio in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

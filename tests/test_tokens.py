@@ -1,0 +1,73 @@
+"""Token block hashing semantics (parity: reference tokens.rs test surface)."""
+
+import pytest
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_seq_hashes,
+    tokens_to_blocks,
+)
+
+pytestmark = [pytest.mark.unit, pytest.mark.pre_merge]
+
+
+def test_block_hash_deterministic():
+    a = compute_block_hash([1, 2, 3, 4])
+    b = compute_block_hash([1, 2, 3, 4])
+    assert a == b
+    assert a != compute_block_hash([1, 2, 3, 5])
+
+
+def test_chain_differs_by_parent():
+    h = compute_block_hash([1, 2, 3, 4])
+    child_of_root = compute_block_hash([5, 6, 7, 8])
+    child_of_h = compute_block_hash([5, 6, 7, 8], parent_hash=h)
+    assert child_of_root != child_of_h
+
+
+def test_seq_hashes_ignore_partial_tail():
+    full = compute_seq_hashes(list(range(8)), block_size=4)
+    with_tail = compute_seq_hashes(list(range(10)), block_size=4)
+    assert len(full) == 2
+    assert with_tail == full
+
+
+def test_shared_prefix_shares_hashes():
+    a = compute_seq_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], block_size=4)
+    b = compute_seq_hashes([1, 2, 3, 4, 9, 9, 9, 9], block_size=4)
+    assert a[0] == b[0]
+    assert a[1] != b[1]
+
+
+def test_incremental_matches_bulk():
+    tokens = list(range(100, 177))
+    seq = TokenBlockSequence(block_size=16)
+    for t in tokens:
+        seq.append(t)
+    assert seq.block_hashes == compute_seq_hashes(tokens, 16)
+    assert seq.all_tokens() == tokens
+    assert len(seq) == len(tokens)
+    assert len(seq.partial_tokens) == 77 % 16
+
+
+def test_extend_returns_completed_blocks():
+    seq = TokenBlockSequence(block_size=4)
+    done = seq.extend(range(11))
+    assert [b.position for b in done] == [0, 1]
+    assert done[1].parent_hash == done[0].block_hash
+
+
+def test_truncate_replays_chain():
+    tokens = list(range(40))
+    seq = TokenBlockSequence(tokens, block_size=8)
+    seq.truncate(20)
+    assert seq.all_tokens() == tokens[:20]
+    assert seq.block_hashes == compute_seq_hashes(tokens[:20], 8)
+
+
+def test_tokens_to_blocks():
+    blocks, partial = tokens_to_blocks(list(range(10)), 4)
+    assert len(blocks) == 2
+    assert partial == [8, 9]
+    assert blocks[0].tokens == (0, 1, 2, 3)
