@@ -1,0 +1,29 @@
+from dynamo_tpu.runtime.component import (
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    EndpointClient,
+    Instance,
+    Namespace,
+    NoInstancesError,
+)
+from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.worker import dynamo_worker
+
+__all__ = [
+    "Annotated",
+    "AsyncEngine",
+    "Component",
+    "Context",
+    "DistributedRuntime",
+    "Endpoint",
+    "EndpointClient",
+    "Instance",
+    "MetricsRegistry",
+    "Namespace",
+    "NoInstancesError",
+    "RuntimeConfig",
+    "dynamo_worker",
+]

@@ -1,0 +1,301 @@
+"""Component model: Namespace → Component → Endpoint → Instance.
+
+Every deployable process attaches to the distributed runtime, carves out
+endpoints under a namespace/component path, serves them on its ingress
+server, and registers each endpoint instance in the control-plane KV under
+its primary lease — so instances vanish from discovery the moment the
+process dies.
+
+Addressing scheme: ``dynamo://{namespace}/{component}/{endpoint}`` with
+instances at ``/dynamo/instances/{ns}/{component}/{endpoint}/{instance_id}``.
+
+Capability parity: reference `lib/runtime/src/component.rs:98-520`
+(Component/Endpoint/Namespace/Instance, ETCD_ROOT_PATH scheme),
+`distributed.rs:53` (DistributedRuntime), `pipeline/network/egress/
+push_router.rs:30-179` (round_robin/random/direct routing modes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random as _random
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable
+
+import msgpack
+
+from dynamo_tpu.runtime.dataplane import EgressClient, Handler, IngressServer, ResponseStream
+from dynamo_tpu.runtime.store import StoreClient, Subscription
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+INSTANCE_ROOT = "/dynamo/instances"
+DEFAULT_STORE_ADDRESS = os.environ.get("DYN_STORE_ADDRESS", "127.0.0.1:6650")
+
+
+@dataclass(frozen=True)
+class Instance:
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    address: str  # data-plane host:port
+    metadata: dict | None = None
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.endpoint}"
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(
+            {
+                "ns": self.namespace,
+                "comp": self.component,
+                "ep": self.endpoint,
+                "id": self.instance_id,
+                "addr": self.address,
+                "meta": self.metadata,
+            }
+        )
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "Instance":
+        d = msgpack.unpackb(raw, raw=False)
+        return cls(
+            namespace=d["ns"],
+            component=d["comp"],
+            endpoint=d["ep"],
+            instance_id=d["id"],
+            address=d["addr"],
+            metadata=d.get("meta"),
+        )
+
+
+class DistributedRuntime:
+    """A process's handle on the distributed system.
+
+    Bundles the control-plane client, the primary lease (process liveness),
+    the ingress server (data-plane listener), and the egress client pool.
+    """
+
+    def __init__(self, store: StoreClient, lease_id: int, ingress_host: str = "127.0.0.1"):
+        self.store = store
+        self.primary_lease_id = lease_id
+        self.ingress = IngressServer(host=ingress_host)
+        self.egress = EgressClient()
+        self._ingress_started = False
+        self._ingress_lock = asyncio.Lock()
+        self._shutdown = asyncio.Event()
+
+    @classmethod
+    async def create(
+        cls,
+        store_address: str | None = None,
+        lease_ttl: float = 10.0,
+        ingress_host: str = "127.0.0.1",
+    ) -> "DistributedRuntime":
+        store = await StoreClient.open(store_address or DEFAULT_STORE_ADDRESS)
+        lease_id = await store.lease_grant(ttl=lease_ttl)
+        return cls(store, lease_id, ingress_host=ingress_host)
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    async def ensure_ingress(self) -> IngressServer:
+        async with self._ingress_lock:
+            if not self._ingress_started:
+                await self.ingress.start()
+                self._ingress_started = True
+        return self.ingress
+
+    async def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._ingress_started:
+            await self.ingress.stop()
+        self.egress.close()
+        await self.store.close()
+
+    def signal_shutdown(self) -> None:
+        self._shutdown.set()
+
+    async def wait_for_shutdown(self) -> None:
+        await self._shutdown.wait()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self.runtime, self.name, name)
+
+
+class Component:
+    def __init__(self, runtime: DistributedRuntime, namespace: str, name: str):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.runtime, self.namespace, self.name, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Endpoint:
+    def __init__(self, runtime: DistributedRuntime, namespace: str, component: str, name: str):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.name}"
+
+    @property
+    def instance_prefix(self) -> str:
+        return f"{INSTANCE_ROOT}/{self.path}/"
+
+    async def serve(
+        self,
+        handler: Handler,
+        metadata: dict | None = None,
+        instance_id: int | None = None,
+    ) -> Instance:
+        """Serve this endpoint on the process ingress + register the instance.
+
+        Parity: reference `serve_endpoint` (bindings lib.rs:519 →
+        endpoint.rs:65) — graceful-deregistration on shutdown is the caller's
+        job via `deregister`; process death handles it via lease expiry.
+        """
+        ingress = await self.runtime.ensure_ingress()
+        ingress.register(self.path, handler)
+        inst = Instance(
+            namespace=self.namespace,
+            component=self.component,
+            endpoint=self.name,
+            instance_id=instance_id
+            if instance_id is not None
+            else self.runtime.primary_lease_id,
+            address=ingress.address,
+            metadata=metadata,
+        )
+        await self.runtime.store.kv_put(
+            f"{self.instance_prefix}{inst.instance_id:016x}",
+            inst.to_wire(),
+            lease=self.runtime.primary_lease_id,
+        )
+        log.info("serving %s as instance %d at %s", self.path, inst.instance_id, inst.address)
+        return inst
+
+    async def deregister(self, instance_id: int) -> None:
+        await self.runtime.store.kv_del(f"{self.instance_prefix}{instance_id:016x}")
+        self.runtime.ingress.unregister(self.path)
+
+    async def client(self) -> "EndpointClient":
+        client = EndpointClient(self)
+        await client.start()
+        return client
+
+
+class EndpointClient:
+    """Watches an endpoint's instances and routes requests to them.
+
+    Routing modes (parity: reference PushRouter `push_router.rs:138-179`):
+    ``round_robin`` | ``random`` | ``direct(instance_id)``.
+    """
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self.runtime = endpoint.runtime
+        self.instances: dict[int, Instance] = {}
+        self._watch: Subscription | None = None
+        self._watch_task: asyncio.Task | None = None
+        self._rr_counter = 0
+        self._instances_changed = asyncio.Event()
+        self.on_instance_added: list[Callable[[Instance], None]] = []
+        self.on_instance_removed: list[Callable[[int], None]] = []
+
+    async def start(self) -> None:
+        self._watch = await self.runtime.store.kv_watch(self.endpoint.instance_prefix)
+        self._watch_task = asyncio.create_task(self._watch_loop())
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watch:
+            await self._watch.unsubscribe()
+
+    async def _watch_loop(self) -> None:
+        assert self._watch is not None
+        async for ev in self._watch:
+            event = StoreClient.as_watch_event(ev)
+            instance_id = int(event.key.rsplit("/", 1)[-1], 16)
+            if event.type == "put":
+                inst = Instance.from_wire(event.value)
+                self.instances[instance_id] = inst
+                for cb in self.on_instance_added:
+                    cb(inst)
+            else:
+                self.instances.pop(instance_id, None)
+                for cb in self.on_instance_removed:
+                    cb(instance_id)
+            self._instances_changed.set()
+            self._instances_changed = asyncio.Event()
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self.instances)
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[int]:
+        async def _wait() -> list[int]:
+            while len(self.instances) < n:
+                await self._instances_changed.wait()
+            return self.instance_ids()
+
+        return await asyncio.wait_for(_wait(), timeout)
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick_round_robin(self) -> Instance:
+        ids = self.instance_ids()
+        if not ids:
+            raise NoInstancesError(self.endpoint.path)
+        inst = self.instances[ids[self._rr_counter % len(ids)]]
+        self._rr_counter += 1
+        return inst
+
+    def _pick_random(self) -> Instance:
+        ids = self.instance_ids()
+        if not ids:
+            raise NoInstancesError(self.endpoint.path)
+        return self.instances[_random.choice(ids)]
+
+    async def direct(
+        self, instance_id: int, payload: Any, headers: dict[str, str] | None = None
+    ) -> ResponseStream:
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            raise NoInstancesError(f"{self.endpoint.path} instance {instance_id}")
+        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+
+    async def round_robin(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
+        inst = self._pick_round_robin()
+        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+
+    async def random(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
+        inst = self._pick_random()
+        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+
+    async def generate(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
+        return await self.round_robin(payload, headers)
+
+
+class NoInstancesError(RuntimeError):
+    def __init__(self, path: str):
+        super().__init__(f"no live instances for endpoint {path}")

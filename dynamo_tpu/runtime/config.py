@@ -1,0 +1,55 @@
+"""Runtime configuration from environment (+ optional file overlay).
+
+Env prefix scheme mirrors the reference's figment config
+(`lib/runtime/src/config.rs:37,69-181`): ``DYN_RUNTIME_*`` for runtime
+knobs, ``DYN_SYSTEM_*`` for the status server, ``DYN_WORKER_*`` for worker
+behavior. Values: env beats file beats defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default, cast=None):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    cast = cast or type(default)
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclass
+class RuntimeConfig:
+    store_address: str = "127.0.0.1:6650"
+    lease_ttl_s: float = 10.0
+    ingress_host: str = "127.0.0.1"
+    namespace: str = "dynamo"
+    # System status server (health/metrics), 0 port = ephemeral, None = off
+    system_enabled: bool = True
+    system_port: int = 0
+    # Logging
+    logging_jsonl: bool = False
+    log_level: str = "INFO"
+
+    @classmethod
+    def from_env(cls, config_file: str | None = None) -> "RuntimeConfig":
+        base: dict = {}
+        path = config_file or os.environ.get("DYN_RUNTIME_CONFIG")
+        if path and os.path.exists(path):
+            with open(path) as f:
+                base = json.load(f)
+        cfg = cls(**{k: v for k, v in base.items() if k in {f.name for f in fields(cls)}})
+        cfg.store_address = _env("DYN_STORE_ADDRESS", cfg.store_address)
+        cfg.lease_ttl_s = _env("DYN_RUNTIME_LEASE_TTL_S", cfg.lease_ttl_s)
+        cfg.ingress_host = _env("DYN_RUNTIME_INGRESS_HOST", cfg.ingress_host)
+        cfg.namespace = _env("DYN_NAMESPACE", cfg.namespace)
+        cfg.system_enabled = _env("DYN_SYSTEM_ENABLED", cfg.system_enabled)
+        cfg.system_port = _env("DYN_SYSTEM_PORT", cfg.system_port)
+        cfg.logging_jsonl = _env("DYN_LOGGING_JSONL", cfg.logging_jsonl)
+        cfg.log_level = _env("DYN_LOG_LEVEL", cfg.log_level)
+        return cfg

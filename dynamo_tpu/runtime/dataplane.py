@@ -1,0 +1,301 @@
+"""Request/response data plane: direct TCP streaming between processes.
+
+The reference pushes requests over NATS and streams responses back over a
+separate raw TCP channel (`lib/runtime/src/pipeline/network.rs:246-284`,
+`tcp/server.rs`, `tcp/client.rs`). We collapse both hops into one
+multiplexed TCP connection per (client, worker) pair: the client pushes a
+request frame carrying a control header + payload (the two-part codec,
+`codec/two_part.rs`) and response frames stream back on the same socket.
+One fewer network hop and no broker on the hot path — on TPU pods the
+request plane is latency-critical for disaggregation handoffs.
+
+Frames (framing.py codec):
+  client→server:  {"t":"req","i":id,"m":"ns/comp/ep","h":{...},"p":payload}
+                  {"t":"stop","i":id}            (graceful cancel)
+                  {"t":"kill","i":id}            (hard cancel)
+  server→client:  {"t":"rsp","i":id,"p":payload} (zero or more)
+                  {"t":"end","i":id}             (stream complete)
+                  {"t":"err","i":id,"err":msg}   (stream failed)
+
+Backpressure: response writes go through ``drain()``; a slow client
+throttles the producing engine naturally through TCP flow control.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.dataplane")
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class IngressServer:
+    """Per-process TCP listener dispatching requests to registered engines.
+
+    Parity: reference `PushEndpoint` worker loop
+    (`pipeline/network/ingress/push_endpoint.rs:18`).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._routes: dict[str, Handler] = {}
+        self._server: asyncio.Server | None = None
+        self._inflight: dict[tuple[int, int], tuple[asyncio.Task, Context]] = {}
+        self._conn_ids = itertools.count(1)
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    def register(self, route: str, handler: Handler) -> None:
+        self._routes[route] = handler
+
+    def unregister(self, route: str) -> None:
+        self._routes.pop(route, None)
+
+    @property
+    def num_inflight(self) -> int:
+        return len(self._inflight)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for task, ctx in self._inflight.values():
+            ctx.kill()
+            task.cancel()
+        # Close live connections too, so peers see worker death immediately
+        # (the signal request migration keys off).
+        for writer in list(self._writers):
+            writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn_id = next(self._conn_ids)
+        write_lock = asyncio.Lock()
+        self._writers.add(writer)
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                kind = msg.get("t")
+                if kind == "req":
+                    key = (conn_id, msg["i"])
+                    ctx = Context(
+                        request_id=msg.get("h", {}).get("x-request-id"),
+                        headers=msg.get("h", {}),
+                    )
+                    task = asyncio.create_task(
+                        self._serve_one(writer, write_lock, key, msg, ctx)
+                    )
+                    self._inflight[key] = (task, ctx)
+                elif kind in ("stop", "kill"):
+                    entry = self._inflight.get((conn_id, msg["i"]))
+                    if entry is not None:
+                        task, ctx = entry
+                        if kind == "kill":
+                            ctx.kill()
+                            task.cancel()
+                        else:
+                            ctx.stop_generating()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            # Peer gone: kill everything it had in flight on this connection.
+            for key in [k for k in self._inflight if k[0] == conn_id]:
+                task, ctx = self._inflight.pop(key)
+                ctx.kill()
+                task.cancel()
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _serve_one(
+        self,
+        writer: asyncio.StreamWriter,
+        write_lock: asyncio.Lock,
+        key: tuple[int, int],
+        msg: dict,
+        ctx: Context,
+    ) -> None:
+        req_id = msg["i"]
+
+        async def send(frame: dict) -> None:
+            async with write_lock:
+                await framing.send_frame(writer, frame)
+
+        try:
+            handler = self._routes.get(msg["m"])
+            if handler is None:
+                await send({"t": "err", "i": req_id, "err": f"no route {msg['m']!r}"})
+                return
+            async for item in handler(msg.get("p"), ctx):
+                await send({"t": "rsp", "i": req_id, "p": item})
+            await send({"t": "end", "i": req_id})
+        except asyncio.CancelledError:
+            raise
+        except ConnectionError:
+            pass
+        except Exception as e:  # noqa: BLE001 — stream errors go to the peer
+            log.exception("handler %s failed", msg.get("m"))
+            try:
+                await send({"t": "err", "i": req_id, "err": f"{type(e).__name__}: {e}"})
+            except ConnectionError:
+                pass
+        finally:
+            self._inflight.pop(key, None)
+
+
+class ResponseStream:
+    """Client-side view of one in-flight streamed response."""
+
+    _END = object()
+
+    def __init__(self, conn: "_EgressConn", req_id: int):
+        self._conn = conn
+        self._req_id = req_id
+        self._queue: asyncio.Queue[Any] = asyncio.Queue()
+        self._done = False
+
+    def _push(self, item: Any) -> None:
+        self._queue.put_nowait(item)
+
+    def __aiter__(self) -> "ResponseStream":
+        return self
+
+    async def __anext__(self) -> Any:
+        if self._done:
+            raise StopAsyncIteration
+        item = await self._queue.get()
+        if item is self._END:
+            self._done = True
+            raise StopAsyncIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item
+
+    async def stop(self) -> None:
+        """Graceful cancel: worker finishes current state and ends stream."""
+        await self._conn.send({"t": "stop", "i": self._req_id})
+
+    async def kill(self) -> None:
+        await self._conn.send({"t": "kill", "i": self._req_id})
+        self._push(self._END)
+
+
+class _EgressConn:
+    def __init__(self, address: str):
+        self.address = address
+        host, _, port = address.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._writer: asyncio.StreamWriter | None = None
+        self._streams: dict[int, ResponseStream] = {}
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+        self._reader_task: asyncio.Task | None = None
+        self.healthy = True
+
+    async def connect(self) -> None:
+        reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        self._reader_task = asyncio.create_task(self._recv_loop(reader))
+
+    async def send(self, frame: dict) -> None:
+        if self._writer is None:
+            raise ConnectionError("egress not connected")
+        async with self._lock:
+            await framing.send_frame(self._writer, frame)
+
+    async def request(self, route: str, payload: Any, headers: dict[str, str]) -> ResponseStream:
+        req_id = next(self._ids)
+        stream = ResponseStream(self, req_id)
+        self._streams[req_id] = stream
+        await self.send({"t": "req", "i": req_id, "m": route, "h": headers, "p": payload})
+        return stream
+
+    async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                stream = self._streams.get(msg["i"])
+                if stream is None:
+                    continue
+                kind = msg["t"]
+                if kind == "rsp":
+                    stream._push(msg["p"])
+                elif kind == "end":
+                    stream._push(ResponseStream._END)
+                    self._streams.pop(msg["i"], None)
+                elif kind == "err":
+                    stream._push(EngineStreamError(msg["err"]))
+                    self._streams.pop(msg["i"], None)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.healthy = False
+            err = ConnectionError(f"connection to {self.address} lost")
+            for stream in self._streams.values():
+                stream._push(err)
+            self._streams.clear()
+
+    def close(self) -> None:
+        self.healthy = False
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+
+class EgressClient:
+    """Connection pool to worker ingress servers, keyed by address.
+
+    Parity: reference `pipeline/network/egress/addressed_router.rs` +
+    `tcp/client.rs` (addressed request push + response registration).
+    """
+
+    def __init__(self) -> None:
+        self._conns: dict[str, _EgressConn] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    async def _get_conn(self, address: str) -> _EgressConn:
+        conn = self._conns.get(address)
+        if conn is not None and conn.healthy:
+            return conn
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and conn.healthy:
+                return conn
+            conn = _EgressConn(address)
+            await conn.connect()
+            self._conns[address] = conn
+            return conn
+
+    async def request(
+        self,
+        address: str,
+        route: str,
+        payload: Any,
+        headers: dict[str, str] | None = None,
+    ) -> ResponseStream:
+        conn = await self._get_conn(address)
+        return await conn.request(route, payload, headers or {})
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+
+
+class EngineStreamError(RuntimeError):
+    """The remote engine reported a failure mid-stream."""

@@ -1,0 +1,122 @@
+"""The universal streaming-engine abstraction.
+
+Every request-serving unit in the framework — preprocessor, router, network
+egress, model worker, mock engine — is an *async engine*: a callable taking
+one request plus a :class:`Context` and yielding a stream of responses.
+Engines compose into pipelines by wrapping each other.
+
+Capability parity: reference `lib/runtime/src/engine.rs:90-219`
+(`AsyncEngine<SingleIn<Req>, ManyOut<Resp>>`, `AsyncEngineContext`) and
+`lib/runtime/src/protocols/annotated.rs:21` (`Annotated<R>` envelope).
+Re-designed: Python async generators *are* ManyOut streams, so the trait
+collapses to a protocol with one method; context propagation rides
+contextvars-free explicit argument passing (explicit beats implicit in a
+codebase with process boundaries).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+
+class Context:
+    """Per-request context: identity, tracing, and two-stage cancellation.
+
+    ``stop`` asks the engine to finish gracefully (emit what it has);
+    ``kill`` demands immediate abandonment. Mirrors AsyncEngineContext's
+    stop/kill semantics (reference engine.rs:124-180).
+    """
+
+    def __init__(self, request_id: str | None = None, headers: dict[str, str] | None = None):
+        self.id = request_id or uuid.uuid4().hex
+        self.headers: dict[str, str] = headers or {}
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    def stop_generating(self) -> None:
+        self._stopped.set()
+
+    def kill(self) -> None:
+        self._killed.set()
+        self._stopped.set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+    def child(self) -> "Context":
+        """A context sharing identity+cancellation with its parent."""
+        child = Context.__new__(Context)
+        child.id = self.id
+        child.headers = self.headers
+        child._stopped = self._stopped
+        child._killed = self._killed
+        return child
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """Anything that turns one request into a stream of responses."""
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        ...
+
+
+@dataclass
+class Annotated:
+    """SSE-shaped event envelope flowing through LLM pipelines.
+
+    Exactly one of ``data`` (a payload chunk) or ``event``+``comment``
+    (a named signal, e.g. ``error`` or an annotation) is typically set.
+    """
+
+    data: Any = None
+    event: str | None = None
+    comment: list[str] = field(default_factory=list)
+    id: str | None = None
+
+    @classmethod
+    def from_data(cls, data: Any) -> "Annotated":
+        return cls(data=data)
+
+    @classmethod
+    def from_error(cls, message: str) -> "Annotated":
+        return cls(event="error", comment=[message])
+
+    @property
+    def is_error(self) -> bool:
+        return self.event == "error"
+
+    def error_message(self) -> str | None:
+        return "; ".join(self.comment) if self.is_error else None
+
+    def to_wire(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.data is not None:
+            out["d"] = self.data
+        if self.event is not None:
+            out["e"] = self.event
+        if self.comment:
+            out["c"] = self.comment
+        if self.id is not None:
+            out["id"] = self.id
+        return out
+
+    @classmethod
+    def from_wire(cls, msg: dict) -> "Annotated":
+        return cls(
+            data=msg.get("d"),
+            event=msg.get("e"),
+            comment=msg.get("c", []),
+            id=msg.get("id"),
+        )

@@ -1,0 +1,4 @@
+from dynamo_tpu.runtime.store.client import StoreClient, WatchEvent, Subscription
+from dynamo_tpu.runtime.store.server import StoreServer
+
+__all__ = ["StoreClient", "StoreServer", "WatchEvent", "Subscription"]

@@ -1,0 +1,3 @@
+from dynamo_tpu.runtime.store.server import main
+
+main()

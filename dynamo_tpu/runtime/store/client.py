@@ -1,0 +1,262 @@
+"""Async client for the control-plane store (see server.py for the contract).
+
+One TCP connection multiplexes all requests, watches, subscriptions, and
+queue ops for a process. Leases are kept alive by a background task at
+ttl/3, mirroring the reference's etcd lease keep-alive
+(`lib/runtime/src/transports/etcd.rs:54-128`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime import framing
+
+log = logging.getLogger("dynamo_tpu.store.client")
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # "put" | "delete"
+    key: str
+    value: bytes
+    revision: int
+
+
+@dataclass(frozen=True)
+class Message:
+    subject: str
+    payload: bytes
+
+
+class Subscription:
+    """Stream of server-push events for one watch/subscription."""
+
+    _CLOSED = object()
+
+    def __init__(self, client: "StoreClient", sub_id: int):
+        self._client = client
+        self.sub_id = sub_id
+        self.queue: asyncio.Queue[Any] = asyncio.Queue()
+
+    async def __aiter__(self) -> AsyncIterator[Any]:
+        while True:
+            item = await self.queue.get()
+            if item is self._CLOSED:
+                return
+            yield item
+
+    async def get(self, timeout: float | None = None) -> Any:
+        item = await asyncio.wait_for(self.queue.get(), timeout)
+        if item is self._CLOSED:
+            raise ConnectionError("subscription closed")
+        return item
+
+    def close_nowait(self) -> None:
+        self.queue.put_nowait(self._CLOSED)
+
+    async def unsubscribe(self) -> None:
+        await self._client.unsubscribe(self)
+
+
+class StoreClient:
+    def __init__(self, address: str):
+        self.address = address
+        host, _, port = address.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future[Any]] = {}
+        self._subs: dict[int, Subscription] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._keepalive_tasks: dict[int, asyncio.Task] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def connect(self) -> "StoreClient":
+        if self._writer is not None:
+            return self
+        self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        self._reader_task = asyncio.create_task(self._recv_loop())
+        return self
+
+    @classmethod
+    async def open(cls, address: str) -> "StoreClient":
+        return await cls(address).connect()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for task in self._keepalive_tasks.values():
+            task.cancel()
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("store client closed"))
+        for sub in self._subs.values():
+            sub.close_nowait()
+
+    async def __aenter__(self) -> "StoreClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _recv_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await framing.read_frame(self._reader)
+                if "s" in msg:  # server push
+                    sub = self._subs.get(msg["s"])
+                    if sub is not None:
+                        sub.queue.put_nowait(msg["ev"])
+                    continue
+                fut = self._pending.pop(msg["i"], None)
+                if fut is None or fut.done():
+                    continue
+                if msg["ok"]:
+                    fut.set_result(msg["r"])
+                else:
+                    fut.set_exception(StoreError(msg["err"]))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("store connection lost"))
+            self._pending.clear()
+            for sub in self._subs.values():
+                sub.close_nowait()
+
+    async def _request(self, op: str, **params: Any) -> Any:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        req_id = next(self._ids)
+        fut: asyncio.Future[Any] = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            await framing.send_frame(self._writer, {"i": req_id, "op": op, **params})
+        return await fut
+
+    # -- KV ----------------------------------------------------------------
+
+    async def kv_put(
+        self, key: str, value: bytes, lease: int = 0, create_only: bool = False
+    ) -> int:
+        r = await self._request("kv_put", k=key, v=value, lease=lease, create_only=create_only)
+        return r["rev"]
+
+    async def kv_get(self, key: str) -> bytes | None:
+        r = await self._request("kv_get", k=key)
+        return None if r is None else r["v"]
+
+    async def kv_del(self, key: str) -> int:
+        return await self._request("kv_del", k=key)
+
+    async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]:
+        r = await self._request("kv_get_prefix", k=prefix)
+        return {e["k"]: e["v"] for e in r}
+
+    async def kv_watch(self, prefix: str, with_initial: bool = True) -> Subscription:
+        r = await self._request("kv_watch", k=prefix, with_initial=with_initial)
+        sub = Subscription(self, r["sub"])
+        self._subs[r["sub"]] = sub
+        for ev in r["initial"]:
+            sub.queue.put_nowait(ev)
+        return sub
+
+    @staticmethod
+    def as_watch_event(ev: dict) -> WatchEvent:
+        return WatchEvent(type=ev["t"], key=ev["k"], value=ev["v"], revision=ev["rev"])
+
+    # -- leases ------------------------------------------------------------
+
+    async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> int:
+        r = await self._request("lease_grant", ttl=ttl)
+        lease_id = r["lease"]
+        if keepalive:
+            self._keepalive_tasks[lease_id] = asyncio.create_task(
+                self._keepalive_loop(lease_id, ttl)
+            )
+        return lease_id
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(ttl / 3.0)
+                await self._request("lease_keepalive", lease=lease_id)
+        except (asyncio.CancelledError, ConnectionError, StoreError):
+            pass
+
+    async def lease_revoke(self, lease_id: int) -> bool:
+        task = self._keepalive_tasks.pop(lease_id, None)
+        if task:
+            task.cancel()
+        return await self._request("lease_revoke", lease=lease_id)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def subscribe(self, subject: str) -> Subscription:
+        r = await self._request("sub", subject=subject)
+        sub = Subscription(self, r["sub"])
+        self._subs[r["sub"]] = sub
+        return sub
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        return await self._request("pub", subject=subject, p=payload)
+
+    async def unsubscribe(self, sub: Subscription) -> None:
+        self._subs.pop(sub.sub_id, None)
+        sub.close_nowait()
+        try:
+            await self._request("unsub", sub=sub.sub_id)
+        except (ConnectionError, StoreError):
+            pass
+
+    @staticmethod
+    def as_message(ev: dict) -> Message:
+        return Message(subject=ev["subject"], payload=ev["p"])
+
+    # -- work queues -------------------------------------------------------
+
+    async def queue_push(self, name: str, payload: bytes) -> int:
+        return await self._request("q_push", q=name, p=payload)
+
+    async def queue_pop(self, name: str, timeout: float = 0.0) -> bytes | None:
+        return await self._request("q_pop", q=name, timeout=timeout)
+
+    async def queue_len(self, name: str) -> int:
+        return await self._request("q_len", q=name)
+
+    # -- object store ------------------------------------------------------
+
+    async def obj_put(self, bucket: str, name: str, payload: bytes) -> None:
+        await self._request("obj_put", b=bucket, name=name, p=payload)
+
+    async def obj_get(self, bucket: str, name: str) -> bytes | None:
+        return await self._request("obj_get", b=bucket, name=name)
+
+    async def obj_del(self, bucket: str, name: str) -> bool:
+        return await self._request("obj_del", b=bucket, name=name)
+
+    async def obj_list(self, bucket: str) -> list[str]:
+        return await self._request("obj_list", b=bucket)
+
+    async def ping(self) -> str:
+        return await self._request("ping")
+
+
+class StoreError(RuntimeError):
+    pass

@@ -1,0 +1,398 @@
+"""The control-plane store: discovery + messaging for the whole framework.
+
+One asyncio TCP server providing the services the reference sources from two
+external systems (SURVEY.md §1 L0):
+
+- **KV with leases and prefix watches** (etcd-equivalent): service discovery,
+  instance registration under leases, config hot-reload, barriers.
+  Parity: reference `lib/runtime/src/transports/etcd.rs:46-309`.
+- **Pub/sub subjects, work queues, object store** (NATS-equivalent):
+  KV events, metrics fan-out, the prefill work queue, model-card storage.
+  Parity: reference `lib/runtime/src/transports/nats.rs:58-253,433-600`.
+
+Design notes (TPU build): the reference assumes operators run etcd + NATS
+next to the cluster. We ship the control plane in-tree instead — it is
+hardware-neutral asyncio code, one process, zero external dependencies —
+while keeping etcd's *semantics* (leases expire → instances vanish from
+discovery; watches see PUT/DELETE with revisions) so every layer above
+(discovery, router, disagg, planner) behaves like the reference's.
+
+Failure detection: a lease dies when its TTL lapses without keepalive OR
+when the owning connection drops — the latter gives sub-second worker-death
+detection (faster than etcd's TTL-only model) and is what request migration
+keys off.
+
+Wire protocol (framing.py): requests ``{"i": id, "op": str, ...}`` →
+responses ``{"i": id, "ok": bool, "r"/"err": ...}``; server-push events
+``{"s": sub_id, "ev": {...}}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any
+
+from dynamo_tpu.runtime import framing
+
+log = logging.getLogger("dynamo_tpu.store")
+
+SWEEP_INTERVAL_S = 0.5
+SUB_QUEUE_LIMIT = 16384
+
+
+@dataclass
+class _KvEntry:
+    value: bytes
+    lease_id: int
+    create_rev: int
+    mod_rev: int
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl_s: float
+    deadline: float
+    conn_id: int  # owning connection; 0 = detached
+    keys: set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Sub:
+    sub_id: int
+    conn: "_Conn"
+    kind: str  # "watch" | "sub"
+    pattern: str
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style subject matching: '.' tokens, '*' one token, '>' tail."""
+    p_toks = pattern.split(".")
+    s_toks = subject.split(".")
+    for i, p in enumerate(p_toks):
+        if p == ">":  # '>' requires at least one remaining subject token
+            return len(s_toks) > i
+        if i >= len(s_toks):
+            return False
+        if p != "*" and p != s_toks[i]:
+            return False
+    return len(p_toks) == len(s_toks)
+
+
+class _Conn:
+    def __init__(self, conn_id: int, writer: asyncio.StreamWriter):
+        self.conn_id = conn_id
+        self.writer = writer
+        self.queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=SUB_QUEUE_LIMIT)
+        self.closed = False
+
+    def push(self, msg: Any) -> None:
+        """Enqueue an outbound frame; drops (with a log) if the peer is slow."""
+        if self.closed:
+            return
+        try:
+            self.queue.put_nowait(framing.pack(msg))
+        except asyncio.QueueFull:
+            log.warning("conn %d slow consumer, dropping frame", self.conn_id)
+
+
+class StoreServer:
+    """In-process control-plane server. ``async with`` or start()/stop()."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: asyncio.Server | None = None
+        self._rev = 0
+        self._ids = itertools.count(1)
+        self._kv: dict[str, _KvEntry] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._subs: dict[int, _Sub] = {}
+        self._conns: dict[int, _Conn] = {}
+        self._queues: dict[str, deque[bytes]] = defaultdict(deque)
+        self._queue_waiters: dict[str, deque[asyncio.Future[bytes]]] = defaultdict(deque)
+        self._objects: dict[str, dict[str, bytes]] = defaultdict(dict)
+        self._sweeper: asyncio.Task | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._sweeper = asyncio.create_task(self._sweep_loop())
+        log.info("store server listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper:
+            self._sweeper.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns.values()):
+            conn.closed = True
+            conn.writer.close()
+
+    async def __aenter__(self) -> "StoreServer":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- connection handling ----------------------------------------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = _Conn(next(self._ids), writer)
+        self._conns[conn.conn_id] = conn
+        sender = asyncio.create_task(self._send_loop(conn))
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                try:
+                    result = await self._dispatch(conn, msg)
+                    conn.push({"i": msg["i"], "ok": True, "r": result})
+                except Exception as e:  # noqa: BLE001 — report op errors to client
+                    conn.push({"i": msg["i"], "ok": False, "err": str(e)})
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            self._drop_conn(conn)
+            sender.cancel()
+
+    async def _send_loop(self, conn: _Conn) -> None:
+        try:
+            while True:
+                frame = await conn.queue.get()
+                if frame is None:
+                    break
+                conn.writer.write(frame)
+                await conn.writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        conn.closed = True
+        self._conns.pop(conn.conn_id, None)
+        for sub_id in [s for s, sub in self._subs.items() if sub.conn is conn]:
+            self._subs.pop(sub_id, None)
+        # Connection death revokes its leases → fast failure detection.
+        for lease in [l for l in self._leases.values() if l.conn_id == conn.conn_id]:
+            self._revoke_lease(lease.lease_id)
+        conn.writer.close()
+
+    # -- op dispatch -------------------------------------------------------
+
+    async def _dispatch(self, conn: _Conn, msg: dict) -> Any:
+        op = msg["op"]
+        handler = getattr(self, f"_op_{op}", None)
+        if handler is None:
+            raise ValueError(f"unknown op {op!r}")
+        return await handler(conn, msg)
+
+    # -- KV ----------------------------------------------------------------
+
+    def _notify_kv(self, event: str, key: str, value: bytes, rev: int) -> None:
+        for sub in self._subs.values():
+            if sub.kind == "watch" and key.startswith(sub.pattern):
+                sub.conn.push(
+                    {"s": sub.sub_id, "ev": {"t": event, "k": key, "v": value, "rev": rev}}
+                )
+
+    async def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
+        key, value = msg["k"], msg["v"]
+        lease_id = msg.get("lease", 0)
+        existing = self._kv.get(key)
+        if msg.get("create_only") and existing is not None:
+            raise ValueError(f"key exists: {key}")
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"no such lease {lease_id}")
+            lease.keys.add(key)
+        self._rev += 1
+        self._kv[key] = _KvEntry(
+            value=value,
+            lease_id=lease_id,
+            create_rev=existing.create_rev if existing else self._rev,
+            mod_rev=self._rev,
+        )
+        self._notify_kv("put", key, value, self._rev)
+        return {"rev": self._rev}
+
+    async def _op_kv_get(self, conn: _Conn, msg: dict) -> dict | None:
+        entry = self._kv.get(msg["k"])
+        if entry is None:
+            return None
+        return {"v": entry.value, "rev": entry.mod_rev, "lease": entry.lease_id}
+
+    async def _op_kv_del(self, conn: _Conn, msg: dict) -> int:
+        return self._delete_key(msg["k"])
+
+    def _delete_key(self, key: str) -> int:
+        entry = self._kv.pop(key, None)
+        if entry is None:
+            return 0
+        if entry.lease_id and entry.lease_id in self._leases:
+            self._leases[entry.lease_id].keys.discard(key)
+        self._rev += 1
+        self._notify_kv("delete", key, b"", self._rev)
+        return 1
+
+    async def _op_kv_get_prefix(self, conn: _Conn, msg: dict) -> list:
+        prefix = msg["k"]
+        return [
+            {"k": k, "v": e.value, "rev": e.mod_rev}
+            for k, e in sorted(self._kv.items())
+            if k.startswith(prefix)
+        ]
+
+    async def _op_kv_watch(self, conn: _Conn, msg: dict) -> dict:
+        sub_id = next(self._ids)
+        self._subs[sub_id] = _Sub(sub_id, conn, "watch", msg["k"])
+        initial = []
+        if msg.get("with_initial", True):
+            initial = [
+                {"t": "put", "k": k, "v": e.value, "rev": e.mod_rev}
+                for k, e in sorted(self._kv.items())
+                if k.startswith(msg["k"])
+            ]
+        return {"sub": sub_id, "initial": initial}
+
+    async def _op_unsub(self, conn: _Conn, msg: dict) -> bool:
+        return self._subs.pop(msg["sub"], None) is not None
+
+    # -- leases ------------------------------------------------------------
+
+    async def _op_lease_grant(self, conn: _Conn, msg: dict) -> dict:
+        lease_id = next(self._ids)
+        ttl = float(msg.get("ttl", 10.0))
+        conn_bound = bool(msg.get("conn_bound", True))
+        self._leases[lease_id] = _Lease(
+            lease_id=lease_id,
+            ttl_s=ttl,
+            deadline=time.monotonic() + ttl,
+            conn_id=conn.conn_id if conn_bound else 0,
+        )
+        return {"lease": lease_id, "ttl": ttl}
+
+    async def _op_lease_keepalive(self, conn: _Conn, msg: dict) -> dict:
+        lease = self._leases.get(msg["lease"])
+        if lease is None:
+            raise ValueError(f"no such lease {msg['lease']}")
+        lease.deadline = time.monotonic() + lease.ttl_s
+        return {"ttl": lease.ttl_s}
+
+    async def _op_lease_revoke(self, conn: _Conn, msg: dict) -> bool:
+        return self._revoke_lease(msg["lease"])
+
+    def _revoke_lease(self, lease_id: int) -> bool:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        for key in list(lease.keys):
+            self._delete_key(key)
+        return True
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(SWEEP_INTERVAL_S)
+            now = time.monotonic()
+            for lease_id in [l.lease_id for l in self._leases.values() if l.deadline < now]:
+                log.info("lease %d expired", lease_id)
+                self._revoke_lease(lease_id)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def _op_sub(self, conn: _Conn, msg: dict) -> dict:
+        sub_id = next(self._ids)
+        self._subs[sub_id] = _Sub(sub_id, conn, "sub", msg["subject"])
+        return {"sub": sub_id}
+
+    async def _op_pub(self, conn: _Conn, msg: dict) -> int:
+        subject, payload = msg["subject"], msg["p"]
+        n = 0
+        for sub in self._subs.values():
+            if sub.kind == "sub" and subject_matches(sub.pattern, subject):
+                sub.conn.push({"s": sub.sub_id, "ev": {"subject": subject, "p": payload}})
+                n += 1
+        return n
+
+    # -- work queues -------------------------------------------------------
+
+    async def _op_q_push(self, conn: _Conn, msg: dict) -> int:
+        name, payload = msg["q"], msg["p"]
+        waiters = self._queue_waiters[name]
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(payload)
+                return 0
+        self._queues[name].append(payload)
+        return len(self._queues[name])
+
+    async def _op_q_pop(self, conn: _Conn, msg: dict) -> bytes | None:
+        name = msg["q"]
+        timeout = msg.get("timeout", 0.0)
+        queue = self._queues[name]
+        if queue:
+            return queue.popleft()
+        if timeout <= 0:
+            return None
+        fut: asyncio.Future[bytes] = asyncio.get_running_loop().create_future()
+        self._queue_waiters[name].append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def _op_q_len(self, conn: _Conn, msg: dict) -> int:
+        return len(self._queues[msg["q"]])
+
+    # -- object store ------------------------------------------------------
+
+    async def _op_obj_put(self, conn: _Conn, msg: dict) -> bool:
+        self._objects[msg["b"]][msg["name"]] = msg["p"]
+        return True
+
+    async def _op_obj_get(self, conn: _Conn, msg: dict) -> bytes | None:
+        return self._objects.get(msg["b"], {}).get(msg["name"])
+
+    async def _op_obj_del(self, conn: _Conn, msg: dict) -> bool:
+        return self._objects.get(msg["b"], {}).pop(msg["name"], None) is not None
+
+    async def _op_obj_list(self, conn: _Conn, msg: dict) -> list[str]:
+        return sorted(self._objects.get(msg["b"], {}).keys())
+
+    async def _op_ping(self, conn: _Conn, msg: dict) -> str:
+        return "pong"
+
+
+async def _amain(host: str, port: int) -> None:
+    server = StoreServer(host, port)
+    await server.start()
+    print(f"dynamo-tpu store listening on {server.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="dynamo-tpu control-plane store server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6650)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args.host, args.port))
+
+
+if __name__ == "__main__":
+    main()

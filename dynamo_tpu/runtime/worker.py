@@ -1,0 +1,55 @@
+"""Worker process entrypoint helpers.
+
+``@dynamo_worker`` turns an ``async def main(runtime, ...)`` into a process
+entry: builds the DistributedRuntime from env/config, installs SIGINT/SIGTERM
+→ graceful shutdown, runs the coroutine, and tears the runtime down.
+
+Capability parity: reference `lib/runtime/src/worker.rs` (`Worker::execute`)
+and the Python `@dynamo_worker` decorator
+(`lib/bindings/python/src/dynamo/runtime`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import signal
+from typing import Any, Awaitable, Callable
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.logging_setup import setup_logging
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+def dynamo_worker(
+    config: RuntimeConfig | None = None,
+) -> Callable[[Callable[..., Awaitable[Any]]], Callable[..., Any]]:
+    def decorator(fn: Callable[..., Awaitable[Any]]) -> Callable[..., Any]:
+        @functools.wraps(fn)
+        def entry(*args: Any, **kwargs: Any) -> Any:
+            cfg = config or RuntimeConfig.from_env()
+            setup_logging(cfg.log_level, cfg.logging_jsonl)
+            return asyncio.run(_run(fn, cfg, *args, **kwargs))
+
+        return entry
+
+    return decorator
+
+
+async def _run(fn: Callable[..., Awaitable[Any]], cfg: RuntimeConfig, *args, **kwargs) -> Any:
+    runtime = await DistributedRuntime.create(
+        cfg.store_address, lease_ttl=cfg.lease_ttl_s, ingress_host=cfg.ingress_host
+    )
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, runtime.signal_shutdown)
+        except NotImplementedError:  # non-main thread
+            pass
+    try:
+        return await fn(runtime, *args, **kwargs)
+    finally:
+        await runtime.shutdown()

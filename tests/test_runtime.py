@@ -1,0 +1,161 @@
+"""Distributed runtime: endpoint serve/discover/stream/cancel/failure.
+
+Parity targets: reference component model + PushRouter behaviors
+(SURVEY.md §2.1) exercised through the in-process control plane.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime, NoInstancesError
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.integration, pytest.mark.pre_merge]
+
+
+async def echo_handler(request, context: Context):
+    for i in range(request["n"]):
+        yield {"i": i, "msg": request["msg"]}
+
+
+async def slow_handler(request, context: Context):
+    for i in range(1000):
+        if context.is_stopped:
+            yield {"stopped_at": i}
+            return
+        yield {"i": i}
+        await asyncio.sleep(0.01)
+
+
+async def test_serve_and_stream():
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            ep = worker.namespace("test").component("workers").endpoint("generate")
+            await ep.serve(echo_handler)
+
+            client = await frontend.namespace("test").component("workers").endpoint("generate").client()
+            await client.wait_for_instances(1, timeout=5)
+            stream = await client.round_robin({"n": 3, "msg": "hi"})
+            out = [item async for item in stream]
+            assert out == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"}, {"i": 2, "msg": "hi"}]
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+
+
+async def test_direct_routing_and_instance_removal():
+    async with StoreServer() as server:
+        w1 = await DistributedRuntime.create(server.address)
+        w2 = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            async def tagged(tag):
+                async def handler(request, context):
+                    yield {"worker": tag}
+                return handler
+
+            ep1 = w1.namespace("t").component("w").endpoint("gen")
+            await ep1.serve(await tagged("w1"))
+            ep2 = w2.namespace("t").component("w").endpoint("gen")
+            await ep2.serve(await tagged("w2"))
+
+            client = await frontend.namespace("t").component("w").endpoint("gen").client()
+            ids = await client.wait_for_instances(2, timeout=5)
+            assert len(ids) == 2
+            assert w1.primary_lease_id in ids and w2.primary_lease_id in ids
+
+            stream = await client.direct(w1.primary_lease_id, {})
+            assert [x async for x in stream] == [{"worker": "w1"}]
+
+            # Kill w2's process (connection drop) → instance disappears.
+            await w2.shutdown()
+            while len(client.instances) > 1:
+                await asyncio.sleep(0.05)
+            assert client.instance_ids() == [w1.primary_lease_id]
+        finally:
+            await frontend.shutdown()
+            await w1.shutdown()
+
+
+async def test_stop_generating_mid_stream():
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            ep = worker.namespace("t").component("w").endpoint("slow")
+            await ep.serve(slow_handler)
+            client = await frontend.namespace("t").component("w").endpoint("slow").client()
+            await client.wait_for_instances(1, timeout=5)
+
+            stream = await client.round_robin({})
+            got = []
+            async for item in stream:
+                got.append(item)
+                if len(got) == 3:
+                    await stream.stop()
+                if "stopped_at" in item:
+                    break
+            assert any("stopped_at" in g for g in got)
+            assert len(got) < 1000
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+
+
+async def test_handler_error_propagates():
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            async def bad(request, context):
+                yield {"ok": 1}
+                raise ValueError("boom")
+
+            ep = worker.namespace("t").component("w").endpoint("bad")
+            await ep.serve(bad)
+            client = await frontend.namespace("t").component("w").endpoint("bad").client()
+            await client.wait_for_instances(1, timeout=5)
+            stream = await client.round_robin({})
+            with pytest.raises(Exception, match="boom"):
+                async for _ in stream:
+                    pass
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+
+
+async def test_no_instances_error():
+    async with StoreServer() as server:
+        rt = await DistributedRuntime.create(server.address)
+        try:
+            client = await rt.namespace("t").component("w").endpoint("none").client()
+            with pytest.raises(NoInstancesError):
+                await client.round_robin({})
+        finally:
+            await rt.shutdown()
+
+
+async def test_worker_death_fails_inflight_stream():
+    """A dying worker must error the client's stream, not hang it (the
+    precondition for request migration)."""
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            ep = worker.namespace("t").component("w").endpoint("slow")
+            await ep.serve(slow_handler)
+            client = await frontend.namespace("t").component("w").endpoint("slow").client()
+            await client.wait_for_instances(1, timeout=5)
+            stream = await client.round_robin({})
+            got = 0
+            with pytest.raises(ConnectionError):
+                async for _ in stream:
+                    got += 1
+                    if got == 2:
+                        await worker.shutdown()
+            assert got >= 2
+        finally:
+            await frontend.shutdown()
