@@ -1,0 +1,148 @@
+"""Model discovery: workers announce models, frontends react.
+
+A worker serving a model writes a :class:`ModelEntry` into the control-plane
+KV under its lease (key: ``/dynamo/models/{name}/{instance_id}``); the entry
+points at the serving endpoint and the MDC checksum. Frontends run a
+:class:`ModelWatcher` over the prefix and add/remove models from their
+:class:`ModelManager` as workers come and go — including pulling the MDC
+from the object store on first sight.
+
+Capability parity: reference `lib/llm/src/discovery/{model_entry.rs:22,
+watcher.rs:41-46, model_manager.rs}` and the `register_llm` flow
+(`lib/bindings/python/rust/lib.rs:143`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+import msgpack
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.component import Endpoint
+from dynamo_tpu.runtime.store import StoreClient, Subscription
+
+log = logging.getLogger("dynamo_tpu.llm.discovery")
+
+MODEL_ROOT = "/dynamo/models"
+
+
+@dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    mdc_checksum: str
+
+    @property
+    def endpoint_path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.endpoint}"
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(
+            {
+                "name": self.name,
+                "ns": self.namespace,
+                "comp": self.component,
+                "ep": self.endpoint,
+                "id": self.instance_id,
+                "mdc": self.mdc_checksum,
+            }
+        )
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "ModelEntry":
+        d = msgpack.unpackb(raw, raw=False)
+        return cls(
+            name=d["name"],
+            namespace=d["ns"],
+            component=d["comp"],
+            endpoint=d["ep"],
+            instance_id=d["id"],
+            mdc_checksum=d["mdc"],
+        )
+
+
+async def register_llm(
+    endpoint: Endpoint,
+    mdc: ModelDeploymentCard,
+    instance_id: int | None = None,
+) -> ModelEntry:
+    """Publish the MDC + model entry for an endpoint already being served."""
+    runtime = endpoint.runtime
+    checksum = await mdc.publish(runtime.store)
+    entry = ModelEntry(
+        name=mdc.name,
+        namespace=endpoint.namespace,
+        component=endpoint.component,
+        endpoint=endpoint.name,
+        instance_id=instance_id if instance_id is not None else runtime.primary_lease_id,
+        mdc_checksum=checksum,
+    )
+    await runtime.store.kv_put(
+        f"{MODEL_ROOT}/{mdc.name}/{entry.instance_id:016x}",
+        entry.to_wire(),
+        lease=runtime.primary_lease_id,
+    )
+    log.info("registered model %r → %s (mdc %s)", mdc.name, entry.endpoint_path, checksum)
+    return entry
+
+
+class ModelWatcher:
+    """Watches MODEL_ROOT; fires add/remove callbacks with entry + card.
+
+    A model is *added* on its first live instance and *removed* when its
+    last instance disappears (frontends keep serving while any worker
+    remains, parity watcher.rs prune semantics).
+    """
+
+    def __init__(self, store: StoreClient):
+        self._store = store
+        self._instances: dict[str, ModelEntry] = {}  # key → entry
+        self._counts: dict[str, int] = {}  # model name → live instances
+        self.on_model_added: list[
+            Callable[[ModelEntry, ModelDeploymentCard], Awaitable[None]]
+        ] = []
+        self.on_model_removed: list[Callable[[str], Awaitable[None]]] = []
+        self._task: asyncio.Task | None = None
+        self._watch: Subscription | None = None
+
+    async def start(self) -> None:
+        self._watch = await self._store.kv_watch(MODEL_ROOT + "/")
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.unsubscribe()
+
+    async def _loop(self) -> None:
+        assert self._watch is not None
+        async for ev in self._watch:
+            event = StoreClient.as_watch_event(ev)
+            try:
+                if event.type == "put":
+                    entry = ModelEntry.from_wire(event.value)
+                    self._instances[event.key] = entry
+                    self._counts[entry.name] = self._counts.get(entry.name, 0) + 1
+                    if self._counts[entry.name] == 1:
+                        mdc = await ModelDeploymentCard.fetch(self._store, entry.mdc_checksum)
+                        for cb in self.on_model_added:
+                            await cb(entry, mdc)
+                else:
+                    entry = self._instances.pop(event.key, None)
+                    if entry is None:
+                        continue
+                    self._counts[entry.name] -= 1
+                    if self._counts[entry.name] == 0:
+                        del self._counts[entry.name]
+                        for cb in self.on_model_removed:
+                            await cb(entry.name)
+            except Exception:  # noqa: BLE001 — a bad entry must not kill the watcher
+                log.exception("model watcher event failed: %s", event.key)

@@ -1,0 +1,15 @@
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+__all__ = [
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+]

@@ -1,0 +1,144 @@
+"""Internal LLM pipeline types: what flows between preprocessor, router,
+workers, and the response path.
+
+Everything here is msgpack-friendly (plain dicts on the wire via
+``to_wire``/``from_wire``) because these cross process boundaries on the
+data plane.
+
+Capability parity: reference `lib/llm/src/protocols/common/llm_backend.rs`
+(PreprocessedRequest / LLMEngineOutput) and `protocols/common/*` (sampling
+and stop-condition extraction).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"           # stop token / stop string hit
+    LENGTH = "length"       # max_tokens reached
+    EOS = "eos"             # model emitted EOS (maps to "stop" in OpenAI)
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def as_openai(self) -> str:
+        return "stop" if self in (FinishReason.EOS, FinishReason.STOP) else self.value
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1          # -1 = disabled
+    seed: int | None = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    n: int = 1
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+@dataclass
+class StopConditions:
+    max_tokens: int | None = None
+    min_tokens: int = 0
+    stop: list[str] = field(default_factory=list)
+    stop_token_ids: list[int] = field(default_factory=list)
+    ignore_eos: bool = False
+
+
+@dataclass
+class OutputOptions:
+    logprobs: int | None = None   # top-k logprobs per token, None = off
+    echo: bool = False
+    skip_special_tokens: bool = True
+
+
+@dataclass
+class PreprocessedRequest:
+    """A tokenized request, ready to route to any worker."""
+
+    model: str
+    token_ids: list[int]
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    output: OutputOptions = field(default_factory=OutputOptions)
+    # Router hints / overrides (per-request, parity kv_router.rs:79)
+    router: dict[str, Any] = field(default_factory=dict)
+    # Disaggregation handoff (set by decode worker → prefill worker)
+    kv_transfer_params: dict[str, Any] | None = None
+    annotations: list[str] = field(default_factory=list)
+    request_id: str | None = None
+
+    def to_wire(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PreprocessedRequest":
+        return cls(
+            model=d["model"],
+            token_ids=list(d["token_ids"]),
+            sampling=SamplingOptions(**d.get("sampling", {})),
+            stop=StopConditions(**d.get("stop", {})),
+            output=OutputOptions(**d.get("output", {})),
+            router=d.get("router", {}),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            annotations=d.get("annotations", []),
+            request_id=d.get("request_id"),
+        )
+
+
+@dataclass
+class TokenLogProb:
+    token_id: int
+    logprob: float
+    top: dict[int, float] = field(default_factory=dict)
+
+
+@dataclass
+class LLMEngineOutput:
+    """One streamed chunk from a worker engine: newly generated tokens."""
+
+    token_ids: list[int] = field(default_factory=list)
+    finish_reason: str | None = None  # FinishReason value
+    logprobs: list[dict] | None = None
+    kv_transfer_params: dict[str, Any] | None = None
+    # usage accounting (cumulative, present on final chunk)
+    prompt_tokens: int | None = None
+    completion_tokens: int | None = None
+    # worker-reported metadata (e.g. cached_tokens for prefix-cache hits)
+    meta: dict[str, Any] = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        out: dict[str, Any] = {"token_ids": self.token_ids}
+        if self.finish_reason is not None:
+            out["finish_reason"] = self.finish_reason
+        if self.logprobs is not None:
+            out["logprobs"] = self.logprobs
+        if self.kv_transfer_params is not None:
+            out["kv_transfer_params"] = self.kv_transfer_params
+        if self.prompt_tokens is not None:
+            out["prompt_tokens"] = self.prompt_tokens
+        if self.completion_tokens is not None:
+            out["completion_tokens"] = self.completion_tokens
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "LLMEngineOutput":
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=d.get("finish_reason"),
+            logprobs=d.get("logprobs"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            prompt_tokens=d.get("prompt_tokens"),
+            completion_tokens=d.get("completion_tokens"),
+            meta=d.get("meta", {}),
+        )

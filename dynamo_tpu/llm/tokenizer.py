@@ -1,0 +1,90 @@
+"""Tokenizer abstraction: HF tokenizers for real models, a dependency-free
+byte-level tokenizer for tests/mocker.
+
+Capability parity: reference `lib/llm/src/tokenizers.rs:576` (HF + GGUF
+tokenizer wrappers behind one trait). The byte tokenizer replaces the
+reference's reliance on downloaded test models — encode/decode are exact
+inverses over UTF-8, which incremental detokenization tests exploit.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class Tokenizer(Protocol):
+    eos_token_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...
+
+
+class ByteTokenizer:
+    """Tokens 0..255 are raw UTF-8 bytes; specials sit above.
+
+    Deterministic, zero-asset, and reversible — the workhorse of the test
+    suite and the mocker engine.
+    """
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+
+    def __init__(self) -> None:
+        self.eos_token_id = self.EOS
+        self.bos_token_id = self.BOS
+        self.pad_token_id = self.PAD
+        self.vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        parts = [f"<|{m['role']}|>{m.get('content') or ''}" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>")
+        return "\n".join(parts)
+
+
+class HFTokenizer:
+    """transformers.AutoTokenizer wrapper (local paths only — zero egress)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.eos_token_id = self._tok.eos_token_id
+        self.bos_token_id = getattr(self._tok, "bos_token_id", None)
+        self.pad_token_id = getattr(self._tok, "pad_token_id", None)
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            )
+        # Fallback template for models shipping without one.
+        parts = [f"<|{m['role']}|>\n{m.get('content') or ''}" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "\n".join(parts)
+
+
+def load_tokenizer(spec: str) -> Tokenizer:
+    """``"byte"`` → ByteTokenizer; anything else is a local HF path."""
+    if spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

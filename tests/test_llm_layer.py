@@ -1,0 +1,173 @@
+"""LLM layer: detokenizer/stop engine, preprocessor, model cards, discovery."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.detokenizer import Decoder, IncrementalDetokenizer, StopStringChecker
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, ChatMessage
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.runtime.store import StoreServer, StoreClient
+
+pytestmark = [pytest.mark.unit, pytest.mark.pre_merge]
+
+
+def test_incremental_detok_multibyte():
+    tok = ByteTokenizer()
+    text = "héllo ☃ wörld"
+    ids = tok.encode(text)
+    detok = IncrementalDetokenizer(tok)
+    out = "".join(detok.step(i) for i in ids)
+    assert out == text  # every byte boundary handled
+
+
+def test_stop_string_jail_across_chunks():
+    c = StopStringChecker(["</s>"])
+    emit1, hit1 = c.step("hello <")
+    assert (emit1, hit1) == ("hello ", False)  # '<' jailed
+    emit2, hit2 = c.step("/s")
+    assert (emit2, hit2) == ("", False)
+    emit3, hit3 = c.step("> trailing")
+    assert (emit3, hit3) == ("", True)  # stop hit, nothing after emitted
+
+
+def test_stop_string_false_alarm_released():
+    c = StopStringChecker(["STOP"])
+    assert c.step("abcST") == ("abc", False)
+    assert c.step("xyz") == ("STxyz", False)  # jail released on mismatch
+
+
+def test_decoder_stop_token_hidden():
+    tok = ByteTokenizer()
+    d = Decoder(tok, stop_token_ids=[65])  # 'A'
+    s = d.step(ord("h"))
+    assert s.text == "h" and s.finish_reason is None
+    s = d.step(65)
+    assert s.text == "" and s.finish_reason == "stop"
+
+
+def test_decoder_eos_and_max_tokens():
+    tok = ByteTokenizer()
+    d = Decoder(tok, max_tokens=3)
+    assert d.step(ord("a")).finish_reason is None
+    assert d.step(tok.eos_token_id).finish_reason == "eos"
+
+    d2 = Decoder(tok, max_tokens=2)
+    assert d2.step(ord("a")).finish_reason is None
+    assert d2.step(ord("b")).finish_reason == "length"
+
+
+def test_decoder_min_tokens_suppresses_eos():
+    tok = ByteTokenizer()
+    d = Decoder(tok, min_tokens=2, max_tokens=10)
+    assert d.step(tok.eos_token_id).finish_reason is None  # too early
+    assert d.step(tok.eos_token_id).finish_reason is None  # still == min
+    assert d.step(tok.eos_token_id).finish_reason == "eos"
+
+
+def test_preprocess_chat_and_budget():
+    mdc = ModelDeploymentCard(name="m", tokenizer="byte", context_length=100)
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        max_tokens=5000,
+        temperature=0.5,
+        stop="END",
+    )
+    p = pre.preprocess_chat(req)
+    assert p.token_ids, "prompt must tokenize"
+    assert p.sampling.temperature == 0.5
+    assert p.stop.stop == ["END"]
+    assert p.stop.max_tokens == 100 - len(p.token_ids)  # clamped to context
+
+
+async def _collect(gen):
+    return [x async for x in gen]
+
+
+def test_postprocess_chat_stream():
+    mdc = ModelDeploymentCard(name="m", tokenizer="byte", context_length=1000)
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="hi")], max_tokens=50
+    )
+    p = pre.preprocess_chat(req)
+
+    async def engine():
+        tok = ByteTokenizer()
+        yield LLMEngineOutput(token_ids=tok.encode("hel"))
+        yield LLMEngineOutput(token_ids=tok.encode("lo"))
+        yield LLMEngineOutput(token_ids=[tok.eos_token_id], finish_reason="eos")
+
+    chunks = asyncio.run(
+        _collect(pre.postprocess_chat_stream(p, engine(), include_usage=True))
+    )
+    text = "".join(c.choices[0].delta.content or "" for c in chunks)
+    assert text == "hello"
+    assert chunks[0].choices[0].delta.role == "assistant"
+    assert chunks[-1].choices[0].finish_reason == "stop"
+    assert chunks[-1].usage.completion_tokens == 6
+
+
+def test_mdc_roundtrip_and_checksum():
+    mdc = ModelDeploymentCard(
+        name="llama", context_length=4096, kv_block_size=16,
+        runtime_config=ModelRuntimeConfig(total_kv_blocks=1024),
+    )
+    again = ModelDeploymentCard.from_wire(mdc.to_wire())
+    assert again == mdc
+    assert again.checksum() == mdc.checksum()
+    mdc2 = ModelDeploymentCard(name="llama", context_length=8192)
+    assert mdc2.checksum() != mdc.checksum()
+
+
+@pytest.mark.integration
+async def test_model_discovery_flow():
+    from dynamo_tpu.llm.discovery import ModelWatcher, register_llm
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        front = await DistributedRuntime.create(server.address)
+        try:
+            added: list = []
+            removed: list = []
+            watcher = ModelWatcher(front.store)
+
+            async def on_add(entry, mdc):
+                added.append((entry.name, mdc.context_length))
+
+            async def on_rm(name):
+                removed.append(name)
+
+            watcher.on_model_added.append(on_add)
+            watcher.on_model_removed.append(on_rm)
+            await watcher.start()
+
+            ep = worker.namespace("ns").component("backend").endpoint("generate")
+
+            async def handler(req, ctx):
+                yield {}
+
+            await ep.serve(handler)
+            await register_llm(ep, ModelDeploymentCard(name="tiny", context_length=2048))
+
+            for _ in range(100):
+                if added:
+                    break
+                await asyncio.sleep(0.02)
+            assert added == [("tiny", 2048)]
+
+            await worker.shutdown()  # lease drops → model removed
+            for _ in range(100):
+                if removed:
+                    break
+                await asyncio.sleep(0.02)
+            assert removed == ["tiny"]
+        finally:
+            await watcher.stop()
+            await front.shutdown()
