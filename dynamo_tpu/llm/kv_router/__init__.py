@@ -1,0 +1,24 @@
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterConfig,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector, softmax_sample
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
+
+__all__ = [
+    "ActiveSequences",
+    "DefaultWorkerSelector",
+    "ForwardPassMetrics",
+    "KvCacheEvent",
+    "KvIndexer",
+    "KvPushRouter",
+    "KvRouter",
+    "RadixTree",
+    "RouterConfig",
+    "RouterEvent",
+    "softmax_sample",
+]

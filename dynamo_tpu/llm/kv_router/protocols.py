@@ -1,0 +1,113 @@
+"""KV-router wire types: cache events and worker load metrics.
+
+Workers publish :class:`RouterEvent` batches on the control-plane subject
+``kv_events.{namespace}.{component}`` as their paged caches store/evict
+blocks, and :class:`ForwardPassMetrics` on ``load_metrics.{...}``. Routers
+consume both to maintain the global prefix index and the load term of the
+scheduling cost.
+
+Capability parity: reference `lib/llm/src/kv_router/protocols.rs:32-85`
+(ForwardPassMetrics{WorkerStats,KvStats}) and the RouterEvent scheme of
+`kv_router/indexer.rs`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+import msgpack
+
+
+def kv_events_subject(namespace: str, component: str) -> str:
+    return f"kv_events.{namespace}.{component}"
+
+def load_metrics_subject(namespace: str, component: str) -> str:
+    return f"load_metrics.{namespace}.{component}"
+
+
+@dataclass(frozen=True)
+class KvCacheEvent:
+    """One store/remove on one worker's paged KV cache.
+
+    ``stored``: ``block_hashes`` are chained seq hashes appended under
+    ``parent_hash`` (None = sequence roots). ``removed``: hashes evicted.
+    """
+
+    op: str  # "stored" | "removed" | "cleared"
+    block_hashes: tuple[int, ...] = ()
+    parent_hash: int | None = None
+
+
+@dataclass(frozen=True)
+class RouterEvent:
+    worker_id: int
+    event_id: int  # per-worker monotonic
+    event: KvCacheEvent
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(
+            {
+                "w": self.worker_id,
+                "i": self.event_id,
+                "op": self.event.op,
+                "h": list(self.event.block_hashes),
+                "p": self.event.parent_hash,
+            }
+        )
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "RouterEvent":
+        d = msgpack.unpackb(raw, raw=False)
+        return cls(
+            worker_id=d["w"],
+            event_id=d["i"],
+            event=KvCacheEvent(op=d["op"], block_hashes=tuple(d["h"]), parent_hash=d["p"]),
+        )
+
+
+@dataclass
+class KvStats:
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    gpu_cache_usage_perc: float = 0.0  # name kept for dashboard parity; TPU HBM usage
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+@dataclass
+class WorkerStats:
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+
+
+@dataclass
+class ForwardPassMetrics:
+    worker_id: int = 0
+    worker: WorkerStats = field(default_factory=WorkerStats)
+    kv: KvStats = field(default_factory=KvStats)
+    spec_decode: dict[str, Any] | None = None
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(asdict(self))
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "ForwardPassMetrics":
+        d = msgpack.unpackb(raw, raw=False)
+        return cls(
+            worker_id=d["worker_id"],
+            worker=WorkerStats(**d["worker"]),
+            kv=KvStats(**d["kv"]),
+            spec_decode=d.get("spec_decode"),
+        )
+
+
+@dataclass
+class RouterConfig:
+    """Scheduling knobs (parity: KvRouterConfig in reference args)."""
+
+    overlap_weight: float = 1.0      # reward for cached prefix blocks
+    temperature: float = 0.0         # 0 = deterministic argmin of cost
+    use_kv_events: bool = True       # False → ApproxKvIndexer
+    replica_sync: bool = False
+    block_size: int = 32

@@ -1,0 +1,135 @@
+"""Worker-side publishers: KV cache events and load metrics.
+
+The engine (real or mock) calls ``stored``/``removed`` as its paged cache
+mutates; events batch onto the control-plane subject consumed by
+:class:`~dynamo_tpu.llm.kv_router.indexer.KvIndexer`. Metrics publish on a
+fixed cadence for the router's load term and the planner.
+
+Capability parity: reference `lib/llm/src/kv_router/publisher.rs:100-482`
+(KvEventPublisher, WorkerMetricsPublisher). The reference listens to the
+engine over ZMQ because vLLM is a foreign process; our JAX engine is
+in-process, so publishing is a direct call — one IPC hop gone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+    kv_events_subject,
+    load_metrics_subject,
+)
+
+log = logging.getLogger("dynamo_tpu.kv_router.publisher")
+
+
+class KvEventPublisher:
+    def __init__(self, store, namespace: str, component: str, worker_id: int):
+        self._store = store
+        self._subject = kv_events_subject(namespace, component)
+        self.worker_id = worker_id
+        self._event_id = 0
+
+    async def _publish(self, event: KvCacheEvent) -> None:
+        self._event_id += 1
+        router_event = RouterEvent(self.worker_id, self._event_id, event)
+        try:
+            await self._store.publish(self._subject, router_event.to_wire())
+        except ConnectionError:
+            log.warning("kv event publish failed (store down?)")
+
+    async def stored(self, block_hashes: list[int], parent_hash: int | None) -> None:
+        if block_hashes:
+            await self._publish(
+                KvCacheEvent(op="stored", block_hashes=tuple(block_hashes), parent_hash=parent_hash)
+            )
+
+    async def removed(self, block_hashes: list[int]) -> None:
+        if block_hashes:
+            await self._publish(KvCacheEvent(op="removed", block_hashes=tuple(block_hashes)))
+
+    async def cleared(self) -> None:
+        await self._publish(KvCacheEvent(op="cleared"))
+
+
+class WorkerMetricsPublisher:
+    def __init__(
+        self,
+        store,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        collect: Callable[[], ForwardPassMetrics],
+        interval_s: float = 1.0,
+    ):
+        self._store = store
+        self._subject = load_metrics_subject(namespace, component)
+        self.worker_id = worker_id
+        self._collect = collect
+        self._interval = interval_s
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def publish_now(self) -> None:
+        metrics = self._collect()
+        metrics.worker_id = self.worker_id
+        try:
+            await self._store.publish(self._subject, metrics.to_wire())
+        except ConnectionError:
+            pass
+
+    async def _loop(self) -> None:
+        while True:
+            await self.publish_now()
+            await asyncio.sleep(self._interval)
+
+
+class MetricsAggregator:
+    """Router/planner-side: latest ForwardPassMetrics per worker.
+
+    Parity: reference `kv_router/metrics_aggregator.rs` + `scoring.rs:93`
+    (ProcessedEndpoints).
+    """
+
+    def __init__(self, store, namespace: str, component: str):
+        self._store = store
+        self._subject = load_metrics_subject(namespace, component)
+        self.latest: dict[int, ForwardPassMetrics] = {}
+        self._task: asyncio.Task | None = None
+        self._sub = None
+        self.on_update: list[Callable[[ForwardPassMetrics], None]] = []
+
+    async def start(self) -> None:
+        self._sub = await self._store.subscribe(self._subject)
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.unsubscribe()
+
+    async def _loop(self) -> None:
+        assert self._sub is not None
+        async for ev in self._sub:
+            try:
+                metrics = ForwardPassMetrics.from_wire(ev["p"])
+                self.latest[metrics.worker_id] = metrics
+                for cb in self.on_update:
+                    cb(metrics)
+            except Exception:  # noqa: BLE001
+                log.exception("bad metrics payload")
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.latest.pop(worker_id, None)

@@ -1,0 +1,141 @@
+"""The KV router: prefix-overlap-aware request dispatch.
+
+``KvRouter`` owns the index (event-driven or approximate), the active
+sequence bookkeeping, and the selector. ``KvPushRouter`` binds it to an
+endpoint client: every request is hashed into blocks, scored, dispatched
+``direct`` to the chosen worker, and its bookkeeping freed when the stream
+ends — including the worker-death path, which also drops the dead worker
+from the index.
+
+Capability parity: reference `lib/llm/src/kv_router.rs:158` (KvRouter),
+`:342` (KvPushRouter); per-request overrides `:79`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer, KvIndexer
+from dynamo_tpu.llm.kv_router.protocols import RouterConfig, kv_events_subject
+from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector, SelectionResult
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
+from dynamo_tpu.runtime.component import EndpointClient
+from dynamo_tpu.tokens import compute_seq_hashes
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouter:
+    def __init__(
+        self,
+        store,
+        namespace: str,
+        component: str,
+        config: RouterConfig | None = None,
+    ):
+        self.config = config or RouterConfig()
+        self.active = ActiveSequences(block_size=self.config.block_size)
+        self.selector = DefaultWorkerSelector()
+        if self.config.use_kv_events:
+            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(
+                store, kv_events_subject(namespace, component)
+            )
+        else:
+            self.indexer = ApproxKvIndexer()
+
+    async def start(self) -> None:
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.start()
+
+    async def stop(self) -> None:
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.stop()
+
+    def find_best_match(
+        self,
+        request_id: str,
+        token_ids: list[int],
+        workers: list[int],
+        config_override: RouterConfig | None = None,
+    ) -> SelectionResult:
+        config = config_override or self.config
+        seq_hashes = compute_seq_hashes(token_ids, self.config.block_size)
+        overlaps = self.indexer.find_matches(seq_hashes)
+        result = self.selector.select_worker(
+            workers, overlaps, len(token_ids), self.active, config
+        )
+        self.active.add_request(
+            request_id, result.worker_id, len(token_ids), result.overlap_blocks
+        )
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.process_routing_decision(result.worker_id, seq_hashes)
+        return result
+
+    def mark_prefill_done(self, request_id: str) -> None:
+        self.active.mark_prefill_done(request_id)
+
+    def free(self, request_id: str) -> None:
+        self.active.free(request_id)
+
+    def remove_worker(self, worker_id: int) -> list[str]:
+        self.indexer.remove_worker(worker_id)
+        return self.active.remove_worker(worker_id)
+
+
+class KvPushRouter:
+    """EndpointClient + KvRouter glued into one `generate` surface."""
+
+    def __init__(self, client: EndpointClient, router: KvRouter):
+        self.client = client
+        self.router = router
+        client.on_instance_removed.append(self._on_worker_gone)
+
+    def _on_worker_gone(self, worker_id: int) -> None:
+        orphans = self.router.remove_worker(worker_id)
+        if orphans:
+            log.info("worker %d died with %d in-flight requests", worker_id, len(orphans))
+
+    async def generate(
+        self,
+        payload: dict,
+        request_id: str,
+        token_ids: list[int],
+        headers: dict[str, str] | None = None,
+        router_overrides: dict[str, Any] | None = None,
+    ) -> AsyncIterator[Any]:
+        overrides = router_overrides or {}
+        workers = self.client.instance_ids()
+        pinned = overrides.get("backend_instance_id")
+        if pinned is not None:
+            selection = SelectionResult(
+                worker_id=pinned, overlap_blocks=0, required_prefill_tokens=len(token_ids), costs={}
+            )
+            self.router.active.add_request(request_id, pinned, len(token_ids), 0)
+        else:
+            config = self.router.config
+            if "overlap_weight" in overrides or "router_temperature" in overrides:
+                config = RouterConfig(
+                    overlap_weight=overrides.get("overlap_weight", config.overlap_weight),
+                    temperature=overrides.get("router_temperature", config.temperature),
+                    use_kv_events=config.use_kv_events,
+                    block_size=config.block_size,
+                )
+            selection = self.router.find_best_match(request_id, token_ids, workers, config)
+        payload = dict(payload)
+        payload.setdefault("meta", {})["overlap_blocks"] = selection.overlap_blocks
+
+        stream = await self.client.direct(selection.worker_id, payload, headers)
+        first = True
+        try:
+            async for item in stream:
+                if first:
+                    first = False
+                    self.router.mark_prefill_done(request_id)
+                yield item
+        finally:
+            self.router.free(request_id)
+
+    @property
+    def worker_ids(self) -> list[int]:
+        return self.client.instance_ids()

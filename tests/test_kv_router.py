@@ -1,0 +1,168 @@
+"""KV router: radix indexer, cost scheduler, active sequences, event flow."""
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    ActiveSequences,
+    DefaultWorkerSelector,
+    KvCacheEvent,
+    KvIndexer,
+    RadixTree,
+    RouterConfig,
+    RouterEvent,
+    softmax_sample,
+)
+from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer
+from dynamo_tpu.llm.kv_router.protocols import kv_events_subject
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.runtime.store import StoreClient, StoreServer
+from dynamo_tpu.tokens import compute_seq_hashes
+
+pytestmark = [pytest.mark.unit, pytest.mark.pre_merge]
+
+
+def stored(worker, event_id, hashes, parent=None):
+    return RouterEvent(worker, event_id, KvCacheEvent("stored", tuple(hashes), parent))
+
+
+def removed(worker, event_id, hashes):
+    return RouterEvent(worker, event_id, KvCacheEvent("removed", tuple(hashes)))
+
+
+def test_radix_matches_contiguous_prefix():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(128)), 32)  # 4 blocks
+    t.apply_event(stored(1, 1, h[:3]))
+    t.apply_event(stored(2, 1, h[:1]))
+    scores = t.find_matches(h)
+    assert scores == {1: 3, 2: 1}
+
+
+def test_radix_removed_blocks_shrink_overlap():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(96)), 32)
+    t.apply_event(stored(1, 1, h))
+    t.apply_event(removed(1, 2, h[2:]))
+    assert t.find_matches(h) == {1: 2}
+    assert t.num_blocks(1) == 2
+
+
+def test_radix_worker_removal_and_prune():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(64)), 32)
+    t.apply_event(stored(1, 1, h))
+    t.apply_event(stored(2, 1, h[:1]))
+    t.remove_worker(1)
+    assert t.find_matches(h) == {2: 1}
+    assert t.num_blocks() == 1  # second block pruned entirely
+
+
+def test_radix_duplicate_event_ignored():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(32)), 32)
+    t.apply_event(stored(1, 5, h))
+    t.apply_event(removed(1, 5, h))  # same event id → replay, dropped
+    assert t.find_matches(h) == {1: 1}
+
+
+def test_radix_divergent_suffixes():
+    t = RadixTree()
+    a = compute_seq_hashes([1] * 64, 32)
+    b = compute_seq_hashes([1] * 32 + [2] * 32, 32)
+    assert a[0] == b[0]
+    t.apply_event(stored(1, 1, a))
+    t.apply_event(stored(2, 1, b))
+    assert t.find_matches(a) == {1: 2, 2: 1}
+    assert t.find_matches(b) == {2: 2, 1: 1}
+
+
+def test_softmax_sample_temperature_zero_is_argmin():
+    costs = {10: 5.0, 20: 1.0, 30: 9.0}
+    assert softmax_sample(costs, 0.0) == 20
+
+
+def test_softmax_sample_prefers_low_cost():
+    import random
+
+    rng = random.Random(0)
+    costs = {1: 0.0, 2: 100.0}
+    picks = [softmax_sample(costs, 0.5, rng) for _ in range(200)]
+    assert picks.count(1) > 150
+
+
+def test_selector_prefers_overlap_and_low_load():
+    active = ActiveSequences(block_size=32)
+    sel = DefaultWorkerSelector()
+    cfg = RouterConfig(overlap_weight=1.0, temperature=0.0, block_size=32)
+    # Worker 1 has 3 of 4 blocks cached; both idle → pick 1.
+    r = sel.select_worker([1, 2], {1: 3}, 128, active, cfg)
+    assert r.worker_id == 1
+    assert r.overlap_blocks == 3
+    assert r.required_prefill_tokens == 128 - 96
+    # Now pile load on worker 1; worker 2 (no overlap, idle) should win.
+    for i in range(50):
+        active.add_request(f"r{i}", 1, 1024, 0)
+    r2 = sel.select_worker([1, 2], {1: 3}, 128, active, cfg)
+    assert r2.worker_id == 2
+
+
+def test_active_sequences_lifecycle():
+    a = ActiveSequences(block_size=32)
+    a.add_request("r1", 7, prompt_tokens=100, overlap_blocks=2)
+    assert a.prefill_tokens(7) == 100 - 64
+    assert a.decode_blocks(7) == 4  # ceil(100/32)
+    a.mark_prefill_done("r1")
+    assert a.prefill_tokens(7) == 0
+    a.add_decode_block("r1")
+    assert a.decode_blocks(7) == 5
+    a.free("r1")
+    assert a.decode_blocks(7) == 0
+    assert a.active_requests() == 0
+
+
+def test_active_sequences_worker_death_orphans():
+    a = ActiveSequences()
+    a.add_request("r1", 1, 10, 0)
+    a.add_request("r2", 1, 10, 0)
+    a.add_request("r3", 2, 10, 0)
+    orphans = a.remove_worker(1)
+    assert sorted(orphans) == ["r1", "r2"]
+    assert a.active_requests() == 1
+
+
+def test_approx_indexer_ttl():
+    idx = ApproxKvIndexer(ttl_s=1000.0)
+    h = compute_seq_hashes(list(range(64)), 32)
+    idx.process_routing_decision(5, h)
+    assert idx.find_matches(h) == {5: 2}
+    idx.remove_worker(5)
+    assert idx.find_matches(h) == {}
+
+
+@pytest.mark.integration
+async def test_event_publisher_to_indexer_roundtrip():
+    """Worker publishes KV events → router's indexer sees the overlap
+    (parity: bindings publisher→indexer round-trip test)."""
+    import asyncio
+
+    async with StoreServer() as server:
+        async with await StoreClient.open(server.address) as worker_store:
+            async with await StoreClient.open(server.address) as router_store:
+                indexer = KvIndexer(router_store, kv_events_subject("ns", "backend"))
+                await indexer.start()
+                pub = KvEventPublisher(worker_store, "ns", "backend", worker_id=42)
+                h = compute_seq_hashes(list(range(96)), 32)
+                await pub.stored(h[:1], parent_hash=None)
+                await pub.stored(h[1:], parent_hash=h[0])
+                for _ in range(100):
+                    if indexer.find_matches(h).get(42) == 3:
+                        break
+                    await asyncio.sleep(0.01)
+                assert indexer.find_matches(h) == {42: 3}
+                await pub.removed(h[1:])
+                for _ in range(100):
+                    if indexer.find_matches(h).get(42) == 1:
+                        break
+                    await asyncio.sleep(0.01)
+                assert indexer.find_matches(h) == {42: 1}
+                await indexer.stop()
