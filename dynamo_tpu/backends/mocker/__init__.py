@@ -1,0 +1,3 @@
+from dynamo_tpu.backends.mocker.main import run_mocker
+
+__all__ = ["run_mocker"]

@@ -1,0 +1,3 @@
+from dynamo_tpu.backends.mocker.main import main
+
+main()

@@ -1,0 +1,121 @@
+"""Mocker backend worker: a fake TPU engine wired into the full runtime.
+
+``python -m dynamo_tpu.backends.mocker --model-name mock -- ...`` starts a
+process that looks exactly like a real worker to every other component:
+registers the model, serves the generate endpoint, emits KV events and load
+metrics. Router/disagg/planner e2e tests and benchmarks run against fleets
+of these.
+
+Capability parity: reference `components/backends/mocker/main.py:23-76` +
+the Rust mocker engine it drives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.worker import dynamo_worker
+
+log = logging.getLogger("dynamo_tpu.backends.mocker")
+
+
+async def run_mocker(
+    runtime: DistributedRuntime,
+    model_name: str = "mock-model",
+    namespace: str = "dynamo",
+    component: str = "backend",
+    engine_args: MockEngineArgs | None = None,
+    context_length: int = 16384,
+    served_event: asyncio.Event | None = None,
+) -> None:
+    args = engine_args or MockEngineArgs()
+    engine = MockTpuEngine(args)
+    worker_id = runtime.primary_lease_id
+
+    kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
+
+    def on_stored(hashes: list[int], parent: int | None) -> None:
+        asyncio.get_running_loop().create_task(kv_pub.stored(hashes, parent))
+
+    def on_removed(hashes: list[int]) -> None:
+        asyncio.get_running_loop().create_task(kv_pub.removed(hashes))
+
+    engine.kv.on_stored = on_stored
+    engine.kv.on_removed = on_removed
+
+    metrics_pub = WorkerMetricsPublisher(
+        runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
+    )
+    await metrics_pub.start()
+
+    endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
+
+    async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        async for out in engine.generate(request, context):
+            yield out
+
+    await endpoint.serve(handler)
+    await register_llm(
+        endpoint,
+        ModelDeploymentCard(
+            name=model_name,
+            tokenizer="byte",
+            model_type="chat",
+            context_length=context_length,
+            kv_block_size=args.block_size,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=args.num_kv_blocks,
+                max_num_seqs=args.max_num_seqs,
+                max_num_batched_tokens=args.max_num_batched_tokens,
+            ),
+        ),
+    )
+    log.info("mocker worker %d serving model %r", worker_id, model_name)
+    if served_event is not None:
+        served_event.set()
+    await runtime.wait_for_shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu mocker worker")
+    ap.add_argument("--model-name", default="mock-model")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--num-kv-blocks", type=int, default=8192)
+    ap.add_argument("--block-size", type=int, default=32)
+    ap.add_argument("--max-num-seqs", type=int, default=256)
+    ap.add_argument("--speedup-ratio", type=float, default=1.0)
+    ap.add_argument("--context-length", type=int, default=16384)
+    args = ap.parse_args()
+
+    engine_args = MockEngineArgs(
+        num_kv_blocks=args.num_kv_blocks,
+        block_size=args.block_size,
+        max_num_seqs=args.max_num_seqs,
+        speedup_ratio=args.speedup_ratio,
+    )
+
+    @dynamo_worker()
+    async def entry(runtime: DistributedRuntime) -> None:
+        await run_mocker(
+            runtime,
+            model_name=args.model_name,
+            namespace=args.namespace,
+            component=args.component,
+            engine_args=engine_args,
+            context_length=args.context_length,
+        )
+
+    entry()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.llm.mocker.kv_manager import MockKvManager
+
+__all__ = ["MockEngineArgs", "MockKvManager", "MockTpuEngine"]

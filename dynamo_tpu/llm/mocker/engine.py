@@ -1,0 +1,260 @@
+"""The mock TPU engine: a timing-faithful fake worker.
+
+Simulates a paged-attention continuous-batching engine — watermark
+admission, chunked prefill, prefix-cache reuse, per-iteration cost model,
+LRU eviction — while emitting *real* KV events and load metrics. It is the
+linchpin of cluster-free testing (SURVEY.md §4): router, disaggregation,
+migration, and planner e2e tests all run against fleets of these.
+
+Capability parity: reference `lib/llm/src/mocker/engine.rs:60`
+(MockVllmEngine), `scheduler.rs:54` (watermark/chunked-prefill
+SchedulerState), `protocols.rs:79` (MockEngineArgs, speedup_ratio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError, MockKvManager
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
+
+log = logging.getLogger("dynamo_tpu.mocker")
+
+
+@dataclass
+class MockEngineArgs:
+    num_kv_blocks: int = 8192
+    block_size: int = 32
+    max_num_seqs: int = 256
+    max_num_batched_tokens: int = 8192
+    watermark: float = 0.01
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    speedup_ratio: float = 1.0
+    # Cost model (pre-speedup): iteration = base + prefill_tokens*prefill
+    #                            + decoding_seqs*decode
+    base_iter_us: float = 500.0
+    prefill_us_per_token: float = 10.0
+    decode_us_per_seq: float = 100.0
+
+
+@dataclass
+class _Seq:
+    request_id: str
+    prompt: list[int]
+    max_tokens: int
+    out: asyncio.Queue
+    seq: TokenBlockSequence
+    prompt_hashes: list[int]
+    cached_blocks: int = 0
+    pinned: list[int] = field(default_factory=list)
+    partials_held: int = 0
+    prefilled: int = 0
+    generated: int = 0
+    cancelled: bool = False
+    ignore_eos: bool = True
+    eos_token_id: int | None = None
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.prefilled >= len(self.prompt)
+
+
+class MockTpuEngine:
+    """AsyncEngine over PreprocessedRequest wire dicts."""
+
+    _FINISHED = object()
+
+    def __init__(self, args: MockEngineArgs | None = None, kv_manager: MockKvManager | None = None):
+        self.args = args or MockEngineArgs()
+        self.kv = kv_manager or MockKvManager(
+            num_blocks=self.args.num_kv_blocks,
+            block_size=self.args.block_size,
+            enable_prefix_caching=self.args.enable_prefix_caching,
+        )
+        self._waiting: list[_Seq] = []
+        self._running: list[_Seq] = []
+        self._wakeup = asyncio.Event()
+        self._loop_task: asyncio.Task | None = None
+        self._iterations = 0
+
+    # -- public engine surface --------------------------------------------
+
+    async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
+        """Handler-compatible: wire dict in, wire dicts out."""
+        pre = PreprocessedRequest.from_wire(request)
+        max_tokens = pre.stop.max_tokens or 16
+        seq = _Seq(
+            request_id=pre.request_id or context.id,
+            prompt=list(pre.token_ids),
+            max_tokens=max_tokens,
+            out=asyncio.Queue(),
+            seq=TokenBlockSequence(pre.token_ids, self.args.block_size),
+            prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
+            ignore_eos=pre.stop.ignore_eos,
+        )
+        self._waiting.append(seq)
+        self._ensure_loop()
+        self._wakeup.set()
+        try:
+            while True:
+                item = await seq.out.get()
+                if item is self._FINISHED:
+                    return
+                yield item
+                if context.is_stopped:
+                    seq.cancelled = True
+                    return
+        finally:
+            seq.cancelled = True
+
+    def metrics(self) -> ForwardPassMetrics:
+        return ForwardPassMetrics(
+            worker=WorkerStats(
+                request_active_slots=len(self._running),
+                request_total_slots=self.args.max_num_seqs,
+                num_requests_waiting=len(self._waiting),
+            ),
+            kv=KvStats(
+                kv_active_blocks=self.kv.used_blocks,
+                kv_total_blocks=self.kv.capacity,
+                gpu_cache_usage_perc=self.kv.usage_perc,
+                gpu_prefix_cache_hit_rate=(
+                    self.kv.stats.prefix_hits / self.kv.stats.prefix_queries
+                    if self.kv.stats.prefix_queries
+                    else 0.0
+                ),
+            ),
+        )
+
+    # -- simulation loop ---------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._sim_loop())
+
+    async def _sim_loop(self) -> None:
+        while True:
+            if not self._waiting and not self._running:
+                self._wakeup.clear()
+                await self._wakeup.wait()
+            self._admit()
+            prefill_tokens, decode_seqs = self._step()
+            iter_time_s = (
+                self.args.base_iter_us
+                + prefill_tokens * self.args.prefill_us_per_token
+                + decode_seqs * self.args.decode_us_per_seq
+            ) / 1e6 / self.args.speedup_ratio
+            self._iterations += 1
+            await asyncio.sleep(iter_time_s)
+
+    def _admit(self) -> None:
+        watermark_blocks = self.args.watermark * self.kv.capacity
+        while self._waiting and len(self._running) < self.args.max_num_seqs:
+            seq = self._waiting[0]
+            if seq.cancelled:
+                self._waiting.pop(0)
+                self._finish(seq, emit=False)
+                continue
+            cached = self.kv.acquire_cached(seq.prompt_hashes)
+            to_commit = len(seq.prompt_hashes) - cached
+            trailing = 1 if len(seq.prompt) % self.args.block_size else 0
+            need = to_commit + trailing
+            if self.kv.free_blocks - need < watermark_blocks and self._running:
+                # Not enough headroom; un-pin and retry next iteration.
+                self.kv.release(seq.prompt_hashes[:cached])
+                return
+            try:
+                self.kv.allocate_partial(need) if need else None
+            except InsufficientBlocksError:
+                self.kv.release(seq.prompt_hashes[:cached])
+                return
+            self._waiting.pop(0)
+            seq.cached_blocks = cached
+            seq.pinned = list(seq.prompt_hashes[:cached])
+            seq.partials_held = need
+            seq.prefilled = cached * self.args.block_size
+            self._running.append(seq)
+
+    def _step(self) -> tuple[int, int]:
+        """One engine iteration; returns (prefill tokens, decoding seqs)."""
+        budget = self.args.max_num_batched_tokens
+        prefill_tokens = 0
+        decode_seqs = 0
+        finished: list[_Seq] = []
+
+        for seq in self._running:
+            if seq.cancelled:
+                finished.append(seq)
+                continue
+            if not seq.prefill_done:
+                if not self.args.enable_chunked_prefill and prefill_tokens:
+                    continue  # one prefill at a time without chunking
+                chunk = min(len(seq.prompt) - seq.prefilled, budget - prefill_tokens)
+                if chunk <= 0:
+                    continue
+                start_block = seq.prefilled // self.args.block_size
+                seq.prefilled += chunk
+                prefill_tokens += chunk
+                end_block = seq.prefilled // self.args.block_size
+                for i in range(max(start_block, seq.cached_blocks), end_block):
+                    h = seq.prompt_hashes[i]
+                    parent = seq.prompt_hashes[i - 1] if i else None
+                    self.kv.commit_block(h, parent)
+                    seq.partials_held -= 1
+                    seq.pinned.append(h)
+                continue
+
+            # Decode: one token per iteration.
+            decode_seqs += 1
+            token = 97 + (seq.generated % 26)  # 'a'..'z' — ByteTokenizer text
+            if len(self.seq_tail(seq)) == 0:
+                # Starting a fresh block mid-decode needs a new partial.
+                try:
+                    self.kv.allocate_partial(1)
+                    seq.partials_held += 1
+                except InsufficientBlocksError:
+                    decode_seqs -= 1
+                    continue  # stalled this iteration (preemption-lite)
+            completed = seq.seq.append(token)
+            if completed is not None:
+                self.kv.commit_block(completed.block_hash, completed.parent_hash)
+                seq.partials_held -= 1
+                seq.pinned.append(completed.block_hash)
+            seq.generated += 1
+            out = LLMEngineOutput(token_ids=[token])
+            if seq.generated == 1:
+                out.meta = {
+                    "cached_tokens": seq.cached_blocks * self.args.block_size,
+                    "iteration": self._iterations,
+                }
+            if seq.generated >= seq.max_tokens:
+                out.finish_reason = "length"
+                out.prompt_tokens = len(seq.prompt)
+                out.completion_tokens = seq.generated
+                seq.out.put_nowait(out.to_wire())
+                finished.append(seq)
+            else:
+                seq.out.put_nowait(out.to_wire())
+
+        for seq in finished:
+            self._running.remove(seq)
+            self._finish(seq, emit=True)
+        return prefill_tokens, decode_seqs
+
+    def seq_tail(self, seq: _Seq) -> list[int]:
+        return seq.seq.partial_tokens
+
+    def _finish(self, seq: _Seq, emit: bool) -> None:
+        self.kv.release(seq.pinned)
+        if seq.partials_held:
+            self.kv.release_partial(seq.partials_held)
+            seq.partials_held = 0
+        if emit:
+            seq.out.put_nowait(self._FINISHED)

@@ -1,0 +1,152 @@
+"""Mock engine: KV manager lifecycle, prefix caching, scheduling, events.
+
+Parity: reference mocker KV-manager lifecycle tests
+(`lib/llm/src/mocker/kv_manager.rs:309-355`).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockKvManager, MockTpuEngine
+from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import compute_seq_hashes
+
+pytestmark = [pytest.mark.unit, pytest.mark.pre_merge]
+
+FAST = MockEngineArgs(
+    num_kv_blocks=64,
+    block_size=4,
+    speedup_ratio=1000.0,
+)
+
+
+def make_request(tokens, max_tokens=8, request_id="r1"):
+    return PreprocessedRequest(
+        model="mock",
+        token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens),
+        request_id=request_id,
+    ).to_wire()
+
+
+# -- KV manager ---------------------------------------------------------------
+
+
+def test_kv_manager_commit_and_release_to_lru():
+    stored, removed = [], []
+    kv = MockKvManager(
+        num_blocks=4, block_size=4,
+        on_stored=lambda h, p: stored.extend(h),
+        on_removed=lambda h: removed.extend(h),
+    )
+    h = compute_seq_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    kv.allocate_partial(2)
+    kv.commit_block(h[0], None)
+    kv.commit_block(h[1], h[0])
+    assert stored == h
+    assert kv.match_prefix(h) == 2
+    kv.release(h)
+    # Released blocks stay cached (inactive LRU) — still matchable.
+    assert kv.match_prefix(h) == 2
+    assert removed == []
+
+
+def test_kv_manager_eviction_under_pressure():
+    removed = []
+    kv = MockKvManager(num_blocks=2, block_size=4, on_removed=lambda h: removed.extend(h))
+    h = compute_seq_hashes(list(range(8)), 4)
+    kv.allocate_partial(2)
+    kv.commit_block(h[0], None)
+    kv.commit_block(h[1], h[0])
+    kv.release(h)  # both inactive now
+    kv.allocate_partial(2)  # requires evicting both LRU blocks
+    assert removed == h
+    assert kv.match_prefix(h) == 0
+
+
+def test_kv_manager_insufficient_blocks():
+    kv = MockKvManager(num_blocks=2, block_size=4)
+    kv.allocate_partial(2)
+    with pytest.raises(InsufficientBlocksError):
+        kv.allocate_partial(1)
+
+
+def test_kv_manager_dedup_on_commit():
+    stored = []
+    kv = MockKvManager(num_blocks=8, block_size=4, on_stored=lambda h, p: stored.extend(h))
+    h = compute_seq_hashes([1, 2, 3, 4], 4)
+    kv.allocate_partial(1)
+    kv.commit_block(h[0], None)
+    kv.allocate_partial(1)
+    kv.commit_block(h[0], None)  # second seq, same content → dedup, no event
+    assert stored == [h[0]]
+    assert kv.used_blocks == 1
+
+
+# -- engine -------------------------------------------------------------------
+
+
+async def test_engine_generates_to_max_tokens():
+    engine = MockTpuEngine(FAST)
+    outs = [o async for o in engine.generate(make_request([1] * 10, max_tokens=6), Context())]
+    tokens = [t for o in outs for t in o["token_ids"]]
+    assert len(tokens) == 6
+    assert outs[-1]["finish_reason"] == "length"
+    assert outs[-1]["prompt_tokens"] == 10
+    assert outs[0]["meta"]["cached_tokens"] == 0
+
+
+async def test_engine_prefix_cache_hit_second_request():
+    engine = MockTpuEngine(FAST)
+    prompt = list(range(16))  # 4 full blocks
+    out1 = [o async for o in engine.generate(make_request(prompt, 2, "a"), Context())]
+    assert out1[0]["meta"]["cached_tokens"] == 0
+    out2 = [o async for o in engine.generate(make_request(prompt, 2, "b"), Context())]
+    assert out2[0]["meta"]["cached_tokens"] == 16  # all 4 blocks reused
+
+
+async def test_engine_concurrent_requests_and_metrics():
+    engine = MockTpuEngine(FAST)
+
+    async def one(i):
+        req = make_request([i] * 20, max_tokens=5, request_id=f"r{i}")
+        return [o async for o in engine.generate(req, Context())]
+
+    results = await asyncio.gather(*(one(i) for i in range(8)))
+    assert all(sum(len(o["token_ids"]) for o in r) == 5 for r in results)
+    m = engine.metrics()
+    assert m.worker.request_active_slots == 0
+    assert m.kv.kv_total_blocks == 64
+
+
+async def test_engine_emits_kv_events():
+    stored = []
+    engine = MockTpuEngine(FAST)
+    engine.kv.on_stored = lambda h, p: stored.extend(h)
+    prompt = list(range(12))  # 3 blocks
+    [o async for o in engine.generate(make_request(prompt, 5), Context())]
+    want = compute_seq_hashes(prompt, 4)
+    assert stored[: len(want)] == want  # prompt blocks stored in chain order
+    # decode added 12+5=17 tokens → 4 complete blocks total
+    assert len(stored) == 4
+
+
+async def test_engine_cancellation_frees_blocks():
+    engine = MockTpuEngine(FAST)
+    ctx = Context()
+    gen = engine.generate(make_request([1] * 40, max_tokens=1000), ctx)
+    got = 0
+    async for _ in gen:
+        got += 1
+        if got == 3:
+            ctx.stop_generating()
+    assert got < 1000
+    for _ in range(200):
+        if engine.kv.free_blocks == engine.kv.capacity:
+            break
+        await asyncio.sleep(0.01)
+    # All blocks released (inactive LRU still holds hashes but is reclaimable)
+    assert engine.kv.free_blocks == engine.kv.capacity
