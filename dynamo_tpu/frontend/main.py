@@ -1,0 +1,82 @@
+"""Frontend process: OpenAI HTTP server + model discovery + router.
+
+``python -m dynamo_tpu.frontend --http-port 8000 --router-mode kv``
+auto-discovers workers via the control plane and serves every registered
+model. Capability parity: reference
+`components/frontend/src/dynamo/frontend/main.py:1-120`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.kv_router.protocols import RouterConfig
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.worker import dynamo_worker
+
+
+async def run_frontend(
+    runtime: DistributedRuntime,
+    http_host: str = "0.0.0.0",
+    http_port: int = 8000,
+    router_mode: str = "kv",
+    router_config: RouterConfig | None = None,
+    ready_event: asyncio.Event | None = None,
+    service_out: list | None = None,
+) -> None:
+    manager = ModelManager(runtime, router_mode=router_mode, router_config=router_config)
+    await manager.start()
+    service = HttpService(manager, host=http_host, port=http_port)
+    await service.start()
+    if service_out is not None:
+        service_out.append(service)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        await runtime.wait_for_shutdown()
+    finally:
+        await service.stop()
+        await manager.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    ap.add_argument("--http-host", default="0.0.0.0")
+    ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument(
+        "--router-mode", choices=["kv", "round_robin", "random"], default="kv"
+    )
+    ap.add_argument("--kv-overlap-weight", type=float, default=1.0)
+    ap.add_argument("--router-temperature", type=float, default=0.0)
+    ap.add_argument(
+        "--kv-cache-block-size",
+        type=int,
+        default=None,
+        help="override the model card's KV block size (must match workers)",
+    )
+    args = ap.parse_args()
+
+    config = RouterConfig(
+        overlap_weight=args.kv_overlap_weight,
+        temperature=args.router_temperature,
+        block_size=args.kv_cache_block_size,
+    )
+
+    @dynamo_worker()
+    async def entry(runtime: DistributedRuntime) -> None:
+        await run_frontend(
+            runtime,
+            http_host=args.http_host,
+            http_port=args.http_port,
+            router_mode=args.router_mode,
+            router_config=config,
+        )
+
+    entry()
+
+
+if __name__ == "__main__":
+    main()

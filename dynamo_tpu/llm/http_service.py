@@ -1,0 +1,292 @@
+"""OpenAI-compatible HTTP frontend: aiohttp + SSE streaming + metrics.
+
+Routes: ``/v1/chat/completions``, ``/v1/completions``, ``/v1/models``,
+``/health``, ``/live``, ``/metrics``. Streaming responses are SSE
+(``data: {chunk}\\n\\n`` … ``data: [DONE]``); client disconnects cancel the
+request all the way down to the worker (the data plane forwards the kill).
+
+Frontend metrics (parity `lib/llm/src/http/service/metrics.rs:16,137-244`):
+``dynamo_frontend_requests_total``, ``dynamo_frontend_inflight_requests``,
+``dynamo_frontend_time_to_first_token_seconds``,
+``dynamo_frontend_inter_token_latency_seconds``,
+``dynamo_frontend_request_duration_seconds``.
+
+Capability parity: reference `lib/llm/src/http/service/service_v2.rs:316`
+(router build), `openai.rs` (handlers), `disconnect.rs`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from dynamo_tpu.llm.model_manager import ModelManager, ServedModel
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatChoice,
+    ChatMessage,
+    CompletionRequest,
+    ModelInfo,
+    ModelList,
+    Usage,
+    new_request_id,
+)
+from dynamo_tpu.runtime.logging_setup import TRACEPARENT_HEADER, child_traceparent
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+log = logging.getLogger("dynamo_tpu.http")
+
+_TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+_ITL_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or MetricsRegistry()
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/live", self.live)
+        self.app.router.add_get("/metrics", self.prometheus)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for addr in self._runner.addresses:  # resolve ephemeral port
+            self.port = addr[1]
+        log.info("OpenAI frontend on http://%s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _error(status: int, message: str, err_type: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": message, "type": err_type}}, status=status
+        )
+
+    @staticmethod
+    def _validate_sampling(body) -> str | None:
+        if body.max_tokens is not None and body.max_tokens < 1:
+            return "max_tokens must be at least 1"
+        mct = getattr(body, "max_completion_tokens", None)
+        if mct is not None and mct < 1:
+            return "max_completion_tokens must be at least 1"
+        if body.temperature is not None and body.temperature < 0:
+            return "temperature must be non-negative"
+        if body.top_p is not None and not (0.0 < body.top_p <= 1.0):
+            return "top_p must be in (0, 1]"
+        if body.n < 1:
+            return "n must be at least 1"
+        if body.n > 1:
+            return "n > 1 is not yet supported"
+        return None
+
+    def _lookup(self, model: str) -> ServedModel | None:
+        return self.manager.get(model)
+
+    def _headers_for(self, request: web.Request, request_id: str) -> dict[str, str]:
+        return {
+            TRACEPARENT_HEADER: child_traceparent(request.headers.get(TRACEPARENT_HEADER)),
+            "x-request-id": request_id,
+        }
+
+    # -- handlers ----------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        models = [s.entry.name for s in self.manager.list_models()]
+        return web.json_response({"status": "healthy" if models else "starting", "models": models})
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        out = ModelList(
+            data=[
+                ModelInfo(id=s.entry.name, max_model_len=s.mdc.context_length)
+                for s in self.manager.list_models()
+            ]
+        )
+        return web.json_response(out.model_dump())
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = ChatCompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return self._error(400, f"invalid request: {e}")
+        if msg := self._validate_sampling(body):
+            return self._error(400, msg)
+        served = self._lookup(body.model)
+        if served is None:
+            return self._error(404, f"model {body.model!r} not found", "model_not_found")
+
+        rid = new_request_id("chatcmpl")
+        m = self.metrics.scoped(service="frontend", model=body.model, endpoint="chat")
+        m.counter("frontend_requests_total").inc()
+        inflight = m.gauge("frontend_inflight_requests")
+        inflight.inc()
+        started = time.monotonic()
+        try:
+            pre = served.preprocessor.preprocess_chat(body)
+            pre.request_id = rid
+            engine_stream = served.generate(pre, self._headers_for(request, rid))
+            chunks = served.preprocessor.postprocess_chat_stream(
+                pre,
+                engine_stream,
+                request_id=rid,
+                include_usage=bool(body.stream_options and body.stream_options.include_usage)
+                or not body.stream,
+            )
+            if body.stream:
+                return await self._stream_sse(request, chunks, started, m)
+            return await self._aggregate_chat(rid, body, chunks, started)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — surface engine errors as 500s
+            log.exception("chat request %s failed", rid)
+            return self._error(500, str(e), "internal_error")
+        finally:
+            inflight.dec()
+            m.histogram("frontend_request_duration_seconds").observe(
+                time.monotonic() - started
+            )
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = CompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return self._error(400, f"invalid request: {e}")
+        if msg := self._validate_sampling(body):
+            return self._error(400, msg)
+        served = self._lookup(body.model)
+        if served is None:
+            return self._error(404, f"model {body.model!r} not found", "model_not_found")
+
+        rid = new_request_id("cmpl")
+        m = self.metrics.scoped(service="frontend", model=body.model, endpoint="completions")
+        m.counter("frontend_requests_total").inc()
+        inflight = m.gauge("frontend_inflight_requests")
+        inflight.inc()
+        started = time.monotonic()
+        try:
+            pre = served.preprocessor.preprocess_completion(body)
+            pre.request_id = rid
+            engine_stream = served.generate(pre, self._headers_for(request, rid))
+            responses = served.preprocessor.postprocess_completion(
+                pre, engine_stream, request_id=rid, stream=body.stream
+            )
+            if body.stream:
+                return await self._stream_sse(request, responses, started, m)
+            final = None
+            async for r in responses:
+                final = r
+            return web.json_response(final.model_dump())
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log.exception("completion request %s failed", rid)
+            return self._error(500, str(e), "internal_error")
+        finally:
+            inflight.dec()
+            m.histogram("frontend_request_duration_seconds").observe(
+                time.monotonic() - started
+            )
+
+    # -- response shaping --------------------------------------------------
+
+    async def _stream_sse(
+        self, request: web.Request, chunks, started: float, m
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        first = True
+        last_t = None
+        ttft_h = m.histogram("frontend_time_to_first_token_seconds", buckets=_TTFT_BUCKETS)
+        itl_h = m.histogram("frontend_inter_token_latency_seconds", buckets=_ITL_BUCKETS)
+        try:
+            async for chunk in chunks:
+                now = time.monotonic()
+                if first:
+                    ttft_h.observe(now - started)
+                    first = False
+                elif last_t is not None:
+                    itl_h.observe(now - last_t)
+                last_t = now
+                payload = json.dumps(chunk.model_dump(exclude_none=True))
+                await resp.write(f"data: {payload}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except asyncio.CancelledError:
+            raise
+        except ConnectionResetError:
+            pass  # client went away
+        except Exception as e:  # noqa: BLE001 — headers already sent; error in-band
+            log.exception("mid-stream failure")
+            err = json.dumps({"error": {"message": str(e), "type": "internal_error"}})
+            try:
+                await resp.write(f"data: {err}\n\n".encode())
+            except ConnectionResetError:
+                pass
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
+        return resp
+
+    async def _aggregate_chat(self, rid, body, chunks, started: float) -> web.Response:
+        text_parts: list[str] = []
+        finish = None
+        usage = None
+        created = int(time.time())
+        async for chunk in chunks:
+            for choice in chunk.choices:
+                if choice.delta.content:
+                    text_parts.append(choice.delta.content)
+                if choice.finish_reason:
+                    finish = choice.finish_reason
+            if chunk.usage:
+                usage = chunk.usage
+        out = ChatCompletionResponse(
+            id=rid,
+            created=created,
+            model=body.model,
+            choices=[
+                ChatChoice(
+                    message=ChatMessage(role="assistant", content="".join(text_parts)),
+                    finish_reason=finish or "stop",
+                )
+            ],
+            usage=usage or Usage(),
+        )
+        return web.json_response(out.model_dump(exclude_none=True))

@@ -110,4 +110,6 @@ class RouterConfig:
     temperature: float = 0.0         # 0 = deterministic argmin of cost
     use_kv_events: bool = True       # False → ApproxKvIndexer
     replica_sync: bool = False
-    block_size: int = 32
+    # None → inherit the model card's kv_block_size at model-add time.
+    # Must match the worker's KV block size or seq hashes never overlap.
+    block_size: int | None = None

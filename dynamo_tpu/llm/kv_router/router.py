@@ -20,7 +20,7 @@ from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer, KvIndexer
 from dynamo_tpu.llm.kv_router.protocols import RouterConfig, kv_events_subject
 from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector, SelectionResult
 from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
-from dynamo_tpu.runtime.component import EndpointClient
+from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
 from dynamo_tpu.tokens import compute_seq_hashes
 
 log = logging.getLogger("dynamo_tpu.kv_router")
@@ -35,6 +35,8 @@ class KvRouter:
         config: RouterConfig | None = None,
     ):
         self.config = config or RouterConfig()
+        if self.config.block_size is None:
+            self.config.block_size = 32
         self.active = ActiveSequences(block_size=self.config.block_size)
         self.selector = DefaultWorkerSelector()
         if self.config.use_kv_events:
@@ -106,6 +108,8 @@ class KvPushRouter:
     ) -> AsyncIterator[Any]:
         overrides = router_overrides or {}
         workers = self.client.instance_ids()
+        if not workers:
+            raise NoInstancesError(self.client.endpoint.path)
         pinned = overrides.get("backend_instance_id")
         if pinned is not None:
             selection = SelectionResult(

@@ -1,0 +1,107 @@
+"""Request migration: mid-stream worker-failure recovery by token replay.
+
+If the worker serving a stream dies, the accumulated output tokens are
+appended to the request's prompt and the request is re-issued to another
+worker — the client sees one uninterrupted stream. Token replay is
+engine-agnostic: with prefix caching the new worker re-prefills cheaply.
+Bounded by the model card's ``migration_limit``.
+
+Capability parity: reference `lib/llm/src/migration.rs:26,74-89`
+(RetryManager) + `docs/architecture/request_migration.md`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import replace
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.kv_router.router import KvPushRouter
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
+
+log = logging.getLogger("dynamo_tpu.migration")
+
+_RETRY_WAIT_S = 0.2
+
+
+class Migration:
+    def __init__(
+        self,
+        client: EndpointClient,
+        push_router: KvPushRouter | None,
+        mode: str = "kv",
+        limit: int = 3,
+    ):
+        self.client = client
+        self.push_router = push_router
+        self.mode = mode
+        self.limit = limit
+
+    async def _dispatch(
+        self, pre: PreprocessedRequest, headers: dict[str, str] | None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        payload = pre.to_wire()
+        if self.push_router is not None:
+            stream = self.push_router.generate(
+                payload,
+                request_id=pre.request_id or "anon",
+                token_ids=pre.token_ids,
+                headers=headers,
+                router_overrides=pre.router,
+            )
+            async for item in stream:
+                yield LLMEngineOutput.from_wire(item)
+        else:
+            pick = self.client.random if self.mode == "random" else self.client.round_robin
+            stream = await pick(payload, headers)
+            async for item in stream:
+                yield LLMEngineOutput.from_wire(item)
+
+    async def generate(
+        self, pre: PreprocessedRequest, headers: dict[str, str] | None = None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        attempts = 0
+        generated: list[int] = []
+        current = pre
+        while True:
+            try:
+                async for out in self._dispatch(current, headers):
+                    generated.extend(out.token_ids)
+                    yield out
+                    if out.finish_reason is not None:
+                        return
+                return
+            except (ConnectionError, NoInstancesError) as e:
+                attempts += 1
+                if attempts > self.limit:
+                    log.warning(
+                        "request %s exhausted %d migrations", pre.request_id, self.limit
+                    )
+                    raise
+                # Replay: generated tokens become prompt suffix; budget shrinks.
+                new_stop = replace(current.stop)
+                if new_stop.max_tokens is not None:
+                    remaining = (pre.stop.max_tokens or 0) - len(generated)
+                    if remaining <= 0:
+                        # Budget exhausted exactly at failure: close the
+                        # stream with an explicit length finish.
+                        yield LLMEngineOutput(
+                            token_ids=[],
+                            finish_reason="length",
+                            prompt_tokens=len(pre.token_ids),
+                            completion_tokens=len(generated),
+                        )
+                        return
+                    new_stop.max_tokens = remaining
+                current = replace(
+                    current,
+                    token_ids=list(pre.token_ids) + generated,
+                    stop=new_stop,
+                )
+                log.info(
+                    "migrating request %s (attempt %d/%d, %d tokens replayed): %s",
+                    pre.request_id, attempts, self.limit, len(generated), e,
+                )
+                await asyncio.sleep(_RETRY_WAIT_S)

@@ -243,7 +243,10 @@ class EndpointClient:
                 for cb in self.on_instance_added:
                     cb(inst)
             else:
-                self.instances.pop(instance_id, None)
+                if self.instances.pop(instance_id, None) is not None:
+                    log.info(
+                        "instance %d removed from %s", instance_id, self.endpoint.path
+                    )
                 for cb in self.on_instance_removed:
                     cb(instance_id)
             self._instances_changed.set()

@@ -1,0 +1,205 @@
+"""E2E: OpenAI frontend + mocker workers over the full runtime stack.
+
+HTTP → preprocess → KV router → data plane → mock engine → detok → SSE.
+Parity: reference `tests/router/test_router_e2e_with_mockers.py:24-80`
+(N mockers + real frontend + concurrent streaming requests, GPU-free).
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.backends.mocker import run_mocker
+from dynamo_tpu.frontend.main import run_frontend
+from dynamo_tpu.llm.mocker import MockEngineArgs
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+FAST_ARGS = MockEngineArgs(num_kv_blocks=2048, block_size=8, speedup_ratio=200.0)
+
+
+class Cluster:
+    """In-process cluster: store + frontend + N mocker workers."""
+
+    def __init__(self, num_workers: int = 2, router_mode: str = "kv"):
+        self.num_workers = num_workers
+        self.router_mode = router_mode
+        self.store = StoreServer()
+        self.runtimes: list[DistributedRuntime] = []
+        self.tasks: list[asyncio.Task] = []
+        self.base_url = ""
+
+    async def __aenter__(self) -> "Cluster":
+        await self.store.start()
+        for i in range(self.num_workers):
+            rt = await DistributedRuntime.create(self.store.address)
+            self.runtimes.append(rt)
+            served = asyncio.Event()
+            self.tasks.append(
+                asyncio.create_task(
+                    run_mocker(rt, model_name="mock", engine_args=FAST_ARGS, served_event=served)
+                )
+            )
+            await asyncio.wait_for(served.wait(), 10)
+        front_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(front_rt)
+        ready = asyncio.Event()
+        services: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_frontend(
+                    front_rt,
+                    http_host="127.0.0.1",
+                    http_port=0,
+                    router_mode=self.router_mode,
+                    ready_event=ready,
+                    service_out=services,
+                )
+            )
+        )
+        await asyncio.wait_for(ready.wait(), 10)
+        self.base_url = f"http://127.0.0.1:{services[0].port}"
+        # Frontend needs the model discovered before requests fly.
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):
+                async with s.get(f"{self.base_url}/v1/models") as r:
+                    data = await r.json()
+                    if data["data"]:
+                        return self
+                await asyncio.sleep(0.05)
+        raise TimeoutError("model never appeared on frontend")
+
+    async def __aexit__(self, *exc) -> None:
+        for rt in self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.1)
+        for t in self.tasks:
+            t.cancel()
+        for rt in self.runtimes:
+            try:
+                await rt.shutdown()
+            except Exception:
+                pass
+        await self.store.stop()
+
+
+async def _chat(session, base_url, content, stream=False, max_tokens=8, extra=None):
+    body = {
+        "model": "mock",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }
+    if extra:
+        body.update(extra)
+    async with session.post(f"{base_url}/v1/chat/completions", json=body) as resp:
+        if stream:
+            text = ""
+            chunks = 0
+            async for line in resp.content:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                chunks += 1
+                for c in chunk["choices"]:
+                    text += c["delta"].get("content") or ""
+            return resp.status, text, chunks
+        return resp.status, await resp.json(), 0
+
+
+async def test_single_request_roundtrip():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            status, body, _ = await _chat(s, cluster.base_url, "hello", max_tokens=6)
+            assert status == 200
+            msg = body["choices"][0]["message"]
+            assert msg["role"] == "assistant"
+            assert msg["content"] == "abcdef"  # mocker emits a,b,c,...
+            assert body["choices"][0]["finish_reason"] == "length"
+            assert body["usage"]["completion_tokens"] == 6
+
+
+async def test_streaming_sse():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            status, text, chunks = await _chat(
+                s, cluster.base_url, "stream me", stream=True, max_tokens=10
+            )
+            assert status == 200
+            assert text == "abcdefghij"
+            assert chunks >= 10  # role chunk + per-token deltas + finish
+
+
+async def test_concurrent_streaming_requests_kv_routed():
+    """100 concurrent streams across 2 mockers with KV routing."""
+    async with Cluster(num_workers=2, router_mode="kv") as cluster:
+        async with aiohttp.ClientSession() as s:
+            async def one(i):
+                # Shared prefix families exercise the radix index.
+                prompt = f"family-{i % 4} " * 20 + f"tail-{i}"
+                return await _chat(s, cluster.base_url, prompt, stream=True, max_tokens=5)
+
+            results = await asyncio.gather(*(one(i) for i in range(100)))
+            assert all(status == 200 for status, _, _ in results)
+            assert all(text == "abcde" for _, text, _ in results)
+
+
+async def test_unknown_model_404():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{cluster.base_url}/v1/chat/completions",
+                json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            ) as resp:
+                assert resp.status == 404
+
+
+async def test_invalid_sampling_params_rejected():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            for bad in (
+                {"max_tokens": -5},
+                {"max_tokens": 0},
+                {"temperature": -1.0},
+                {"top_p": 0.0},
+                {"n": 0},
+            ):
+                async with s.post(
+                    f"{cluster.base_url}/v1/chat/completions",
+                    json={
+                        "model": "mock",
+                        "messages": [{"role": "user", "content": "x"}],
+                        **bad,
+                    },
+                ) as resp:
+                    assert resp.status == 400, bad
+
+
+async def test_completions_endpoint():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{cluster.base_url}/v1/completions",
+                json={"model": "mock", "prompt": "complete this", "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["text"] == "abcd"
+
+
+async def test_metrics_endpoint_exposes_frontend_series():
+    async with Cluster(num_workers=1) as cluster:
+        async with aiohttp.ClientSession() as s:
+            await _chat(s, cluster.base_url, "hi", stream=True, max_tokens=3)
+            async with s.get(f"{cluster.base_url}/metrics") as resp:
+                text = await resp.text()
+                assert "dynamo_frontend_requests_total" in text
+                assert "dynamo_frontend_time_to_first_token_seconds" in text
