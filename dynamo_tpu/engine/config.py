@@ -1,0 +1,152 @@
+"""Model and engine configuration for the native JAX TPU engine.
+
+The reference delegates model execution to vLLM/SGLang/TRT-LLM
+(`components/backends/*`); here the engine is first-party, so its
+configuration lives in the framework. Shapes are chosen TPU-first: head
+dims and block sizes aligned to MXU/VPU lanes (128 / 8), bfloat16 compute,
+static bucketed shapes so every (bucket, batch) pair compiles exactly once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family decoder-only transformer hyperparameters."""
+
+    name: str = "llama"
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    # Byte-level models (test tokenizer) tie embeddings to save params.
+    tie_embeddings: bool = False
+
+    @property
+    def jax_dtype(self):
+        return _DTYPES[self.dtype]
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def param_bytes(self) -> int:
+        """Approximate parameter footprint at the configured dtype."""
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = (
+            h * (self.q_size + 2 * self.kv_size)  # wq, wk, wv
+            + self.q_size * h                     # wo
+            + 3 * h * i                           # gate, up, down
+            + 2 * h                               # norms
+        )
+        total = v * h + self.num_layers * per_layer + h + (0 if self.tie_embeddings else h * v)
+        bytes_per = jnp.dtype(self.jax_dtype).itemsize
+        return total * bytes_per
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine shape/capacity knobs (static under jit).
+
+    Capability parity: the knobs vLLM exposes through the reference's
+    backend shims (`components/backends/vllm/src/dynamo/vllm/args.py`):
+    block size, KV blocks, max seqs, max batched tokens — plus TPU-specific
+    prefill length buckets (XLA compiles one program per bucket).
+    """
+
+    num_kv_blocks: int = 2048
+    block_size: int = 32
+    max_num_seqs: int = 64           # decode batch width (static)
+    max_model_len: int = 8192
+    prefill_buckets: tuple[int, ...] = (128, 512, 2048, 8192)
+    enable_prefix_caching: bool = True
+    # Decode batch buckets: compile decode at these widths only.
+    decode_buckets: tuple[int, ...] = (8, 16, 32, 64)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    @property
+    def total_slots(self) -> int:
+        # One extra garbage block at index `num_kv_blocks` absorbs writes
+        # from padded positions, keeping every jitted shape static.
+        return (self.num_kv_blocks + 1) * self.block_size
+
+    @property
+    def garbage_block(self) -> int:
+        return self.num_kv_blocks
+
+
+# -- presets ---------------------------------------------------------------
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(name="llama3-8b")
+
+
+def llama3_70b() -> ModelConfig:
+    return ModelConfig(
+        name="llama3-70b",
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+    )
+
+
+def tiny_model(vocab_size: int = 384) -> ModelConfig:
+    """Byte-tokenizer-sized model for tests and CPU smoke runs."""
+    return ModelConfig(
+        name="tiny",
+        vocab_size=vocab_size,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        dtype="float32",
+        tie_embeddings=True,
+    )
+
+
+def tiny_engine(**overrides) -> EngineConfig:
+    defaults = dict(
+        num_kv_blocks=64,
+        block_size=8,
+        max_num_seqs=8,
+        max_model_len=256,
+        prefill_buckets=(32, 64, 128, 256),
+        decode_buckets=(4, 8),
+    )
+    defaults.update(overrides)
+    return EngineConfig(**defaults)
+
+
+PRESETS = {
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "tiny": tiny_model,
+}

@@ -1,0 +1,251 @@
+"""Functional llama-family transformer with a paged KV cache.
+
+Pure functions over a params pytree — no flax Module state — so `jit`,
+`shard_map`, and donation compose cleanly. Layers are *stacked* (every
+weight carries a leading ``num_layers`` axis) and the forward pass is a
+`lax.scan` over them: compile time is O(1) in depth, which matters at 80
+layers (llama3-70b).
+
+Two entry points, both static-shaped:
+
+- :func:`prefill_step` — one sequence padded to a length bucket. Computes
+  plain causal self-attention (the sequence is self-contained), scatters
+  K/V into the paged cache via the block table, returns next-token logits.
+- :func:`decode_step` — a batch of sequences, one new token each. Scatters
+  the new K/V, then paged attention over each sequence's block table.
+
+Cache layout: head-major ``[num_layers, n_kv, total_slots, head_dim]``
+where ``slot = block * block_size + offset``; the last block is a garbage
+block absorbing padded-position writes (config.py). Head-major keeps
+per-head page DMAs on untiled leading axes (TPU tiles the last two dims)
+and puts the tensor-parallel shard axis first. The reference delegates all
+of this to vLLM's CUDA paged attention; on TPU it is first-party
+(SURVEY.md §7 stage 6).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+
+Params = dict[str, Any]
+
+
+# -- initialization --------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random init (serving benchmarks + tests; real weights via loader)."""
+    h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    dt = cfg.jax_dtype
+    keys = jax.random.split(rng, 8)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dt)
+
+    params: Params = {
+        "embed": dense(keys[0], (v, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dt),
+            "mlp_norm": jnp.ones((L, h), dt),
+            "wq": dense(keys[1], (L, h, cfg.q_size), h),
+            "wk": dense(keys[2], (L, h, cfg.kv_size), h),
+            "wv": dense(keys[3], (L, h, cfg.kv_size), h),
+            "wo": dense(keys[4], (L, cfg.q_size, h), cfg.q_size),
+            "w_gate": dense(keys[5], (L, h, i), h),
+            "w_up": dense(keys[6], (L, h, i), h),
+            "w_down": dense(keys[7], (L, i, h), i),
+        },
+        "final_norm": jnp.ones((h,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(jax.random.fold_in(rng, 99), (h, v), h)
+    return params
+
+
+def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple[jax.Array, jax.Array]:
+    """(k_cache, v_cache), each [L, n_kv, total_slots, head_dim]."""
+    dtype = dtype or cfg.jax_dtype
+    shape = (cfg.num_layers, cfg.num_kv_heads, engine.total_slots, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# -- building blocks -------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [..., T, n, d], positions: [..., T]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, d/2]
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(x, lp):
+    gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    return jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+
+
+def _slot_for(block_tables: jax.Array, positions: jax.Array, block_size: int) -> jax.Array:
+    """Flat cache slot for each position, via its sequence's block table.
+
+    block_tables: [..., max_blocks]; positions: [...] or [..., T].
+    """
+    blk = positions // block_size
+    off = positions % block_size
+    page = jnp.take_along_axis(
+        block_tables, blk.reshape(block_tables.shape[0], -1), axis=-1
+    ).reshape(blk.shape) if block_tables.ndim == 2 else block_tables[blk]
+    return page * block_size + off
+
+
+# -- prefill ---------------------------------------------------------------
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "engine", "kv_span"), donate_argnums=(2, 3)
+)
+def prefill_step(
+    params: Params,
+    tokens: jax.Array,       # [T] int32, padded to a bucket
+    k_cache: jax.Array,      # [L, n_kv, total_slots, d] (donated)
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [max_blocks_per_seq] int32
+    seq_len: jax.Array,      # scalar int32: valid tokens in `tokens`
+    start_pos: jax.Array,    # scalar int32: absolute position of tokens[0]
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    kv_span: int | None = None,  # static: KV positions attended, >= start_pos+seq_len
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (last-token logits [vocab], k_cache, v_cache).
+
+    ``start_pos`` > 0 resumes a sequence whose first blocks are already
+    cached (prefix-cache hit or chunked prefill): positions/RoPE/slots all
+    shift, and attention additionally covers the cached prefix via the
+    paged cache (earlier chunks were written there).
+
+    ``kv_span`` bounds attention cost to the sequence's reachable range —
+    callers round ``start_pos + seq_len`` up to a bucket so short prompts
+    don't pay O(max_model_len) attention. Defaults to the full table.
+    """
+    T = tokens.shape[0]
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)
+    x = params["embed"][tokens]  # [T, h]
+
+    slots = _slot_for(block_table, positions, engine.block_size)  # [T]
+    # Padded tail writes land in the garbage block.
+    slots = jnp.where(jnp.arange(T) < seq_len, slots, engine.total_slots - 1)
+
+    # Attention over the paged cache covers positions [0, start_pos + T):
+    # earlier chunks already live there; this chunk is written before reading.
+    if kv_span is None:
+        kv_span = engine.max_blocks_per_seq * engine.block_size
+    if kv_span % engine.block_size:
+        raise ValueError(f"kv_span {kv_span} not a multiple of block_size")
+    causal = positions[:, None] >= jnp.arange(kv_span, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(kv_span, dtype=jnp.int32)[None, :] < (start_pos + seq_len)
+    mask = causal & valid  # [T, kv_span]
+    scale = cfg.head_dim ** -0.5
+
+    page_offsets = jnp.arange(engine.block_size, dtype=jnp.int32)
+    span_table = block_table[: kv_span // engine.block_size]
+    page_slots = (span_table[:, None] * engine.block_size + page_offsets[None, :]).reshape(-1)
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
+        k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+        v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+
+        k_l = k_l.at[:, slots].set(k.transpose(1, 0, 2))
+        v_l = v_l.at[:, slots].set(v.transpose(1, 0, 2))
+
+        kk = k_l[:, page_slots]  # [n_kv, kv_span, d]
+        vv = v_l[:, page_slots]
+        group = cfg.num_heads // cfg.num_kv_heads
+        qg = q.reshape(T, cfg.num_kv_heads, group, cfg.head_dim).astype(jnp.float32)
+        logits = jnp.einsum("thgd,hsd->thgs", qg, kk.astype(jnp.float32)) * scale
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("thgs,hsd->thgd", w, vv.astype(jnp.float32))
+        attn = attn.reshape(T, cfg.q_size).astype(x.dtype)
+        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    return _logits(last, params, cfg), k_cache, v_cache
+
+
+# -- decode ----------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg", "engine"), donate_argnums=(2, 3))
+def decode_step(
+    params: Params,
+    tokens: jax.Array,        # [B] int32 — the just-sampled token per seq
+    k_cache: jax.Array,       # donated
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks_per_seq] int32
+    positions: jax.Array,     # [B] int32 — position of `tokens` (0-based)
+    active: jax.Array,        # [B] bool — padding lanes write to garbage
+    cfg: ModelConfig,
+    engine: EngineConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (logits [B, vocab] f32, k_cache, v_cache)."""
+    from dynamo_tpu.ops.paged_attention import paged_attention
+
+    B = tokens.shape[0]
+    x = params["embed"][tokens]  # [B, h]
+    slots = _slot_for(block_tables, positions, engine.block_size)  # [B]
+    slots = jnp.where(active, slots, engine.total_slots - 1)
+    seq_lens = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = rope(q.reshape(B, 1, cfg.num_heads, cfg.head_dim), positions[:, None], cfg.rope_theta)[:, 0]
+        k = rope(k.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim), positions[:, None], cfg.rope_theta)[:, 0]
+        v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
+
+        k_l = k_l.at[:, slots].set(k.transpose(1, 0, 2))
+        v_l = v_l.at[:, slots].set(v.transpose(1, 0, 2))
+
+        attn = paged_attention(
+            q, k_l, v_l, block_tables, seq_lens, block_size=engine.block_size
+        )  # [B, n_q, d]
+        attn = attn.reshape(B, cfg.q_size)
+        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _logits(x, params, cfg), k_cache, v_cache

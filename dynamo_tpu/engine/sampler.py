@@ -1,0 +1,48 @@
+"""Jittable batched token sampling: greedy / temperature / top-k / top-p.
+
+Per-request sampling params arrive as arrays (one lane per sequence), so a
+single compiled program serves any mix of greedy and sampled requests —
+no per-request recompiles, no host round trip per token.
+
+Capability parity: the sampling options the reference extracts in its
+preprocessor (`lib/llm/src/protocols/common`, SamplingOptionsProvider) and
+hands to vLLM; here the sampler is part of the first-party engine.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jax.Array,        # [B, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,   # [B] float32; 0 => greedy
+    top_k: jax.Array,         # [B] int32; <= 0 => disabled
+    top_p: jax.Array,         # [B] float32; >= 1 => disabled
+) -> jax.Array:               # [B] int32
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Sort once (descending); both top-k and top-p become rank masks.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)  # rank of each vocab entry
+
+    k = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep_k = ranks < k
+
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # Keep every rank whose *previous* cumulative mass is < top_p (always
+    # keeps rank 0), matching standard nucleus sampling.
+    cum_prev = cum - probs_sorted
+    keep_p_sorted = cum_prev < jnp.where(top_p >= 1.0, 2.0, top_p)[:, None]
+    keep_p = jnp.take_along_axis(keep_p_sorted, ranks, axis=-1)
+
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

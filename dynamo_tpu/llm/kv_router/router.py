@@ -13,6 +13,7 @@ Capability parity: reference `lib/llm/src/kv_router.rs:158` (KvRouter),
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Any, AsyncIterator
 
@@ -34,9 +35,11 @@ class KvRouter:
         component: str,
         config: RouterConfig | None = None,
     ):
-        self.config = config or RouterConfig()
-        if self.config.block_size is None:
-            self.config.block_size = 32
+        config = config or RouterConfig()
+        if config.block_size is None:
+            # Default on a copy — never mutate the caller's config object.
+            config = dataclasses.replace(config, block_size=32)
+        self.config = config
         self.active = ActiveSequences(block_size=self.config.block_size)
         self.selector = DefaultWorkerSelector()
         if self.config.use_kv_events:
@@ -105,9 +108,14 @@ class KvPushRouter:
         token_ids: list[int],
         headers: dict[str, str] | None = None,
         router_overrides: dict[str, Any] | None = None,
+        exclude: set[int] | None = None,
     ) -> AsyncIterator[Any]:
         overrides = router_overrides or {}
         workers = self.client.instance_ids()
+        if exclude:
+            # Migration retries must not re-dial a worker that just failed —
+            # its cached prefix makes it the router's top pick otherwise.
+            workers = [w for w in workers if w not in exclude] or workers
         if not workers:
             raise NoInstancesError(self.client.endpoint.path)
         pinned = overrides.get("backend_instance_id")
@@ -129,14 +137,18 @@ class KvPushRouter:
         payload = dict(payload)
         payload.setdefault("meta", {})["overlap_blocks"] = selection.overlap_blocks
 
-        stream = await self.client.direct(selection.worker_id, payload, headers)
         first = True
         try:
+            stream = await self.client.direct(selection.worker_id, payload, headers)
             async for item in stream:
                 if first:
                     first = False
                     self.router.mark_prefill_done(request_id)
                 yield item
+        except (ConnectionError, NoInstancesError) as e:
+            # Tag the failure with the worker so migration can exclude it.
+            e.worker_id = selection.worker_id  # type: ignore[attr-defined]
+            raise
         finally:
             self.router.free(request_id)
 

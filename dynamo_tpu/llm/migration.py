@@ -40,7 +40,10 @@ class Migration:
         self.limit = limit
 
     async def _dispatch(
-        self, pre: PreprocessedRequest, headers: dict[str, str] | None
+        self,
+        pre: PreprocessedRequest,
+        headers: dict[str, str] | None,
+        exclude: set[int],
     ) -> AsyncIterator[LLMEngineOutput]:
         payload = pre.to_wire()
         if self.push_router is not None:
@@ -50,24 +53,30 @@ class Migration:
                 token_ids=pre.token_ids,
                 headers=headers,
                 router_overrides=pre.router,
+                exclude=exclude,
             )
             async for item in stream:
                 yield LLMEngineOutput.from_wire(item)
         else:
-            pick = self.client.random if self.mode == "random" else self.client.round_robin
-            stream = await pick(payload, headers)
-            async for item in stream:
-                yield LLMEngineOutput.from_wire(item)
+            worker_id = self.client.pick_instance(self.mode, exclude)
+            try:
+                stream = await self.client.direct(worker_id, payload, headers)
+                async for item in stream:
+                    yield LLMEngineOutput.from_wire(item)
+            except (ConnectionError, NoInstancesError) as e:
+                e.worker_id = worker_id  # type: ignore[attr-defined]
+                raise
 
     async def generate(
         self, pre: PreprocessedRequest, headers: dict[str, str] | None = None
     ) -> AsyncIterator[LLMEngineOutput]:
         attempts = 0
         generated: list[int] = []
+        failed_workers: set[int] = set()
         current = pre
         while True:
             try:
-                async for out in self._dispatch(current, headers):
+                async for out in self._dispatch(current, headers, failed_workers):
                     generated.extend(out.token_ids)
                     yield out
                     if out.finish_reason is not None:
@@ -75,6 +84,9 @@ class Migration:
                 return
             except (ConnectionError, NoInstancesError) as e:
                 attempts += 1
+                failed = getattr(e, "worker_id", None)
+                if failed is not None:
+                    failed_workers.add(failed)
                 if attempts > self.limit:
                     log.warning(
                         "request %s exhausted %d migrations", pre.request_id, self.limit

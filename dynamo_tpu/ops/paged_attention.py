@@ -1,0 +1,219 @@
+"""Paged attention for the decode path: one new query token per sequence
+attends over that sequence's KV blocks scattered through the paged cache.
+
+Two implementations with identical semantics:
+
+- :func:`paged_attention_reference` — pure jnp gather + masked softmax.
+  Runs anywhere (CPU test mesh included) and is the ground truth.
+- :func:`paged_attention_pallas` — Pallas TPU kernel. Grid over the batch;
+  per sequence it walks the block table, DMAs each KV page HBM→VMEM, and
+  folds it into an online-softmax accumulator (flash-attention style), so
+  the full [S] attention row never materializes and HBM traffic is exactly
+  the live pages.
+
+The reference framework outsources this op to vLLM's CUDA kernels; on TPU
+we own it (SURVEY.md §7 "hard parts"). Cache layout is head-major flat
+``[n_kv, total_slots, d]`` with ``slot = block * block_size + offset``:
+per-head page DMAs then slice only the untiled leading axes (TPU tiling
+constrains the last two dims), and tensor parallelism shards axis 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def paged_attention_reference(
+    q: jax.Array,            # [B, n_q, d]
+    k_cache: jax.Array,      # [n_kv, total_slots, d]
+    v_cache: jax.Array,      # [n_kv, total_slots, d]
+    block_tables: jax.Array, # [B, max_blocks] int32 (padding -> garbage block)
+    seq_lens: jax.Array,     # [B] int32, number of valid tokens incl. current
+    *,
+    block_size: int,
+    scale: float | None = None,
+) -> jax.Array:              # [B, n_q, d]
+    B, n_q, d = q.shape
+    n_kv = k_cache.shape[0]
+    group = n_q // n_kv
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * block_size
+    scale = scale if scale is not None else d ** -0.5
+
+    offsets = jnp.arange(block_size, dtype=jnp.int32)
+    slots = (block_tables[:, :, None] * block_size + offsets[None, None, :]).reshape(B, S)
+    k = k_cache[:, slots]  # [n_kv, B, S, d]
+    v = v_cache[:, slots]
+
+    qg = q.reshape(B, n_kv, group, d).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bhgd,hbsd->bhgs", qg, kf) * scale
+    mask = jnp.arange(S, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, S]
+    logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgs,hbsd->bhgd", weights, v.astype(jnp.float32))
+    return out.reshape(B, n_q, d).astype(q.dtype)
+
+
+def _paged_attn_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_blocks] SMEM
+    seq_lens_ref,      # [B] SMEM
+    # inputs
+    q_ref,             # [1, 1, group, d] VMEM (this sequence, this kv head)
+    k_hbm,             # [n_kv, total_slots, d] ANY/HBM
+    v_hbm,
+    # output
+    o_ref,             # [1, 1, group, d] VMEM
+    # scratch
+    k_page,            # [2, block_size, d] VMEM double buffer
+    v_page,
+    sem,               # DMA sems [2, 2]
+    *,
+    block_size: int,
+    scale: float,
+):
+    # One grid instance = one (sequence, kv head): all matmuls are plain 2D
+    # (Mosaic's tpu.matmul does not support mismatched batch dims).
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    num_blocks = jax.lax.div(seq_len + block_size - 1, block_size)
+    group, d = q_ref.shape[2], q_ref.shape[3]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, d]
+
+    def page_dma(slot, blk_idx):
+        page = block_tables_ref[b, blk_idx]
+        start = page * block_size
+        kd = pltpu.make_async_copy(
+            k_hbm.at[h, pl.ds(start, block_size)], k_page.at[slot], sem.at[slot, 0]
+        )
+        vd = pltpu.make_async_copy(
+            v_hbm.at[h, pl.ds(start, block_size)], v_page.at[slot], sem.at[slot, 1]
+        )
+        return kd, vd
+
+    # Warm up the pipeline with the first page.
+    @pl.when(num_blocks > 0)
+    def _():
+        kd, vd = page_dma(0, 0)
+        kd.start()
+        vd.start()
+
+    def body(i, carry):
+        m, l, acc = carry  # [group, 1], [group, 1], [group, d]
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < num_blocks)
+        def _():
+            kd, vd = page_dma(1 - slot, i + 1)
+            kd.start()
+            vd.start()
+
+        kd, vd = page_dma(slot, i)
+        kd.wait()
+        vd.wait()
+
+        k = k_page[slot].astype(jnp.float32)   # [bs, d]
+        v = v_page[slot].astype(jnp.float32)
+        # s[g, t] = q[g, :] . k[t, :]
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, bs]
+        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                            # [group, bs]
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, d]
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, 1), jnp.float32)
+    acc0 = jnp.zeros((group, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    *,
+    block_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    B, n_q, d = q.shape
+    max_blocks = block_tables.shape[1]
+    n_kv = k_cache.shape[0]
+    scale = scale if scale is not None else d ** -0.5
+
+    group = n_q // n_kv
+    qg = q.reshape(B, n_kv, group, d)
+
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        block_size=block_size,
+        scale=scale,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_kv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, d), k_cache.dtype),
+            pltpu.VMEM((2, block_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(B, n_q, d)
+
+
+def paged_attention(
+    q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None
+) -> jax.Array:
+    """Dispatch to the Pallas kernel on TPU, the reference elsewhere."""
+    if jax.default_backend() == "tpu":
+        return paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, seq_lens,
+            block_size=block_size, scale=scale,
+        )
+    return paged_attention_reference(
+        q, k_cache, v_cache, block_tables, seq_lens,
+        block_size=block_size, scale=scale,
+    )

@@ -265,19 +265,32 @@ class EndpointClient:
 
     # -- routing -----------------------------------------------------------
 
-    def _pick_round_robin(self) -> Instance:
+    def _eligible(self, exclude: set[int] | None) -> list[int]:
         ids = self.instance_ids()
+        if exclude:
+            filtered = [i for i in ids if i not in exclude]
+            # All excluded (e.g. every worker failed once): retry the full
+            # set rather than dead-ending — instances may have recovered.
+            ids = filtered or ids
         if not ids:
             raise NoInstancesError(self.endpoint.path)
+        return ids
+
+    def _pick_round_robin(self, exclude: set[int] | None = None) -> Instance:
+        ids = self._eligible(exclude)
         inst = self.instances[ids[self._rr_counter % len(ids)]]
         self._rr_counter += 1
         return inst
 
-    def _pick_random(self) -> Instance:
-        ids = self.instance_ids()
-        if not ids:
-            raise NoInstancesError(self.endpoint.path)
+    def _pick_random(self, exclude: set[int] | None = None) -> Instance:
+        ids = self._eligible(exclude)
         return self.instances[_random.choice(ids)]
+
+    def pick_instance(self, mode: str = "round_robin", exclude: set[int] | None = None) -> int:
+        """Choose a live instance id without dispatching (migration uses
+        this to know which worker a later stream failure belongs to)."""
+        picker = self._pick_random if mode == "random" else self._pick_round_robin
+        return picker(exclude).instance_id
 
     async def direct(
         self, instance_id: int, payload: Any, headers: dict[str, str] | None = None

@@ -10,12 +10,21 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points at real TPU hardware
+# (tests are deterministic and cluster-free; bench.py uses the real chip).
+# The TPU PJRT plugin ignores the JAX_PLATFORMS env var, so the config
+# update below — which does win — is the load-bearing line; the env vars
+# cover subprocesses.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
