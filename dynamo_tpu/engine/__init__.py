@@ -1,0 +1,35 @@
+"""The native JAX/Pallas TPU serving engine (SURVEY.md §7 stage 6).
+
+The reference wraps external GPU engines (vLLM/SGLang/TRT-LLM); here the
+engine is first-party: functional llama models, paged KV cache with a
+Pallas decode kernel, continuous batching over bucketed static shapes,
+fused sampling, prefix caching sharing the framework-wide block hashes.
+"""
+
+from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError
+from dynamo_tpu.engine.config import (
+    EngineConfig,
+    ModelConfig,
+    PRESETS,
+    llama3_8b,
+    llama3_70b,
+    tiny_engine,
+    tiny_model,
+)
+from dynamo_tpu.engine.core import EngineCore, Sequence
+from dynamo_tpu.engine.engine import TpuEngine
+
+__all__ = [
+    "DeviceBlockAllocator",
+    "EngineConfig",
+    "EngineCore",
+    "ModelConfig",
+    "OutOfBlocksError",
+    "PRESETS",
+    "Sequence",
+    "TpuEngine",
+    "llama3_8b",
+    "llama3_70b",
+    "tiny_engine",
+    "tiny_model",
+]

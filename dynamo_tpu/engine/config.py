@@ -138,7 +138,7 @@ def tiny_engine(**overrides) -> EngineConfig:
         block_size=8,
         max_num_seqs=8,
         max_model_len=256,
-        prefill_buckets=(32, 64, 128, 256),
+        prefill_buckets=(32, 64, 128),  # < max_model_len: exercises chunking
         decode_buckets=(4, 8),
     )
     defaults.update(overrides)

@@ -1,0 +1,488 @@
+"""EngineCore: synchronous continuous-batching scheduler over jitted steps.
+
+The TPU-native analogue of vLLM's engine loop, which the reference only
+wraps (`components/backends/vllm`); here it is first-party. One `step()`
+is one engine iteration: drain new requests, admit under a free-block
+watermark, then either run one prefill chunk (prefill-priority, like
+vLLM's default scheduler) or one batched decode+sample for every running
+sequence. All device programs are static-shaped — prompt lengths snap to
+prefill buckets, decode width to decode buckets — so XLA compiles a small
+fixed set of programs and every later call replays them.
+
+Design notes:
+- Sampling is fused into the decode program (one dispatch, one [B] int
+  transfer back per token) with per-lane PRNG derived from (seed, counter)
+  inside jit — seeded requests reproduce regardless of batch neighbors.
+- Blocks are committed to the allocator exactly when their K/V has been
+  written on device, so the KV events this engine emits describe cache
+  reality (parity: reference worker KV events, kv_router/publisher.rs).
+- Preemption = release everything + token-replay re-prefill (the same
+  trick request migration uses across workers, migration.rs).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model import (
+    decode_step_impl,
+    init_cache,
+    init_params,
+    prefill_step_impl,
+)
+from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+@dataclass
+class Sequence:
+    request_id: str
+    prompt: list[int]
+    sampling: SamplingOptions
+    stop: StopConditions
+    seed: int
+    # -- device-cache bookkeeping --
+    prompt_hashes: list[int] = field(default_factory=list)
+    block_ids: list[int] = field(default_factory=list)
+    hashed: TokenBlockSequence | None = None   # tokens whose K/V is written
+    pinned_hashes: list[int] = field(default_factory=list)
+    committed_blocks: int = 0                  # prefix of block_ids committed
+    num_cached_tokens: int = 0
+    # -- progress --
+    prefilled: int = 0      # prompt tokens with K/V written
+    processed: int = 0      # all tokens with K/V written
+    pending: int | None = None  # sampled, not yet processed
+    generated: int = 0
+    finish: str | None = None
+    cancelled: bool = False
+    emitted_first: bool = False
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.prefilled >= self.prompt_len
+
+
+def _sample_from_logits(logits, seeds, counters, temperature, top_k, top_p):
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
+    )(seeds, counters)
+    return sample(logits, keys, temperature, top_k, top_p)
+
+
+def _decode_and_sample(
+    params, k_cache, v_cache, tokens, block_tables, positions, active,
+    seeds, counters, temperature, top_k, top_p, *, cfg, engine,
+):
+    logits, k_cache, v_cache = decode_step_impl(
+        params, tokens, k_cache, v_cache, block_tables, positions, active, cfg, engine
+    )
+    toks = _sample_from_logits(logits, seeds, counters, temperature, top_k, top_p)
+    return toks, k_cache, v_cache
+
+
+class EngineCore:
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        params: Any = None,
+        seed: int = 0,
+        eos_token_ids: tuple[int, ...] = (),
+        on_stored: Callable[[list[int], int | None], None] | None = None,
+        on_removed: Callable[[list[int]], None] | None = None,
+    ):
+        bs = engine_cfg.block_size
+        for b in engine_cfg.prefill_buckets:
+            if b % bs:
+                raise ValueError(f"prefill bucket {b} not a multiple of block_size {bs}")
+        self.cfg = model_cfg
+        self.engine = engine_cfg
+        self.eos_token_ids = set(eos_token_ids)
+        self.params = params if params is not None else init_params(
+            jax.random.PRNGKey(seed), model_cfg
+        )
+        self.k_cache, self.v_cache = init_cache(model_cfg, engine_cfg)
+        self.allocator = DeviceBlockAllocator(
+            engine_cfg.num_kv_blocks,
+            bs,
+            enable_prefix_caching=engine_cfg.enable_prefix_caching,
+            on_stored=on_stored,
+            on_removed=on_removed,
+        )
+        self._inbox: deque[Sequence] = deque()   # thread-safe enqueue
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        self.iterations = 0
+        self._req_counter = 0
+        self._lock = threading.Lock()
+
+        self._prefill = jax.jit(
+            partial(prefill_step_impl, cfg=model_cfg, engine=engine_cfg),
+            static_argnames=("kv_span",),
+            donate_argnums=(2, 3),
+        )
+        self._decode = jax.jit(
+            partial(_decode_and_sample, cfg=model_cfg, engine=engine_cfg),
+            donate_argnums=(1, 2),
+        )
+        self._sample1 = jax.jit(_sample_from_logits)
+
+    # -- request intake (any thread) --------------------------------------
+
+    def add_request(self, pre: PreprocessedRequest) -> Sequence:
+        with self._lock:
+            self._req_counter += 1
+            n = self._req_counter
+        seed = pre.sampling.seed if pre.sampling.seed is not None else n
+        seq = Sequence(
+            request_id=pre.request_id or f"req-{n}",
+            prompt=list(pre.token_ids),
+            sampling=pre.sampling,
+            stop=pre.stop,
+            seed=seed,
+        )
+        if not seq.prompt:
+            raise ValueError("empty prompt")
+        limit = self.engine.max_model_len
+        if seq.prompt_len >= limit:
+            raise ValueError(
+                f"prompt of {seq.prompt_len} tokens exceeds max_model_len {limit}"
+            )
+        # Clamp the generation budget to the context window (vLLM semantics).
+        budget = limit - seq.prompt_len
+        if seq.stop.max_tokens is None or seq.stop.max_tokens > budget:
+            seq.stop = type(seq.stop)(
+                max_tokens=budget,
+                min_tokens=seq.stop.min_tokens,
+                stop=seq.stop.stop,
+                stop_token_ids=seq.stop.stop_token_ids,
+                ignore_eos=seq.stop.ignore_eos,
+            )
+        self._inbox.append(seq)
+        return seq
+
+    # -- scheduling --------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return bool(self._inbox or self.waiting or self.running)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.engine.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"{n} exceeds largest prefill bucket")
+
+    def _kv_span_for(self, total: int) -> int:
+        cap = self.engine.max_blocks_per_seq * self.engine.block_size
+        for b in self.engine.prefill_buckets:
+            if b >= total:
+                return min(b, cap)
+        big = self.engine.prefill_buckets[-1]
+        return min(-(-total // big) * big, cap)
+
+    def _decode_width(self, n: int) -> int:
+        for b in self.engine.decode_buckets:
+            if b >= n:
+                return b
+        return self.engine.decode_buckets[-1]
+
+    def _admit(self) -> None:
+        while self._inbox:
+            self.waiting.append(self._inbox.popleft())
+        bs = self.engine.block_size
+        watermark = 0.01 * self.allocator.capacity
+        while self.waiting and len(self.running) < self.engine.max_num_seqs:
+            seq = self.waiting[0]
+            if seq.cancelled:
+                self.waiting.popleft()
+                continue
+            P = seq.prompt_len
+            seq.prompt_hashes = compute_seq_hashes(seq.prompt, bs)
+            # Cap the reusable prefix so at least one token is prefilled
+            # (the engine needs last-token logits to start decoding).
+            cap = (P - 1) // bs
+            cached_ids = self.allocator.acquire_cached(seq.prompt_hashes[:cap])
+            ncached = len(cached_ids)
+            total_blocks = -(-P // bs)
+            need = total_blocks - ncached
+            if (
+                self.allocator.free_blocks - need < watermark
+                and self.running
+            ):
+                self.allocator.release(seq.prompt_hashes[:ncached])
+                return
+            try:
+                new_ids = self.allocator.alloc_many(need)
+            except OutOfBlocksError:
+                self.allocator.release(seq.prompt_hashes[:ncached])
+                return
+            self.waiting.popleft()
+            seq.block_ids = cached_ids + new_ids
+            seq.committed_blocks = ncached
+            seq.pinned_hashes = list(seq.prompt_hashes[:ncached])
+            seq.num_cached_tokens = ncached * bs
+            seq.prefilled = seq.processed = ncached * bs
+            seq.hashed = TokenBlockSequence(seq.prompt[: seq.prefilled], bs)
+            self.running.append(seq)
+
+    # -- device-step assembly ---------------------------------------------
+
+    def _table_array(self, block_ids: list[int]) -> np.ndarray:
+        t = np.full(self.engine.max_blocks_per_seq, self.engine.garbage_block, np.int32)
+        t[: len(block_ids)] = block_ids
+        return t
+
+    def _commit_completed(self, seq: Sequence, completed) -> None:
+        for blk in completed:
+            idx = blk.position
+            canonical = self.allocator.commit(
+                seq.block_ids[idx], blk.block_hash, blk.parent_hash
+            )
+            seq.block_ids[idx] = canonical
+            seq.pinned_hashes.append(blk.block_hash)
+            seq.committed_blocks += 1
+
+    def _run_prefill(self, seq: Sequence) -> None:
+        bs = self.engine.block_size
+        remaining = seq.prompt_len - seq.prefilled
+        max_bucket = self.engine.prefill_buckets[-1]
+        chunk = min(remaining, max_bucket)
+        bucket = self._bucket_for(chunk)
+        toks = np.zeros(bucket, np.int32)
+        toks[:chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
+        kv_span = self._kv_span_for(seq.prefilled + chunk)
+        logits, self.k_cache, self.v_cache = self._prefill(
+            self.params,
+            jnp.asarray(toks),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(self._table_array(seq.block_ids)),
+            jnp.int32(chunk),
+            jnp.int32(seq.prefilled),
+            kv_span=kv_span,
+        )
+        completed = seq.hashed.extend(seq.prompt[seq.prefilled : seq.prefilled + chunk])
+        self._commit_completed(seq, completed)
+        seq.prefilled += chunk
+        seq.processed = seq.prefilled
+        if seq.prefill_done:
+            tok = self._sample1(
+                logits[None],
+                jnp.asarray([seq.seed], jnp.int32),
+                jnp.asarray([seq.generated], jnp.int32),
+                jnp.asarray([seq.sampling.temperature], jnp.float32),
+                jnp.asarray([seq.sampling.top_k], jnp.int32),
+                jnp.asarray([seq.sampling.top_p], jnp.float32),
+            )
+            seq.pending = int(tok[0])
+            seq.generated += 1
+
+    def _grow_block(self, seq: Sequence) -> bool:
+        """Ensure a physical block exists for the next decode write."""
+        bs = self.engine.block_size
+        if seq.processed % bs == 0 and seq.processed // bs >= len(seq.block_ids):
+            try:
+                seq.block_ids.append(self.allocator.alloc())
+            except OutOfBlocksError:
+                return False
+        return True
+
+    def _preempt(self, seq: Sequence) -> None:
+        """Token-replay preemption: free everything, re-prefill later."""
+        log.info("preempting %s (generated=%d)", seq.request_id, seq.generated)
+        self._release_blocks(seq)
+        new_prompt = seq.hashed.all_tokens()
+        if seq.pending is not None:
+            new_prompt.append(seq.pending)
+        seq.prompt = new_prompt
+        seq.pending = None
+        seq.block_ids = []
+        seq.pinned_hashes = []
+        seq.committed_blocks = 0
+        seq.prefilled = seq.processed = 0
+        seq.hashed = None
+        self.running.remove(seq)
+        self.waiting.appendleft(seq)
+
+    def _release_blocks(self, seq: Sequence) -> None:
+        for bid in seq.block_ids[seq.committed_blocks :]:
+            self.allocator.free_partial(bid)
+        self.allocator.release(seq.pinned_hashes)
+        seq.block_ids = seq.block_ids[: seq.committed_blocks]
+
+    def _run_decode(self, seqs: list[Sequence]) -> list[int]:
+        B = self._decode_width(len(seqs))
+        seqs = seqs[:B]
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        tables = np.full(
+            (B, self.engine.max_blocks_per_seq), self.engine.garbage_block, np.int32
+        )
+        active = np.zeros(B, bool)
+        temp = np.ones(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        seeds = np.zeros(B, np.int32)
+        counters = np.zeros(B, np.int32)
+        for i, seq in enumerate(seqs):
+            tokens[i] = seq.pending
+            positions[i] = seq.processed
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            active[i] = True
+            temp[i] = seq.sampling.temperature
+            top_k[i] = seq.sampling.top_k
+            top_p[i] = seq.sampling.top_p
+            seeds[i] = seq.seed
+            counters[i] = seq.generated
+        out, self.k_cache, self.v_cache = self._decode(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(tables),
+            jnp.asarray(positions),
+            jnp.asarray(active),
+            jnp.asarray(seeds),
+            jnp.asarray(counters),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+        )
+        return [int(t) for t in np.asarray(out)[: len(seqs)]]
+
+    # -- the iteration -----------------------------------------------------
+
+    def step(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """One engine iteration; returns (sequence, output-chunk) pairs.
+        A chunk with ``finish_reason`` set is the sequence's last."""
+        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+        self.iterations += 1
+
+        for seq in [s for s in self.running if s.cancelled]:
+            self.running.remove(seq)
+            self._release_blocks(seq)
+
+        self._admit()
+
+        prefill = next((s for s in self.running if not s.prefill_done), None)
+        if prefill is not None:
+            self._run_prefill(prefill)
+            if prefill.prefill_done:
+                outputs.append((prefill, self._emit(prefill, prefill.pending)))
+                if prefill.finish is not None:
+                    self._finish(prefill)
+            return outputs
+
+        decoding = [s for s in self.running if s.pending is not None]
+        ready: list[Sequence] = []
+        for seq in decoding:
+            if seq not in self.running:
+                continue  # preempted by an earlier seq in this loop
+            if self._grow_block(seq):
+                ready.append(seq)
+                continue
+            victim = next((s for s in reversed(self.running) if s is not seq), None)
+            if victim is not None:
+                self._preempt(victim)
+                if victim in ready:
+                    ready.remove(victim)
+                if self._grow_block(seq):
+                    ready.append(seq)
+        if not ready:
+            return outputs
+
+        new_tokens = self._run_decode(ready)
+        for seq, new_tok in zip(ready, new_tokens):
+            completed = seq.hashed.append(seq.pending)
+            if completed is not None:
+                self._commit_completed(seq, [completed])
+            seq.processed += 1
+            seq.generated += 1
+            outputs.append((seq, self._emit(seq, new_tok)))
+            if seq.finish is not None:
+                self._finish(seq)
+            else:
+                seq.pending = new_tok
+        return outputs
+
+    def _emit(self, seq: Sequence, token: int) -> LLMEngineOutput:
+        """Emit the newest sampled token. ``seq.generated`` already counts
+        it, on both the prefill and decode paths."""
+        finish = self._check_stop(seq, token)
+        out = LLMEngineOutput(token_ids=[token])
+        if not seq.emitted_first:
+            seq.emitted_first = True
+            out.meta = {
+                "cached_tokens": seq.num_cached_tokens,
+                "iteration": self.iterations,
+            }
+        if finish is not None:
+            seq.finish = finish
+            out.finish_reason = finish
+            out.prompt_tokens = seq.prompt_len
+            out.completion_tokens = seq.generated
+        return out
+
+    def _check_stop(self, seq: Sequence, token: int) -> str | None:
+        st = seq.stop
+        n = seq.generated  # includes `token`
+        if token in self.eos_token_ids and not st.ignore_eos and n >= st.min_tokens:
+            return FinishReason.EOS.value
+        if token in st.stop_token_ids and n >= st.min_tokens:
+            return FinishReason.STOP.value
+        if st.max_tokens is not None and n >= st.max_tokens:
+            return FinishReason.LENGTH.value
+        return None
+
+    def _finish(self, seq: Sequence) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        self._release_blocks(seq)
+
+    # -- observability -----------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        alloc = self.allocator
+        return ForwardPassMetrics(
+            worker=WorkerStats(
+                request_active_slots=len(self.running),
+                request_total_slots=self.engine.max_num_seqs,
+                num_requests_waiting=len(self.waiting) + len(self._inbox),
+            ),
+            kv=KvStats(
+                kv_active_blocks=alloc.used_blocks,
+                kv_total_blocks=alloc.capacity,
+                gpu_cache_usage_perc=alloc.usage_perc,
+                gpu_prefix_cache_hit_rate=(
+                    alloc.prefix_hits / alloc.prefix_queries
+                    if alloc.prefix_queries
+                    else 0.0
+                ),
+            ),
+        )

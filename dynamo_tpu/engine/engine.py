@@ -1,0 +1,89 @@
+"""TpuEngine: async facade over EngineCore for the worker runtime.
+
+Same surface as the mock engine (`dynamo_tpu/llm/mocker/engine.py`):
+``generate(wire_dict, context) -> async iterator of wire dicts``, plus
+``metrics()`` and KV-event callbacks — so the backend worker CLI, router,
+and tests treat real and mock engines interchangeably.
+
+The engine loop runs each `step()` in a worker thread (`asyncio.to_thread`)
+— jitted device calls block, and the event loop must stay live to accept
+requests and stream tokens. Host-side scheduler state is only touched from
+inside `step()`; intake goes through the core's thread-safe inbox.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.engine.core import EngineCore, Sequence
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+_FINISHED = object()
+
+
+class TpuEngine:
+    def __init__(self, core: EngineCore):
+        self.core = core
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._seqs: dict[str, Sequence] = {}
+        self._wakeup = asyncio.Event()
+        self._loop_task: asyncio.Task | None = None
+
+    async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
+        pre = PreprocessedRequest.from_wire(request)
+        pre.request_id = pre.request_id or context.id
+        seq = self.core.add_request(pre)
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[seq.request_id] = queue
+        self._seqs[seq.request_id] = seq
+        self._ensure_loop()
+        self._wakeup.set()
+        try:
+            while True:
+                item = await queue.get()
+                if item is _FINISHED:
+                    return
+                yield item
+                if context.is_stopped:
+                    seq.cancelled = True
+                    return
+        finally:
+            seq.cancelled = True
+            self._queues.pop(seq.request_id, None)
+            self._seqs.pop(seq.request_id, None)
+
+    def metrics(self):
+        return self.core.metrics()
+
+    # -- engine loop -------------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            if not self.core.has_work():
+                self._wakeup.clear()
+                await self._wakeup.wait()
+            try:
+                outputs = await asyncio.to_thread(self.core.step)
+            except Exception:
+                log.exception("engine step failed")
+                for rid, q in list(self._queues.items()):
+                    q.put_nowait(_FINISHED)
+                raise
+            for seq, out in outputs:
+                q = self._queues.get(seq.request_id)
+                if q is None:
+                    continue
+                q.put_nowait(out.to_wire())
+                if out.finish_reason is not None:
+                    q.put_nowait(_FINISHED)
+            # Yield to let request/stream tasks run between iterations.
+            await asyncio.sleep(0)

@@ -121,10 +121,7 @@ def _slot_for(block_tables: jax.Array, positions: jax.Array, block_size: int) ->
 
 # -- prefill ---------------------------------------------------------------
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "engine", "kv_span"), donate_argnums=(2, 3)
-)
-def prefill_step(
+def prefill_step_impl(
     params: Params,
     tokens: jax.Array,       # [T] int32, padded to a bucket
     k_cache: jax.Array,      # [L, n_kv, total_slots, d] (donated)
@@ -204,8 +201,7 @@ def prefill_step(
 
 # -- decode ----------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("cfg", "engine"), donate_argnums=(2, 3))
-def decode_step(
+def decode_step_impl(
     params: Params,
     tokens: jax.Array,        # [B] int32 — the just-sampled token per seq
     k_cache: jax.Array,       # donated
@@ -249,3 +245,13 @@ def decode_step(
     x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _logits(x, params, cfg), k_cache, v_cache
+
+
+# Jitted entry points (standalone use / tests). The engine core wraps the
+# *_impl functions in its own jits to fuse sampling into the same program.
+prefill_step = jax.jit(
+    prefill_step_impl, static_argnames=("cfg", "engine", "kv_span"), donate_argnums=(2, 3)
+)
+decode_step = jax.jit(
+    decode_step_impl, static_argnames=("cfg", "engine"), donate_argnums=(2, 3)
+)

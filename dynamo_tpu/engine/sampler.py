@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 def sample(
     logits: jax.Array,        # [B, V] float32
-    rng: jax.Array,
+    rng: jax.Array,           # single key, or per-lane keys [B, 2]
     temperature: jax.Array,   # [B] float32; 0 => greedy
     top_k: jax.Array,         # [B] int32; <= 0 => disabled
     top_p: jax.Array,         # [B] float32; >= 1 => disabled
@@ -44,5 +44,10 @@ def sample(
     keep_p = jnp.take_along_axis(keep_p_sorted, ranks, axis=-1)
 
     masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
-    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    if rng.ndim == 2:
+        # Per-lane keys: each request draws from its own seeded stream, so
+        # a seeded request reproduces regardless of its batch neighbors.
+        sampled = jax.vmap(jax.random.categorical)(rng, masked).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
