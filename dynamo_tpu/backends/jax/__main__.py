@@ -1,0 +1,3 @@
+from dynamo_tpu.backends.jax.main import main
+
+main()

@@ -1,0 +1,184 @@
+"""The JAX TPU backend worker: the native engine wired into the runtime.
+
+``python -m dynamo_tpu.backends.jax --model-name tiny --preset tiny``
+starts a worker process exactly shaped like the reference's vLLM shim
+(`components/backends/vllm/src/dynamo/vllm/main.py:67-247`): connect to
+the control plane, build the engine, publish KV events + load metrics,
+register the model card, serve the generate endpoint. The engine is the
+first-party JAX/Pallas one instead of a GPU subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.worker import dynamo_worker
+
+log = logging.getLogger("dynamo_tpu.backends.jax")
+
+
+def build_engine(
+    preset: str,
+    engine_overrides: dict[str, Any] | None = None,
+    seed: int = 0,
+    eos_token_ids: tuple[int, ...] = (),
+    on_stored=None,
+    on_removed=None,
+):
+    """Construct (EngineCore, TpuEngine) for a model preset.
+
+    Imported lazily so the CLI can print --help without touching jax.
+    """
+    from dynamo_tpu.engine import (
+        EngineConfig,
+        EngineCore,
+        PRESETS,
+        TpuEngine,
+        tiny_engine,
+    )
+
+    model_cfg = PRESETS[preset]()
+    overrides = dict(engine_overrides or {})
+    if preset == "tiny":
+        engine_cfg = tiny_engine(**overrides)
+    else:
+        engine_cfg = EngineConfig(**overrides) if overrides else EngineConfig()
+    core = EngineCore(
+        model_cfg,
+        engine_cfg,
+        seed=seed,
+        eos_token_ids=eos_token_ids,
+        on_stored=on_stored,
+        on_removed=on_removed,
+    )
+    return core, TpuEngine(core)
+
+
+async def run_jax_worker(
+    runtime: DistributedRuntime,
+    model_name: str = "tiny",
+    preset: str = "tiny",
+    namespace: str = "dynamo",
+    component: str = "backend",
+    engine_overrides: dict[str, Any] | None = None,
+    tokenizer: str = "byte",
+    seed: int = 0,
+    served_event: asyncio.Event | None = None,
+) -> None:
+    worker_id = runtime.primary_lease_id
+    kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
+    loop = asyncio.get_running_loop()
+
+    # KV events fire from the engine thread (core.step under to_thread);
+    # hop them onto the loop for publishing.
+    def on_stored(hashes: list[int], parent: int | None) -> None:
+        loop.call_soon_threadsafe(
+            lambda: loop.create_task(kv_pub.stored(hashes, parent))
+        )
+
+    def on_removed(hashes: list[int]) -> None:
+        loop.call_soon_threadsafe(
+            lambda: loop.create_task(kv_pub.removed(hashes))
+        )
+
+    eos: tuple[int, ...] = ()
+    if tokenizer == "byte":
+        from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+        eos = (ByteTokenizer.EOS,)
+
+    core, engine = build_engine(
+        preset,
+        engine_overrides,
+        seed=seed,
+        eos_token_ids=eos,
+        on_stored=on_stored,
+        on_removed=on_removed,
+    )
+
+    metrics_pub = WorkerMetricsPublisher(
+        runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
+    )
+    await metrics_pub.start()
+
+    endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
+
+    async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        async for out in engine.generate(request, context):
+            yield out
+
+    await endpoint.serve(handler)
+    await register_llm(
+        endpoint,
+        ModelDeploymentCard(
+            name=model_name,
+            tokenizer=tokenizer,
+            model_type="chat",
+            context_length=core.engine.max_model_len,
+            kv_block_size=core.engine.block_size,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=core.engine.num_kv_blocks,
+                max_num_seqs=core.engine.max_num_seqs,
+                max_num_batched_tokens=core.engine.prefill_buckets[-1],
+            ),
+        ),
+    )
+    log.info(
+        "jax worker %d serving model %r (preset %s, %d kv blocks)",
+        worker_id, model_name, preset, core.engine.num_kv_blocks,
+    )
+    if served_event is not None:
+        served_event.set()
+    await runtime.wait_for_shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
+    ap.add_argument("--model-name", default="tiny")
+    ap.add_argument("--preset", default="tiny", choices=["tiny", "llama3-8b", "llama3-70b"])
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--tokenizer", default="byte", help="'byte' or an HF tokenizer path")
+    ap.add_argument("--num-kv-blocks", type=int, default=None)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--max-num-seqs", type=int, default=None)
+    ap.add_argument("--max-model-len", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    overrides = {
+        k: v
+        for k, v in {
+            "num_kv_blocks": args.num_kv_blocks,
+            "block_size": args.block_size,
+            "max_num_seqs": args.max_num_seqs,
+            "max_model_len": args.max_model_len,
+        }.items()
+        if v is not None
+    }
+
+    @dynamo_worker()
+    async def entry(runtime: DistributedRuntime) -> None:
+        await run_jax_worker(
+            runtime,
+            model_name=args.model_name,
+            preset=args.preset,
+            namespace=args.namespace,
+            component=args.component,
+            engine_overrides=overrides,
+            tokenizer=args.tokenizer,
+            seed=args.seed,
+        )
+
+    entry()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""E2E: OpenAI frontend + the real JAX engine worker (tiny model, CPU).
+
+The full production path with the first-party engine: HTTP → preprocess →
+KV router → data plane → EngineCore (jitted prefill/decode + paged cache)
+→ detok → SSE. Parity: reference `tests/serve/test_vllm.py` (frontend +
+real engine worker, completions asserted), minus the GPU.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.backends.jax.main import run_jax_worker
+from dynamo_tpu.frontend.main import run_frontend
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+
+class JaxCluster:
+    def __init__(self, num_workers: int = 1, router_mode: str = "kv"):
+        self.num_workers = num_workers
+        self.router_mode = router_mode
+        self.store = StoreServer()
+        self.runtimes: list[DistributedRuntime] = []
+        self.tasks: list[asyncio.Task] = []
+        self.base_url = ""
+
+    async def __aenter__(self) -> "JaxCluster":
+        await self.store.start()
+        for i in range(self.num_workers):
+            rt = await DistributedRuntime.create(self.store.address)
+            self.runtimes.append(rt)
+            served = asyncio.Event()
+            self.tasks.append(
+                asyncio.create_task(
+                    run_jax_worker(
+                        rt,
+                        model_name="tinyjax",
+                        preset="tiny",
+                        seed=0,
+                        served_event=served,
+                    )
+                )
+            )
+            await asyncio.wait_for(served.wait(), 30)
+        front_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(front_rt)
+        ready = asyncio.Event()
+        services: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_frontend(
+                    front_rt,
+                    http_host="127.0.0.1",
+                    http_port=0,
+                    router_mode=self.router_mode,
+                    ready_event=ready,
+                    service_out=services,
+                )
+            )
+        )
+        await asyncio.wait_for(ready.wait(), 10)
+        self.base_url = f"http://127.0.0.1:{services[0].port}"
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):
+                async with s.get(f"{self.base_url}/v1/models") as r:
+                    data = await r.json()
+                    if data["data"]:
+                        return self
+                await asyncio.sleep(0.05)
+        raise TimeoutError("model never appeared on frontend")
+
+    async def __aexit__(self, *exc) -> None:
+        for rt in self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.1)
+        for t in self.tasks:
+            t.cancel()
+        for rt in self.runtimes:
+            try:
+                await rt.shutdown()
+            except Exception:
+                pass
+        await self.store.stop()
+
+
+async def _chat(session, base_url, content, max_tokens=6, stream=False, extra=None):
+    body = {
+        "model": "tinyjax",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+        "temperature": 0.0,
+    }
+    if extra:
+        body.update(extra)
+    async with session.post(f"{base_url}/v1/chat/completions", json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+
+async def test_jax_worker_completion_e2e():
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            out = await _chat(s, c.base_url, "hello tpu", max_tokens=6)
+            choice = out["choices"][0]
+            assert choice["finish_reason"] == "length"
+            assert out["usage"]["completion_tokens"] == 6
+            # Greedy determinism end-to-end: same request, same content —
+            # and the repeat must hit the prefix cache.
+            out2 = await _chat(s, c.base_url, "hello tpu", max_tokens=6)
+            assert out2["choices"][0]["message"] == choice["message"]
+            cached = out2["usage"].get("prompt_tokens_details", {}).get("cached_tokens", 0)
+            assert cached > 0
+
+
+async def test_jax_worker_concurrent_streams():
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+
+            async def one(i: int):
+                return await _chat(s, c.base_url, f"request number {i}", max_tokens=4)
+
+            results = await asyncio.gather(*[one(i) for i in range(8)])
+            for out in results:
+                assert out["usage"]["completion_tokens"] == 4
