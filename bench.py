@@ -1,0 +1,112 @@
+"""Serving throughput bench on the flagship single-chip model.
+
+Drives EngineCore (the real jitted engine: bucketed prefill, batched
+paged-attention decode with fused sampling) through a fixed synthetic
+workload and prints ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+``vs_baseline`` is measured throughput over an HBM-bandwidth roofline for
+the decode phase (decode is bandwidth-bound: every step streams the full
+weights plus the batch's live KV), so 1.0 means saturating the chip's
+memory system — the honest ceiling for autoregressive decode. Workload
+shape follows the reference's harness defaults scaled to one chip
+(`benchmarks/llm/perf.sh:18-27`, SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = 32
+ISL = 128
+OSL = 128
+
+# HBM bandwidth by TPU generation (GB/s); v5e default.
+HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819))
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig, llama3_1b
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    cfg = llama3_1b()
+    eng = EngineConfig(
+        num_kv_blocks=512,
+        block_size=32,
+        max_num_seqs=BATCH,
+        max_model_len=512,
+        prefill_buckets=(ISL,),
+        decode_buckets=(BATCH,),
+    )
+    core = EngineCore(cfg, eng, seed=0)
+    rng = np.random.RandomState(0)
+
+    def req(i: int, n_out: int) -> PreprocessedRequest:
+        return PreprocessedRequest(
+            model="bench",
+            token_ids=rng.randint(1, cfg.vocab_size, size=ISL).tolist(),
+            request_id=f"bench-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=n_out, ignore_eos=True),
+        )
+
+    def drain(n_expected: int) -> tuple[int, float, float]:
+        """Run until n_expected finishes; returns (tokens, ttft_sum, t)."""
+        finished = 0
+        tokens = 0
+        first_seen: dict[str, float] = {}
+        t0 = time.perf_counter()
+        while finished < n_expected:
+            for seq, out in core.step():
+                tokens += len(out.token_ids)
+                if seq.request_id not in first_seen:
+                    first_seen[seq.request_id] = time.perf_counter() - t0
+                if out.finish_reason:
+                    finished += 1
+        return tokens, sum(first_seen.values()), time.perf_counter() - t0
+
+    # Warmup: trigger the prefill + decode compiles.
+    core.add_request(req(9999, 4))
+    drain(1)
+
+    for i in range(BATCH):
+        core.add_request(req(i, OSL))
+    tokens, ttft_sum, elapsed = drain(BATCH)
+
+    throughput = tokens / elapsed
+
+    # Decode roofline: per step, weights + live KV of the batch stream
+    # from HBM. Mean context during decode = ISL + OSL/2.
+    kv_bytes_per_tok = (
+        cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2 * 2  # K+V, bf16
+    )
+    mean_ctx = ISL + OSL / 2
+    step_bytes = cfg.param_bytes() + BATCH * mean_ctx * kv_bytes_per_tok
+    roofline = BATCH / (step_bytes / (HBM_GBPS * 1e9))
+
+    print(
+        json.dumps(
+            {
+                "metric": f"llama3-1b agg tokens/sec/chip (B={BATCH}, {ISL}/{OSL})",
+                "value": round(throughput, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(throughput / roofline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -115,6 +115,20 @@ def llama3_70b() -> ModelConfig:
     )
 
 
+def llama3_1b() -> ModelConfig:
+    """Llama-3.2-1B-proportioned: the single-chip flagship for benches."""
+    return ModelConfig(
+        name="llama3-1b",
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        tie_embeddings=True,
+    )
+
+
 def tiny_model(vocab_size: int = 384) -> ModelConfig:
     """Byte-tokenizer-sized model for tests and CPU smoke runs."""
     return ModelConfig(
@@ -148,5 +162,6 @@ def tiny_engine(**overrides) -> EngineConfig:
 PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
+    "llama3-1b": llama3_1b,
     "tiny": tiny_model,
 }

@@ -1,0 +1,94 @@
+"""Device-mesh sharding for the JAX engine: megatron-style TP + DP.
+
+The reference delegates tensor parallelism to its GPU engines and only
+plumbs `tp_size` flags (`components/backends/vllm/src/dynamo/vllm/args.py`,
+SURVEY.md §2.6); on TPU the partitioning is first-party and rides ICI via
+XLA collectives — no NCCL.
+
+Mapping (classic megatron over axes ``("dp", "tp")``):
+- attention qkv projections: column-parallel (heads split across tp)
+- attention output / mlp down: row-parallel (XLA inserts the psum)
+- mlp gate/up: column-parallel (intermediate split)
+- lm_head: vocab-split (sampling reduces across shards inside jit)
+- paged KV cache: kv-head axis split across tp — the head-major layout
+  [L, n_kv, slots, d] makes this the leading per-layer axis
+- decode batch: split across dp; prefill (one sequence) replicated on dp
+
+Requires ``num_kv_heads % tp == 0`` (llama3 GQA: tp ≤ 8). Larger tp would
+split head_dim — future work, noted in EngineConfig docs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+
+
+def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devices)}")
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    """NamedSharding pytree matching `model.init_params` structure."""
+    if cfg.num_kv_heads % mesh.shape["tp"]:
+        raise ValueError(
+            f"tp={mesh.shape['tp']} must divide num_kv_heads={cfg.num_kv_heads}"
+        )
+
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    shardings = {
+        "embed": s(None, None),
+        "layers": {
+            "attn_norm": s(None, None),
+            "mlp_norm": s(None, None),
+            "wq": s(None, None, "tp"),
+            "wk": s(None, None, "tp"),
+            "wv": s(None, None, "tp"),
+            "wo": s(None, "tp", None),
+            "w_gate": s(None, None, "tp"),
+            "w_up": s(None, None, "tp"),
+            "w_down": s(None, "tp", None),
+        },
+        "final_norm": s(None),
+    }
+    if not cfg.tie_embeddings:
+        shardings["lm_head"] = s(None, "tp")
+    return shardings
+
+
+def cache_sharding(mesh: Mesh) -> NamedSharding:
+    """[L, n_kv, slots, d] — kv heads split across tp."""
+    return NamedSharding(mesh, P(None, "tp", None, None))
+
+
+def decode_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Decode-step batch operands: batch axis split across dp."""
+    dp = NamedSharding(mesh, P("dp"))
+    return {
+        "tokens": dp,
+        "block_tables": NamedSharding(mesh, P("dp", None)),
+        "positions": dp,
+        "active": dp,
+    }
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
+    """Place an (unsharded) params pytree onto the mesh."""
+    return jax.tree.map(
+        lambda x, sh: jax.device_put(x, sh), params, param_shardings(cfg, mesh)
+    )
