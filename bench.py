@@ -49,6 +49,7 @@ def main() -> None:
         max_model_len=512,
         prefill_buckets=(ISL,),
         decode_buckets=(BATCH,),
+        decode_chain=32,
     )
     core = EngineCore(cfg, eng, seed=0)
     rng = np.random.RandomState(0)
@@ -77,8 +78,8 @@ def main() -> None:
                     finished += 1
         return tokens, sum(first_seen.values()), time.perf_counter() - t0
 
-    # Warmup: trigger the prefill + decode compiles.
-    core.add_request(req(9999, 4))
+    # Warmup: trigger the prefill + full-chain decode compiles.
+    core.add_request(req(9999, eng.decode_chain))
     drain(1)
 
     for i in range(BATCH):

@@ -6,18 +6,30 @@ starts a worker process exactly shaped like the reference's vLLM shim
 the control plane, build the engine, publish KV events + load metrics,
 register the model card, serve the generate endpoint. The engine is the
 first-party JAX/Pallas one instead of a GPU subprocess.
+
+Disaggregation (``--role prefill|decode``) follows the reference's vLLM
+decode-first pattern (`handlers.py:113-168`, SURVEY.md §3.3): the decode
+worker forwards long prefills to the prefill fleet with ``max_tokens=1``
+and ``kv_transfer_params={do_remote_decode: true}``; the prefill worker
+holds the request's KV blocks and returns descriptors; the decode worker
+pulls the blocks over the data plane (`kv_transfer` endpoint — the
+NIXL-equivalent host-staged DCN path), imports them into its cache, and
+continues decoding against the now-local prefix.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import logging
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
 from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
 from dynamo_tpu.runtime import Context, DistributedRuntime
 from dynamo_tpu.runtime.worker import dynamo_worker
 
@@ -66,12 +78,17 @@ async def run_jax_worker(
     model_name: str = "tiny",
     preset: str = "tiny",
     namespace: str = "dynamo",
-    component: str = "backend",
+    component: str | None = None,
     engine_overrides: dict[str, Any] | None = None,
     tokenizer: str = "byte",
     seed: int = 0,
+    role: str = "aggregated",   # aggregated | prefill | decode
+    disagg_config: DisaggConfig | None = None,
     served_event: asyncio.Event | None = None,
+    core_out: list | None = None,
 ) -> None:
+    if component is None:
+        component = "prefill" if role == "prefill" else "backend"
     worker_id = runtime.primary_lease_id
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
     loop = asyncio.get_running_loop()
@@ -103,6 +120,9 @@ async def run_jax_worker(
         on_removed=on_removed,
     )
 
+    if core_out is not None:
+        core_out.append(core)
+
     metrics_pub = WorkerMetricsPublisher(
         runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
     )
@@ -110,9 +130,75 @@ async def run_jax_worker(
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
-    async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
-        async for out in engine.generate(request, context):
-            yield out
+    if role == "prefill":
+        # Remote-prefill server: tag descriptors with our identity so the
+        # decode side can pull directly, and serve the block-transfer
+        # endpoint (the NIXL-equivalent data path).
+        async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            async for out in engine.generate(request, context):
+                if out.get("kv_transfer_params"):
+                    out["kv_transfer_params"]["worker_id"] = worker_id
+                yield out
+
+        async def kv_transfer_handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            rid = request["request_id"]
+            try:
+                blocks, _ = await asyncio.to_thread(core.export_held_blocks, rid)
+            except KeyError:
+                yield {"error": f"no held blocks for {rid}"}
+                return
+            for blk in blocks:
+                yield blk
+
+        transfer_ep = (
+            runtime.namespace(namespace).component(component).endpoint("kv_transfer")
+        )
+        await transfer_ep.serve(kv_transfer_handler)
+        await endpoint.serve(handler)
+        log.info("jax prefill worker %d ready (model %r)", worker_id, model_name)
+        if served_event is not None:
+            served_event.set()
+        await runtime.wait_for_shutdown()
+        return
+
+    if role == "decode":
+        disagg = DisaggRouter(disagg_config)
+        asyncio.create_task(disagg.watch_store(runtime.store, namespace))
+        prefill_client = await (
+            runtime.namespace(namespace).component("prefill").endpoint("generate").client()
+        )
+        transfer_client = await (
+            runtime.namespace(namespace).component("prefill").endpoint("kv_transfer").client()
+        )
+
+        async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            pre = PreprocessedRequest.from_wire(request)
+            pre.request_id = pre.request_id or context.id
+            cached = await asyncio.to_thread(core.cached_prefix_tokens, pre.token_ids)
+            uncached = len(pre.token_ids) - cached
+            if (
+                prefill_client.instance_ids()
+                and disagg.should_remote_prefill(uncached)
+            ):
+                try:
+                    async for out in _remote_prefill_then_decode(
+                        core, engine, pre, context, prefill_client, transfer_client
+                    ):
+                        yield out
+                    return
+                except Exception:
+                    log.exception(
+                        "remote prefill failed for %s; falling back to local",
+                        pre.request_id,
+                    )
+            async for out in engine.generate(pre.to_wire(), context):
+                yield out
+
+    else:
+
+        async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            async for out in engine.generate(request, context):
+                yield out
 
     await endpoint.serve(handler)
     await register_llm(
@@ -131,12 +217,70 @@ async def run_jax_worker(
         ),
     )
     log.info(
-        "jax worker %d serving model %r (preset %s, %d kv blocks)",
-        worker_id, model_name, preset, core.engine.num_kv_blocks,
+        "jax %s worker %d serving model %r (preset %s, %d kv blocks)",
+        role, worker_id, model_name, preset, core.engine.num_kv_blocks,
     )
     if served_event is not None:
         served_event.set()
     await runtime.wait_for_shutdown()
+
+
+async def _remote_prefill_then_decode(
+    core, engine, pre: PreprocessedRequest, context: Context,
+    prefill_client, transfer_client,
+) -> AsyncIterator[Any]:
+    """Decode-first disaggregation: remote prefill, block pull, local
+    continuation by token replay (reference handlers.py:113-151)."""
+    from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+
+    prefill_req = dataclasses.replace(
+        pre,
+        stop=StopConditions(max_tokens=1, ignore_eos=True),
+        kv_transfer_params={"do_remote_decode": True},
+    )
+    stream = await prefill_client.round_robin(prefill_req.to_wire())
+    first: dict | None = None
+    async for item in stream:
+        first = item
+    if first is None:
+        raise ConnectionError("prefill worker returned no output")
+    out1 = LLMEngineOutput.from_wire(first)
+    xfer = out1.kv_transfer_params or {}
+    prefill_worker = xfer.get("worker_id")
+    rid = xfer.get("request_id")
+
+    if prefill_worker is not None and rid is not None:
+        blocks: list[dict] = []
+        bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
+        async for blk in bstream:
+            if "error" not in blk:
+                blocks.append(blk)
+        imported = await asyncio.to_thread(core.import_blocks, blocks)
+        log.debug("imported %d/%d transferred blocks for %s", imported, len(blocks), rid)
+
+    token1 = out1.token_ids[0]
+    first_chunk = LLMEngineOutput(
+        token_ids=[token1], meta=dict(out1.meta, remote_prefill=True)
+    )
+    if pre.stop.max_tokens is not None and pre.stop.max_tokens <= 1:
+        first_chunk.finish_reason = out1.finish_reason or "length"
+        first_chunk.prompt_tokens = len(pre.token_ids)
+        first_chunk.completion_tokens = 1
+        yield first_chunk.to_wire()
+        return
+    yield first_chunk.to_wire()
+
+    cont = dataclasses.replace(
+        pre,
+        token_ids=list(pre.token_ids) + [token1],
+        stop=dataclasses.replace(
+            pre.stop,
+            max_tokens=None if pre.stop.max_tokens is None else pre.stop.max_tokens - 1,
+        ),
+        kv_transfer_params=None,
+    )
+    async for out in engine.generate(cont.to_wire(), context):
+        yield out
 
 
 def main() -> None:
@@ -144,13 +288,18 @@ def main() -> None:
     ap.add_argument("--model-name", default="tiny")
     ap.add_argument("--preset", default="tiny", choices=["tiny", "llama3-8b", "llama3-70b"])
     ap.add_argument("--namespace", default="dynamo")
-    ap.add_argument("--component", default="backend")
+    ap.add_argument("--component", default=None, help="defaults by role")
     ap.add_argument("--tokenizer", default="byte", help="'byte' or an HF tokenizer path")
     ap.add_argument("--num-kv-blocks", type=int, default=None)
     ap.add_argument("--block-size", type=int, default=None)
     ap.add_argument("--max-num-seqs", type=int, default=None)
     ap.add_argument("--max-model-len", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
+    ap.add_argument(
+        "--max-local-prefill-length", type=int, default=50,
+        help="decode role: prefills longer than this go to the prefill fleet",
+    )
     args = ap.parse_args()
 
     overrides = {
@@ -175,6 +324,10 @@ def main() -> None:
             engine_overrides=overrides,
             tokenizer=args.tokenizer,
             seed=args.seed,
+            role=args.role,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=args.max_local_prefill_length
+            ),
         )
 
     entry()

@@ -172,6 +172,31 @@ class DeviceBlockAllocator:
                     self._free.append(blk.block_id)
                     self.on_removed([h])
 
+    def is_cached(self, block_hash: int) -> bool:
+        return block_hash in self._by_hash
+
+    def alloc_for_import(self) -> int:
+        """A block for transferred-in KV content (not partial-tracked)."""
+        if not self._free:
+            if not self._inactive:
+                raise OutOfBlocksError(f"all {self.capacity} blocks pinned")
+            self._evict_lru()
+        return self._free.popleft()
+
+    def register_inactive(self, block_id: int, block_hash: int, parent_hash: int | None) -> int:
+        """Register imported content as cached-but-unpinned (inactive LRU).
+        Dedup mirrors commit(): existing hash keeps its canonical block."""
+        existing = self._by_hash.get(block_hash)
+        if existing is not None:
+            self._free.append(block_id)
+            return existing.block_id
+        blk = _Committed(block_id, block_hash, parent_hash, refcount=0)
+        self._by_hash[block_hash] = blk
+        self._inactive[block_hash] = blk
+        self._inactive.move_to_end(block_hash)
+        self.on_stored([block_hash], parent_hash)
+        return block_id
+
     def clear_cache(self) -> list[int]:
         """Drop all unpinned cached blocks; returns the evicted hashes."""
         hashes = list(self._inactive)

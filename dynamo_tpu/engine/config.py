@@ -82,6 +82,12 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     # Decode batch buckets: compile decode at these widths only.
     decode_buckets: tuple[int, ...] = (8, 16, 32, 64)
+    # Multi-step decode: chain this many decode+sample steps in ONE device
+    # program (sampled tokens feed back on-device via lax.scan), amortizing
+    # dispatch/host latency. Stop conditions are applied per token on the
+    # host afterwards; near the context edge the engine falls back to
+    # single steps. 1 = classic per-token stepping.
+    decode_chain: int = 8
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -116,15 +122,22 @@ def llama3_70b() -> ModelConfig:
 
 
 def llama3_1b() -> ModelConfig:
-    """Llama-3.2-1B-proportioned: the single-chip flagship for benches."""
+    """Llama-3.2-1B-proportioned single-chip flagship.
+
+    TPU-native deviation: 16 heads x 128 head_dim instead of upstream's
+    32 x 64 — the Pallas paged-attention kernel DMAs KV pages whose lane
+    dimension is head_dim, and TPU tiling wants 128 there. Same hidden
+    size, same FLOPs; models with head_dim < 128 still run via the XLA
+    reference attention path.
+    """
     return ModelConfig(
         name="llama3-1b",
         hidden_size=2048,
         intermediate_size=8192,
         num_layers=16,
-        num_heads=32,
+        num_heads=16,
         num_kv_heads=8,
-        head_dim=64,
+        head_dim=128,
         tie_embeddings=True,
     )
 

@@ -77,6 +77,9 @@ class Sequence:
     finish: str | None = None
     cancelled: bool = False
     emitted_first: bool = False
+    # Disaggregation: a remote-decode prefill holds its blocks after finish
+    # until the decode worker pulls them (reference disagg_serving.md flow).
+    hold_blocks: bool = False
 
     @property
     def prompt_len(self) -> int:
@@ -95,15 +98,30 @@ def _sample_from_logits(logits, seeds, counters, temperature, top_k, top_p):
     return sample(logits, keys, temperature, top_k, top_p)
 
 
-def _decode_and_sample(
+def _decode_chain(
     params, k_cache, v_cache, tokens, block_tables, positions, active,
-    seeds, counters, temperature, top_k, top_p, *, cfg, engine,
+    seeds, counters, temperature, top_k, top_p, *, n_steps, cfg, engine,
 ):
-    logits, k_cache, v_cache = decode_step_impl(
-        params, tokens, k_cache, v_cache, block_tables, positions, active, cfg, engine
+    """n_steps fused decode+sample iterations in one program: each step
+    writes the current token's K/V, attends, samples the next token —
+    which feeds the next step on-device. Returns all sampled tokens
+    [n_steps, B]; the host applies stop conditions afterwards."""
+    step = jnp.asarray(active, jnp.int32)
+
+    def body(carry, i):
+        toks, k, v = carry
+        logits, k, v = decode_step_impl(
+            params, toks, k, v, block_tables, positions + i * step, active, cfg, engine
+        )
+        nxt = _sample_from_logits(
+            logits, seeds, counters + i, temperature, top_k, top_p
+        )
+        return (nxt, k, v), nxt
+
+    (_, k_cache, v_cache), sampled = jax.lax.scan(
+        body, (tokens, k_cache, v_cache), jnp.arange(n_steps)
     )
-    toks = _sample_from_logits(logits, seeds, counters, temperature, top_k, top_p)
-    return toks, k_cache, v_cache
+    return sampled, k_cache, v_cache
 
 
 class EngineCore:
@@ -141,6 +159,10 @@ class EngineCore:
         self.iterations = 0
         self._req_counter = 0
         self._lock = threading.Lock()
+        # Serializes step() against cross-thread cache surgery
+        # (import/export of disaggregated KV blocks).
+        self._step_lock = threading.Lock()
+        self._held: dict[str, Sequence] = {}
 
         self._prefill = jax.jit(
             partial(prefill_step_impl, cfg=model_cfg, engine=engine_cfg),
@@ -148,7 +170,8 @@ class EngineCore:
             donate_argnums=(2, 3),
         )
         self._decode = jax.jit(
-            partial(_decode_and_sample, cfg=model_cfg, engine=engine_cfg),
+            partial(_decode_chain, cfg=model_cfg, engine=engine_cfg),
+            static_argnames=("n_steps",),
             donate_argnums=(1, 2),
         )
         self._sample1 = jax.jit(_sample_from_logits)
@@ -184,6 +207,8 @@ class EngineCore:
                 stop_token_ids=seq.stop.stop_token_ids,
                 ignore_eos=seq.stop.ignore_eos,
             )
+        if (pre.kv_transfer_params or {}).get("do_remote_decode"):
+            seq.hold_blocks = True
         self._inbox.append(seq)
         return seq
 
@@ -303,14 +328,20 @@ class EngineCore:
             seq.pending = int(tok[0])
             seq.generated += 1
 
-    def _grow_block(self, seq: Sequence) -> bool:
-        """Ensure a physical block exists for the next decode write."""
+    def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
+        """Ensure physical blocks exist for the next ``n_tokens`` decode
+        writes (positions processed .. processed+n_tokens-1)."""
         bs = self.engine.block_size
-        if seq.processed % bs == 0 and seq.processed // bs >= len(seq.block_ids):
+        need = (seq.processed + n_tokens - 1) // bs + 1 - len(seq.block_ids)
+        grabbed: list[int] = []
+        for _ in range(max(0, need)):
             try:
-                seq.block_ids.append(self.allocator.alloc())
+                grabbed.append(self.allocator.alloc())
             except OutOfBlocksError:
+                for b in grabbed:
+                    self.allocator.free_partial(b)
                 return False
+        seq.block_ids.extend(grabbed)
         return True
 
     def _preempt(self, seq: Sequence) -> None:
@@ -336,7 +367,7 @@ class EngineCore:
         self.allocator.release(seq.pinned_hashes)
         seq.block_ids = seq.block_ids[: seq.committed_blocks]
 
-    def _run_decode(self, seqs: list[Sequence]) -> list[int]:
+    def _run_decode(self, seqs: list[Sequence], n_steps: int) -> Any:
         B = self._decode_width(len(seqs))
         seqs = seqs[:B]
         tokens = np.zeros(B, np.int32)
@@ -373,14 +404,19 @@ class EngineCore:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            n_steps=n_steps,
         )
-        return [int(t) for t in np.asarray(out)[: len(seqs)]]
+        return np.asarray(out)  # [n_steps, B]
 
     # -- the iteration -----------------------------------------------------
 
     def step(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         """One engine iteration; returns (sequence, output-chunk) pairs.
         A chunk with ``finish_reason`` set is the sequence's last."""
+        with self._step_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         outputs: list[tuple[Sequence, LLMEngineOutput]] = []
         self.iterations += 1
 
@@ -400,11 +436,14 @@ class EngineCore:
             return outputs
 
         decoding = [s for s in self.running if s.pending is not None]
+        if not decoding:
+            return outputs
+        n_steps = self._chain_length(decoding)
         ready: list[Sequence] = []
         for seq in decoding:
             if seq not in self.running:
                 continue  # preempted by an earlier seq in this loop
-            if self._grow_block(seq):
+            if self._grow_blocks(seq, n_steps):
                 ready.append(seq)
                 continue
             victim = next((s for s in reversed(self.running) if s is not seq), None)
@@ -412,24 +451,39 @@ class EngineCore:
                 self._preempt(victim)
                 if victim in ready:
                     ready.remove(victim)
-                if self._grow_block(seq):
+                if self._grow_blocks(seq, n_steps):
                     ready.append(seq)
         if not ready:
             return outputs
 
-        new_tokens = self._run_decode(ready)
-        for seq, new_tok in zip(ready, new_tokens):
-            completed = seq.hashed.append(seq.pending)
-            if completed is not None:
-                self._commit_completed(seq, [completed])
-            seq.processed += 1
-            seq.generated += 1
-            outputs.append((seq, self._emit(seq, new_tok)))
-            if seq.finish is not None:
-                self._finish(seq)
-            else:
+        chained = self._run_decode(ready, n_steps)  # [n_steps, len(ready)]
+        for i, seq in enumerate(ready):
+            for j in range(n_steps):
+                completed = seq.hashed.append(seq.pending)
+                if completed is not None:
+                    self._commit_completed(seq, [completed])
+                seq.processed += 1
+                seq.generated += 1
+                new_tok = int(chained[j][i])
+                outputs.append((seq, self._emit(seq, new_tok)))
+                if seq.finish is not None:
+                    self._finish(seq)
+                    break
                 seq.pending = new_tok
         return outputs
+
+    def _chain_length(self, seqs: list[Sequence]) -> int:
+        """Fused decode steps this iteration. Always the configured chain
+        unless the context edge forces fewer (hard limit — no writes past
+        the block table); then snap down to a power of two. Generation
+        budgets do NOT shorten chains: overshoot tokens are discarded by
+        the host stop-check, which costs a little compute but keeps the
+        compiled-program count at ~1 instead of one per tail length."""
+        ctx_cap = min(self.engine.max_model_len - s.processed for s in seqs)
+        n = max(1, min(self.engine.decode_chain, ctx_cap))
+        if n == self.engine.decode_chain:
+            return n
+        return 1 << (n.bit_length() - 1)
 
     def _emit(self, seq: Sequence, token: int) -> LLMEngineOutput:
         """Emit the newest sampled token. ``seq.generated`` already counts
@@ -447,6 +501,12 @@ class EngineCore:
             out.finish_reason = finish
             out.prompt_tokens = seq.prompt_len
             out.completion_tokens = seq.generated
+            if seq.hold_blocks:
+                out.kv_transfer_params = {
+                    "request_id": seq.request_id,
+                    "block_hashes": list(seq.pinned_hashes[: seq.committed_blocks]),
+                    "block_size": self.engine.block_size,
+                }
         return out
 
     def _check_stop(self, seq: Sequence, token: int) -> str | None:
@@ -463,7 +523,88 @@ class EngineCore:
     def _finish(self, seq: Sequence) -> None:
         if seq in self.running:
             self.running.remove(seq)
-        self._release_blocks(seq)
+        if seq.hold_blocks:
+            self._held[seq.request_id] = seq
+        else:
+            self._release_blocks(seq)
+
+    # -- disaggregated KV transfer (export on prefill, import on decode) ---
+
+    def export_held_blocks(self, request_id: str) -> tuple[list[dict], Any]:
+        """Gather a held prefill's committed blocks off the device.
+
+        Returns (block descriptors, none) and releases the hold. Each
+        descriptor carries the hash chain plus raw K/V page bytes
+        [L, n_kv, block_size, d]. The TPU-native analogue of NIXL
+        descriptor export (reference nixl_connect/__init__.py:501).
+        """
+        with self._step_lock:
+            seq = self._held.pop(request_id, None)
+            if seq is None:
+                raise KeyError(f"no held blocks for request {request_id}")
+            bs = self.engine.block_size
+            blocks: list[dict] = []
+            parent: int | None = None
+            for i in range(seq.committed_blocks):
+                bid = seq.block_ids[i]
+                sl = slice(bid * bs, (bid + 1) * bs)
+                k = np.asarray(self.k_cache[:, :, sl, :])
+                v = np.asarray(self.v_cache[:, :, sl, :])
+                h = seq.prompt_hashes[i]
+                blocks.append(
+                    {
+                        "hash": h,
+                        "parent": parent,
+                        "k": k.tobytes(),
+                        "v": v.tobytes(),
+                        "shape": list(k.shape),
+                        "dtype": np.dtype(self.cfg.jax_dtype).name,
+                    }
+                )
+                parent = h
+            self._release_blocks(seq)
+            return blocks, None
+
+    def cached_prefix_tokens(self, token_ids: list[int]) -> int:
+        """Locally cached leading tokens (disagg local-vs-remote decision)."""
+        hashes = compute_seq_hashes(token_ids, self.engine.block_size)
+        with self._step_lock:
+            return self.allocator.match_prefix(hashes) * self.engine.block_size
+
+    def release_held(self, request_id: str) -> None:
+        with self._step_lock:
+            seq = self._held.pop(request_id, None)
+            if seq is not None:
+                self._release_blocks(seq)
+
+    def import_blocks(self, blocks: list[dict]) -> int:
+        """Write transferred KV pages into the local cache as inactive
+        cached content; a following admission prefix-matches them. Returns
+        blocks actually imported (already-cached hashes are skipped)."""
+        import jax.numpy as jnp
+        import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+
+        bs = self.engine.block_size
+        with self._step_lock:
+            imported = 0
+            for blk in blocks:
+                h = blk["hash"]
+                if self.allocator.is_cached(h):
+                    continue
+                try:
+                    bid = self.allocator.alloc_for_import()
+                except OutOfBlocksError:
+                    break
+                dtype = np.dtype(blk["dtype"])
+                shape = tuple(blk["shape"])
+                k = np.frombuffer(blk["k"], dtype=dtype).reshape(shape)
+                v = np.frombuffer(blk["v"], dtype=dtype).reshape(shape)
+                sl = slice(bid * bs, (bid + 1) * bs)
+                self.k_cache = self.k_cache.at[:, :, sl, :].set(jnp.asarray(k))
+                self.v_cache = self.v_cache.at[:, :, sl, :].set(jnp.asarray(v))
+                self.allocator.register_inactive(bid, h, blk["parent"])
+                imported += 1
+            return imported
 
     # -- observability -----------------------------------------------------
 

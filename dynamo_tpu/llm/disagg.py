@@ -1,0 +1,64 @@
+"""Conditional disaggregation: local vs remote prefill decision.
+
+A decode worker sends a request's prefill to the prefill fleet only when
+it is long enough to be worth the KV transfer AND the prefill fleet isn't
+backed up — otherwise prefilling locally is faster. Thresholds hot-reload
+from the control-plane store so operators can tune a live system.
+
+Capability parity: reference `lib/llm/src/disagg_router.rs:24-100`
+(prefill-length + queue-depth conditions, etcd-watched config) and
+`docs/architecture/disagg_serving.md:46-56`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+
+log = logging.getLogger("dynamo_tpu.disagg")
+
+DISAGG_CONFIG_KEY = "/dynamo/config/disagg/{namespace}"
+
+
+@dataclass
+class DisaggConfig:
+    # Prefills at or below this many uncached tokens stay local.
+    max_local_prefill_length: int = 50
+    # Remote prefill is skipped while the prefill queue is deeper than this.
+    max_prefill_queue_size: int = 2
+    enabled: bool = True
+
+
+class DisaggRouter:
+    def __init__(self, config: DisaggConfig | None = None):
+        self.config = config or DisaggConfig()
+
+    def should_remote_prefill(
+        self, prefill_length: int, queue_depth: int = 0
+    ) -> bool:
+        """``prefill_length`` = tokens actually needing prefill (prompt
+        minus the locally cached prefix)."""
+        c = self.config
+        return (
+            c.enabled
+            and prefill_length > c.max_local_prefill_length
+            and queue_depth <= c.max_prefill_queue_size
+        )
+
+    async def watch_store(self, store, namespace: str) -> None:
+        """Follow config updates at DISAGG_CONFIG_KEY (hot reload)."""
+        from dynamo_tpu.runtime.store.client import StoreClient
+
+        key = DISAGG_CONFIG_KEY.format(namespace=namespace)
+        sub = await store.kv_watch(key)
+        async for ev in sub:
+            event = StoreClient.as_watch_event(ev)
+            if event.type != "put" or event.value is None:
+                continue
+            try:
+                data = json.loads(event.value)
+                self.config = DisaggConfig(**data)
+                log.info("disagg config reloaded: %s", self.config)
+            except (ValueError, TypeError) as e:
+                log.warning("bad disagg config at %s: %s", key, e)

@@ -204,11 +204,22 @@ def paged_attention_pallas(
     return out.reshape(B, n_q, d)
 
 
+def pallas_supported(head_dim: int, block_size: int, dtype) -> bool:
+    """TPU tiling constraints on the page DMA: lane dim (head_dim) must be
+    a multiple of 128 and the sublane slice (block_size) a multiple of the
+    dtype's min tile."""
+    sublane = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    return head_dim % 128 == 0 and block_size % sublane == 0
+
+
 def paged_attention(
     q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None
 ) -> jax.Array:
-    """Dispatch to the Pallas kernel on TPU, the reference elsewhere."""
-    if jax.default_backend() == "tpu":
+    """Dispatch to the Pallas kernel on TPU (tiling permitting), the XLA
+    reference elsewhere — e.g. head_dim < 128 models."""
+    if jax.default_backend() == "tpu" and pallas_supported(
+        q.shape[-1], block_size, k_cache.dtype
+    ):
         return paged_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,
             block_size=block_size, scale=scale,

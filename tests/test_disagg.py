@@ -1,0 +1,172 @@
+"""Disaggregated prefill/decode: remote prefill, KV block transfer,
+local continuation — outputs must match aggregated serving exactly.
+
+Parity: reference disagg flow `docs/architecture/disagg_serving.md` +
+vLLM decode-first handlers (`handlers.py:113-168`); transfer layer is the
+framework's host-staged DCN path instead of NIXL RDMA.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.backends.jax.main import run_jax_worker
+from dynamo_tpu.frontend.main import run_frontend
+from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+
+def test_disagg_router_policy():
+    r = DisaggRouter(DisaggConfig(max_local_prefill_length=50, max_prefill_queue_size=2))
+    assert not r.should_remote_prefill(10)
+    assert r.should_remote_prefill(100)
+    assert not r.should_remote_prefill(100, queue_depth=5)
+    r.config.enabled = False
+    assert not r.should_remote_prefill(100)
+
+
+class DisaggCluster:
+    """Store + 1 prefill worker + 1 decode worker + frontend, in-process."""
+
+    def __init__(self):
+        self.store = StoreServer()
+        self.runtimes: list[DistributedRuntime] = []
+        self.tasks: list[asyncio.Task] = []
+        self.base_url = ""
+        self.prefill_core = None
+        self.decode_core = None
+
+    async def __aenter__(self) -> "DisaggCluster":
+        await self.store.start()
+
+        prefill_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(prefill_rt)
+        served = asyncio.Event()
+        cores: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_jax_worker(
+                    prefill_rt, model_name="tinyjax", preset="tiny", seed=0,
+                    role="prefill", served_event=served, core_out=cores,
+                )
+            )
+        )
+        await asyncio.wait_for(served.wait(), 30)
+        self.prefill_core = cores[0]
+
+        decode_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(decode_rt)
+        served2 = asyncio.Event()
+        cores2: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_jax_worker(
+                    decode_rt, model_name="tinyjax", preset="tiny", seed=0,
+                    role="decode",
+                    disagg_config=DisaggConfig(max_local_prefill_length=16),
+                    served_event=served2, core_out=cores2,
+                )
+            )
+        )
+        await asyncio.wait_for(served2.wait(), 30)
+        self.decode_core = cores2[0]
+
+        front_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(front_rt)
+        ready = asyncio.Event()
+        services: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_frontend(
+                    front_rt, http_host="127.0.0.1", http_port=0,
+                    router_mode="kv", ready_event=ready, service_out=services,
+                )
+            )
+        )
+        await asyncio.wait_for(ready.wait(), 10)
+        self.base_url = f"http://127.0.0.1:{services[0].port}"
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):
+                async with s.get(f"{self.base_url}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        return self
+                await asyncio.sleep(0.05)
+        raise TimeoutError("model never appeared")
+
+    async def __aexit__(self, *exc) -> None:
+        for rt in self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.1)
+        for t in self.tasks:
+            t.cancel()
+        for rt in self.runtimes:
+            try:
+                await rt.shutdown()
+            except Exception:
+                pass
+        await self.store.stop()
+
+
+LONG_PROMPT = (
+    "Long prompts get disaggregated: this text is deliberately padded so "
+    "its tokenization spans multiple complete KV blocks end to end."
+)
+
+
+async def _chat(session, base_url, content, max_tokens=8):
+    body = {
+        "model": "tinyjax",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+    }
+    async with session.post(f"{base_url}/v1/chat/completions", json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+
+async def test_disagg_matches_aggregated_and_transfers_blocks():
+    # Aggregated ground truth (same seed/model).
+    from tests.test_e2e_jax_worker import JaxCluster
+
+    async with JaxCluster() as agg:
+        async with aiohttp.ClientSession() as s:
+            want = await _chat(s, agg.base_url, LONG_PROMPT, max_tokens=8)
+
+    async with DisaggCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            got = await _chat(s, c.base_url, LONG_PROMPT, max_tokens=8)
+
+            # Identical content through the disaggregated path.
+            assert got["choices"][0]["message"] == want["choices"][0]["message"]
+            assert got["usage"]["completion_tokens"] == 8
+
+            # The prefill actually ran remotely and its blocks moved:
+            assert c.prefill_core.iterations > 0, "prefill fleet never ran"
+            assert len(c.prefill_core.allocator._by_hash) > 0
+            # Decode worker imported the transferred prefix blocks (they
+            # are registered content in its allocator).
+            assert len(c.decode_core.allocator._by_hash) > 0
+
+            # Short prompts stay local: prefill fleet iteration count frozen.
+            before = c.prefill_core.iterations
+            out2 = await _chat(s, c.base_url, "hi", max_tokens=4)
+            assert out2["usage"]["completion_tokens"] == 4
+            assert c.prefill_core.iterations == before
+
+
+async def test_disagg_decode_reuses_transferred_blocks():
+    async with DisaggCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            await _chat(s, c.base_url, LONG_PROMPT, max_tokens=4)
+            # Repeat: everything already cached locally on the decode
+            # worker -> no new remote prefill.
+            before = c.prefill_core.iterations
+            out = await _chat(s, c.base_url, LONG_PROMPT, max_tokens=4)
+            assert c.prefill_core.iterations == before
+            cached = out["usage"].get("prompt_tokens_details", {}).get("cached_tokens", 0)
+            assert cached > 0
