@@ -293,7 +293,10 @@ class EngineCore:
             seq.pinned_hashes.append(blk.block_hash)
             seq.committed_blocks += 1
 
-    def _run_prefill(self, seq: Sequence) -> None:
+    def _run_prefill_chunk(self, seq: Sequence):
+        """Dispatch one prefill chunk; returns last-token logits (device
+        array, NOT synced) — the caller batches sampling across sequences
+        so a fleet of prefills costs one host round trip."""
         bs = self.engine.block_size
         remaining = seq.prompt_len - seq.prefilled
         max_bucket = self.engine.prefill_buckets[-1]
@@ -316,17 +319,37 @@ class EngineCore:
         self._commit_completed(seq, completed)
         seq.prefilled += chunk
         seq.processed = seq.prefilled
-        if seq.prefill_done:
-            tok = self._sample1(
-                logits[None],
-                jnp.asarray([seq.seed], jnp.int32),
-                jnp.asarray([seq.generated], jnp.int32),
-                jnp.asarray([seq.sampling.temperature], jnp.float32),
-                jnp.asarray([seq.sampling.top_k], jnp.int32),
-                jnp.asarray([seq.sampling.top_p], jnp.float32),
-            )
-            seq.pending = int(tok[0])
-            seq.generated += 1
+        return logits
+
+    def _sample_first_tokens(self, pairs: list[tuple[Sequence, Any]]) -> list[int]:
+        """One padded sampling program + one device->host sync for every
+        sequence that completed prefill this iteration."""
+        W = self.engine.max_num_seqs  # fixed width -> exactly one compile
+        pairs = pairs[:W]
+        logits = jnp.stack([lg for _, lg in pairs])
+        if len(pairs) < W:
+            pad = jnp.zeros((W - len(pairs), logits.shape[1]), logits.dtype)
+            logits = jnp.concatenate([logits, pad])
+        seeds = np.zeros(W, np.int32)
+        counters = np.zeros(W, np.int32)
+        temp = np.ones(W, np.float32)
+        top_k = np.zeros(W, np.int32)
+        top_p = np.ones(W, np.float32)
+        for i, (seq, _) in enumerate(pairs):
+            seeds[i] = seq.seed
+            counters[i] = seq.generated
+            temp[i] = seq.sampling.temperature
+            top_k[i] = seq.sampling.top_k
+            top_p[i] = seq.sampling.top_p
+        toks = self._sample1(
+            logits,
+            jnp.asarray(seeds),
+            jnp.asarray(counters),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+        )
+        return [int(t) for t in np.asarray(toks)[: len(pairs)]]
 
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
         """Ensure physical blocks exist for the next ``n_tokens`` decode
@@ -426,13 +449,22 @@ class EngineCore:
 
         self._admit()
 
-        prefill = next((s for s in self.running if not s.prefill_done), None)
-        if prefill is not None:
-            self._run_prefill(prefill)
-            if prefill.prefill_done:
-                outputs.append((prefill, self._emit(prefill, prefill.pending)))
-                if prefill.finish is not None:
-                    self._finish(prefill)
+        prefills = [s for s in self.running if not s.prefill_done]
+        if prefills:
+            finished_pairs: list[tuple[Sequence, Any]] = []
+            for seq in prefills:
+                logits = self._run_prefill_chunk(seq)
+                if seq.prefill_done:
+                    finished_pairs.append((seq, logits))
+            if finished_pairs:
+                for (seq, _), tok in zip(
+                    finished_pairs, self._sample_first_tokens(finished_pairs)
+                ):
+                    seq.pending = tok
+                    seq.generated += 1
+                    outputs.append((seq, self._emit(seq, tok)))
+                    if seq.finish is not None:
+                        self._finish(seq)
             return outputs
 
         decoding = [s for s in self.running if s.pending is not None]

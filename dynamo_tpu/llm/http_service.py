@@ -136,50 +136,51 @@ class HttpService:
         return web.json_response(out.model_dump())
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = ChatCompletionRequest.model_validate(await request.json())
-        except (ValidationError, json.JSONDecodeError) as e:
-            return self._error(400, f"invalid request: {e}")
-        if msg := self._validate_sampling(body):
-            return self._error(400, msg)
-        served = self._lookup(body.model)
-        if served is None:
-            return self._error(404, f"model {body.model!r} not found", "model_not_found")
-
-        rid = new_request_id("chatcmpl")
-        m = self.metrics.scoped(service="frontend", model=body.model, endpoint="chat")
-        m.counter("frontend_requests_total").inc()
-        inflight = m.gauge("frontend_inflight_requests")
-        inflight.inc()
-        started = time.monotonic()
-        try:
+        def make_stream(served: ServedModel, body, rid: str, headers):
             pre = served.preprocessor.preprocess_chat(body)
             pre.request_id = rid
-            engine_stream = served.generate(pre, self._headers_for(request, rid))
-            chunks = served.preprocessor.postprocess_chat_stream(
+            return served.preprocessor.postprocess_chat_stream(
                 pre,
-                engine_stream,
+                served.generate(pre, headers),
                 request_id=rid,
                 include_usage=bool(body.stream_options and body.stream_options.include_usage)
                 or not body.stream,
             )
-            if body.stream:
-                return await self._stream_sse(request, chunks, started, m)
-            return await self._aggregate_chat(rid, body, chunks, started)
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:  # noqa: BLE001 — surface engine errors as 500s
-            log.exception("chat request %s failed", rid)
-            return self._error(500, str(e), "internal_error")
-        finally:
-            inflight.dec()
-            m.histogram("frontend_request_duration_seconds").observe(
-                time.monotonic() - started
-            )
+
+        return await self._handle_llm_request(
+            request, ChatCompletionRequest, "chatcmpl", "chat",
+            make_stream, self._aggregate_chat,
+        )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
+        def make_stream(served: ServedModel, body, rid: str, headers):
+            pre = served.preprocessor.preprocess_completion(body)
+            pre.request_id = rid
+            return served.preprocessor.postprocess_completion(
+                pre, served.generate(pre, headers), request_id=rid, stream=body.stream
+            )
+
+        async def aggregate(rid, body, responses):
+            final = None
+            async for r in responses:
+                final = r
+            if final is None:
+                return self._error(500, "engine returned no output", "internal_error")
+            return web.json_response(final.model_dump())
+
+        return await self._handle_llm_request(
+            request, CompletionRequest, "cmpl", "completions", make_stream, aggregate
+        )
+
+    async def _handle_llm_request(
+        self, request: web.Request, model_cls, rid_prefix: str, endpoint: str,
+        make_stream, aggregate,
+    ) -> web.StreamResponse:
+        """The shared request lifecycle: parse/validate -> model lookup ->
+        metrics bracketing -> stream (SSE) or aggregate -> error mapping.
+        Chat and completions differ only in their pre/postprocess pair."""
         try:
-            body = CompletionRequest.model_validate(await request.json())
+            body = model_cls.model_validate(await request.json())
         except (ValidationError, json.JSONDecodeError) as e:
             return self._error(400, f"invalid request: {e}")
         if msg := self._validate_sampling(body):
@@ -188,29 +189,21 @@ class HttpService:
         if served is None:
             return self._error(404, f"model {body.model!r} not found", "model_not_found")
 
-        rid = new_request_id("cmpl")
-        m = self.metrics.scoped(service="frontend", model=body.model, endpoint="completions")
+        rid = new_request_id(rid_prefix)
+        m = self.metrics.scoped(service="frontend", model=body.model, endpoint=endpoint)
         m.counter("frontend_requests_total").inc()
         inflight = m.gauge("frontend_inflight_requests")
         inflight.inc()
         started = time.monotonic()
         try:
-            pre = served.preprocessor.preprocess_completion(body)
-            pre.request_id = rid
-            engine_stream = served.generate(pre, self._headers_for(request, rid))
-            responses = served.preprocessor.postprocess_completion(
-                pre, engine_stream, request_id=rid, stream=body.stream
-            )
+            chunks = make_stream(served, body, rid, self._headers_for(request, rid))
             if body.stream:
-                return await self._stream_sse(request, responses, started, m)
-            final = None
-            async for r in responses:
-                final = r
-            return web.json_response(final.model_dump())
+                return await self._stream_sse(request, chunks, started, m)
+            return await aggregate(rid, body, chunks)
         except asyncio.CancelledError:
             raise
-        except Exception as e:  # noqa: BLE001
-            log.exception("completion request %s failed", rid)
+        except Exception as e:  # noqa: BLE001 — surface engine errors as 500s
+            log.exception("%s request %s failed", endpoint, rid)
             return self._error(500, str(e), "internal_error")
         finally:
             inflight.dec()
@@ -264,7 +257,7 @@ class HttpService:
             pass
         return resp
 
-    async def _aggregate_chat(self, rid, body, chunks, started: float) -> web.Response:
+    async def _aggregate_chat(self, rid, body, chunks) -> web.Response:
         text_parts: list[str] = []
         finish = None
         usage = None
