@@ -55,6 +55,12 @@ class DeviceBlockAllocator:
         self._partials = 0
         self.on_stored = on_stored or (lambda hashes, parent: None)
         self.on_removed = on_removed or (lambda hashes: None)
+        # Optional demotion hook (host KV tier): called with
+        # (block_id, hash, parent) BEFORE an evicted block's storage is
+        # reused; when set, eviction does not emit `removed` — the block
+        # lives on at the next tier and the hook's owner emits removal
+        # when it truly leaves the worker.
+        self.on_evict: Callable[[int, int, int | None], None] | None = None
         self.prefix_queries = 0
         self.prefix_hits = 0
 
@@ -78,8 +84,11 @@ class DeviceBlockAllocator:
     def _evict_lru(self) -> None:
         h, blk = self._inactive.popitem(last=False)
         del self._by_hash[h]
+        if self.on_evict is not None:
+            self.on_evict(blk.block_id, h, blk.parent_hash)
+        else:
+            self.on_removed([h])
         self._free.append(blk.block_id)
-        self.on_removed([h])
 
     def alloc(self) -> int:
         """A fresh partial (uncommitted) block; evicts LRU under pressure."""
@@ -183,9 +192,13 @@ class DeviceBlockAllocator:
             self._evict_lru()
         return self._free.popleft()
 
-    def register_inactive(self, block_id: int, block_hash: int, parent_hash: int | None) -> int:
+    def register_inactive(
+        self, block_id: int, block_hash: int, parent_hash: int | None, emit: bool = True
+    ) -> int:
         """Register imported content as cached-but-unpinned (inactive LRU).
-        Dedup mirrors commit(): existing hash keeps its canonical block."""
+        Dedup mirrors commit(): existing hash keeps its canonical block.
+        ``emit=False`` for host-tier onboarding — the block never left the
+        worker, so the router already counts it as stored."""
         existing = self._by_hash.get(block_hash)
         if existing is not None:
             self._free.append(block_id)
@@ -194,7 +207,8 @@ class DeviceBlockAllocator:
         self._by_hash[block_hash] = blk
         self._inactive[block_hash] = blk
         self._inactive.move_to_end(block_hash)
-        self.on_stored([block_hash], parent_hash)
+        if emit:
+            self.on_stored([block_hash], parent_hash)
         return block_id
 
     def clear_cache(self) -> list[int]:

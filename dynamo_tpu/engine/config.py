@@ -79,6 +79,12 @@ class EngineConfig:
     max_num_seqs: int = 64           # decode batch width (static)
     max_model_len: int = 8192
     prefill_buckets: tuple[int, ...] = (128, 512, 2048, 8192)
+    # Sequences prefilled per dispatch (one program prefills a whole
+    # admission wave; short prompts batch onto the MXU).
+    prefill_batch: int = 8
+    # Host KV tier (G2): blocks evicted from HBM stay cached in host RAM
+    # up to this many blocks and onboard back on prefix hits. 0 = off.
+    host_kv_blocks: int = 0
     enable_prefix_caching: bool = True
     # Decode batch buckets: compile decode at these widths only.
     decode_buckets: tuple[int, ...] = (8, 16, 32, 64)

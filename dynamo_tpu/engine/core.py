@@ -39,7 +39,7 @@ from dynamo_tpu.engine.model import (
     decode_step_impl,
     init_cache,
     init_params,
-    prefill_step_impl,
+    prefill_batch_impl,
 )
 from dynamo_tpu.engine.sampler import sample
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
@@ -153,6 +153,16 @@ class EngineCore:
             on_stored=on_stored,
             on_removed=on_removed,
         )
+        self.host_pool = None
+        if engine_cfg.host_kv_blocks > 0:
+            from dynamo_tpu.engine.host_cache import HostKvPool
+
+            self.host_pool = HostKvPool(
+                engine_cfg.host_kv_blocks,
+                on_removed=lambda hashes: self.allocator.on_removed(hashes),
+            )
+            self.allocator.on_evict = self._offload_block
+
         self._inbox: deque[Sequence] = deque()   # thread-safe enqueue
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
@@ -165,7 +175,7 @@ class EngineCore:
         self._held: dict[str, Sequence] = {}
 
         self._prefill = jax.jit(
-            partial(prefill_step_impl, cfg=model_cfg, engine=engine_cfg),
+            partial(prefill_batch_impl, cfg=model_cfg, engine=engine_cfg),
             static_argnames=("kv_span",),
             donate_argnums=(2, 3),
         )
@@ -254,6 +264,10 @@ class EngineCore:
             cap = (P - 1) // bs
             cached_ids = self.allocator.acquire_cached(seq.prompt_hashes[:cap])
             ncached = len(cached_ids)
+            if self.host_pool is not None:
+                cached_ids, ncached = self._onboard_from_host(
+                    seq.prompt_hashes, cached_ids, ncached, cap
+                )
             total_blocks = -(-P // bs)
             need = total_blocks - ncached
             if (
@@ -276,6 +290,41 @@ class EngineCore:
             seq.hashed = TokenBlockSequence(seq.prompt[: seq.prefilled], bs)
             self.running.append(seq)
 
+    # -- host KV tier (G2) -------------------------------------------------
+
+    def _offload_block(self, block_id: int, block_hash: int, parent: int | None) -> None:
+        """Device eviction hook: demote the block's pages to host RAM."""
+        import jax.numpy as jnp  # noqa: F401
+
+        bs = self.engine.block_size
+        sl = slice(block_id * bs, (block_id + 1) * bs)
+        k = np.asarray(self.k_cache[:, :, sl, :])
+        v = np.asarray(self.v_cache[:, :, sl, :])
+        self.host_pool.put(block_hash, parent, k, v)
+
+    def _onboard_from_host(
+        self, hashes: list[int], cached_ids: list[int], ncached: int, cap: int
+    ) -> tuple[list[int], int]:
+        """Extend a device-cached prefix with host-tier hits: promote each
+        consecutive host block back to HBM and pin it."""
+        import jax.numpy as jnp
+
+        bs = self.engine.block_size
+        while ncached < cap and hashes[ncached] in self.host_pool:
+            h = hashes[ncached]
+            try:
+                bid = self.allocator.alloc_for_import()
+            except OutOfBlocksError:
+                break
+            blk = self.host_pool.pop(h)
+            sl = slice(bid * bs, (bid + 1) * bs)
+            self.k_cache = self.k_cache.at[:, :, sl, :].set(jnp.asarray(blk.k))
+            self.v_cache = self.v_cache.at[:, :, sl, :].set(jnp.asarray(blk.v))
+            self.allocator.register_inactive(bid, h, blk.parent_hash, emit=False)
+            cached_ids.extend(self.allocator.acquire_cached([h]))
+            ncached += 1
+        return cached_ids, ncached
+
     # -- device-step assembly ---------------------------------------------
 
     def _table_array(self, block_ids: list[int]) -> np.ndarray:
@@ -293,33 +342,47 @@ class EngineCore:
             seq.pinned_hashes.append(blk.block_hash)
             seq.committed_blocks += 1
 
-    def _run_prefill_chunk(self, seq: Sequence):
-        """Dispatch one prefill chunk; returns last-token logits (device
-        array, NOT synced) — the caller batches sampling across sequences
-        so a fleet of prefills costs one host round trip."""
-        bs = self.engine.block_size
-        remaining = seq.prompt_len - seq.prefilled
+    def _run_prefill_wave(self, seqs: list[Sequence]):
+        """One dispatch prefills up to ``prefill_batch`` sequences (one
+        chunk each). Returns device logits [W, vocab]; rows of sequences
+        that finished their prompt feed the batched first-token sampler."""
+        W = self.engine.prefill_batch
+        seqs = seqs[:W]
         max_bucket = self.engine.prefill_buckets[-1]
-        chunk = min(remaining, max_bucket)
-        bucket = self._bucket_for(chunk)
-        toks = np.zeros(bucket, np.int32)
-        toks[:chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
-        kv_span = self._kv_span_for(seq.prefilled + chunk)
+        chunks = [min(s.prompt_len - s.prefilled, max_bucket) for s in seqs]
+        bucket = self._bucket_for(max(chunks))
+        kv_span = self._kv_span_for(
+            max(s.prefilled + c for s, c in zip(seqs, chunks))
+        )
+        tokens = np.zeros((W, bucket), np.int32)
+        tables = np.full(
+            (W, self.engine.max_blocks_per_seq), self.engine.garbage_block, np.int32
+        )
+        seq_lens = np.zeros(W, np.int32)
+        start = np.zeros(W, np.int32)
+        for i, (seq, chunk) in enumerate(zip(seqs, chunks)):
+            tokens[i, :chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            seq_lens[i] = chunk
+            start[i] = seq.prefilled
         logits, self.k_cache, self.v_cache = self._prefill(
             self.params,
-            jnp.asarray(toks),
+            jnp.asarray(tokens),
             self.k_cache,
             self.v_cache,
-            jnp.asarray(self._table_array(seq.block_ids)),
-            jnp.int32(chunk),
-            jnp.int32(seq.prefilled),
+            jnp.asarray(tables),
+            jnp.asarray(seq_lens),
+            jnp.asarray(start),
             kv_span=kv_span,
         )
-        completed = seq.hashed.extend(seq.prompt[seq.prefilled : seq.prefilled + chunk])
-        self._commit_completed(seq, completed)
-        seq.prefilled += chunk
-        seq.processed = seq.prefilled
-        return logits
+        for seq, chunk in zip(seqs, chunks):
+            completed = seq.hashed.extend(
+                seq.prompt[seq.prefilled : seq.prefilled + chunk]
+            )
+            self._commit_completed(seq, completed)
+            seq.prefilled += chunk
+            seq.processed = seq.prefilled
+        return seqs, logits
 
     def _sample_first_tokens(self, pairs: list[tuple[Sequence, Any]]) -> list[int]:
         """One padded sampling program + one device->host sync for every
@@ -452,10 +515,10 @@ class EngineCore:
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
             finished_pairs: list[tuple[Sequence, Any]] = []
-            for seq in prefills:
-                logits = self._run_prefill_chunk(seq)
+            wave, logits = self._run_prefill_wave(prefills)
+            for i, seq in enumerate(wave):
                 if seq.prefill_done:
-                    finished_pairs.append((seq, logits))
+                    finished_pairs.append((seq, logits[i]))
             if finished_pairs:
                 for (seq, _), tok in zip(
                     finished_pairs, self._sample_first_tokens(finished_pairs)

@@ -1,0 +1,76 @@
+"""Host-memory KV tier (G2): blocks evicted from device HBM stay cached
+in host RAM and onboard back on a prefix hit.
+
+The TPU analogue of the reference's KVBM offload tier
+(`lib/llm/src/block_manager/offload.rs`, `storage/cuda.rs` pinned-host
+pool, CacheLevel G1/G2 in `block_manager.rs:75-86`): device eviction
+demotes instead of destroys; admission checks G2 after G1 and promotes
+hits before prefill. Router KV events fire on the *worker* boundary — a
+block offloaded to host is still "stored" (onboardable); only host-pool
+eviction emits "removed".
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+@dataclass
+class HostPoolStats:
+    offloads: int = 0
+    onboards: int = 0
+    evictions: int = 0
+
+
+@dataclass
+class _HostBlock:
+    parent_hash: int | None
+    k: np.ndarray  # [L, n_kv, block_size, d]
+    v: np.ndarray
+
+
+class HostKvPool:
+    def __init__(
+        self,
+        capacity_blocks: int,
+        on_removed: Callable[[list[int]], None] | None = None,
+    ):
+        self.capacity = capacity_blocks
+        self._blocks: OrderedDict[int, _HostBlock] = OrderedDict()  # LRU
+        self.on_removed = on_removed or (lambda hashes: None)
+        self.stats = HostPoolStats()
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._blocks
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def put(self, block_hash: int, parent_hash: int | None, k: np.ndarray, v: np.ndarray) -> None:
+        if block_hash in self._blocks:
+            self._blocks.move_to_end(block_hash)
+            return
+        while len(self._blocks) >= self.capacity:
+            h, _ = self._blocks.popitem(last=False)
+            self.stats.evictions += 1
+            self.on_removed([h])
+        self._blocks[block_hash] = _HostBlock(parent_hash, k, v)
+        self.stats.offloads += 1
+
+    def get(self, block_hash: int) -> _HostBlock | None:
+        blk = self._blocks.get(block_hash)
+        if blk is not None:
+            self._blocks.move_to_end(block_hash)
+        return blk
+
+    def pop(self, block_hash: int) -> _HostBlock | None:
+        """Remove on onboarding — the block is device-resident again and
+        G1 eviction would re-offload it here."""
+        blk = self._blocks.pop(block_hash, None)
+        if blk is not None:
+            self.stats.onboards += 1
+        return blk

@@ -199,6 +199,90 @@ def prefill_step_impl(
     return _logits(last, params, cfg), k_cache, v_cache
 
 
+def prefill_batch_impl(
+    params: Params,
+    tokens: jax.Array,        # [B, T] int32, padded to buckets in both dims
+    k_cache: jax.Array,       # [L, n_kv, total_slots, d] (donated)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks_per_seq] int32
+    seq_lens: jax.Array,      # [B] valid tokens in each row (0 = inactive lane)
+    start_pos: jax.Array,     # [B] absolute position of tokens[b, 0]
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    kv_span: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched prefill: B sequences in one program — one dispatch prefills
+    a whole admission wave (and short prompts batch onto the MXU instead
+    of underfilling it). Returns (last-token logits [B, vocab], caches).
+
+    Per-lane ``start_pos`` keeps chunked resumption: different lanes may
+    be at different chunks of different prompts.
+    """
+    B, T = tokens.shape
+    bs = engine.block_size
+    if kv_span is None:
+        kv_span = engine.max_blocks_per_seq * bs
+    if kv_span % bs:
+        raise ValueError(f"kv_span {kv_span} not a multiple of block_size")
+
+    positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    x = params["embed"][tokens]  # [B, T, h]
+
+    blk = positions // bs
+    page = jnp.take_along_axis(block_tables, blk, axis=1)  # [B, T]
+    slots = page * bs + positions % bs
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]
+    slots = jnp.where(valid, slots, engine.total_slots - 1)
+    flat_slots = slots.reshape(-1)  # [B*T]
+
+    kv_pos = jnp.arange(kv_span, dtype=jnp.int32)
+    causal = positions[:, :, None] >= kv_pos[None, None, :]
+    in_seq = kv_pos[None, None, :] < (start_pos + seq_lens)[:, None, None]
+    mask = causal & in_seq  # [B, T, kv_span]
+    scale = cfg.head_dim ** -0.5
+
+    span_tables = block_tables[:, : kv_span // bs]  # [B, span_blocks]
+    page_offsets = jnp.arange(bs, dtype=jnp.int32)
+    page_slots = (
+        span_tables[:, :, None] * bs + page_offsets[None, None, :]
+    ).reshape(B, kv_span)
+
+    group = cfg.num_heads // cfg.num_kv_heads
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = rope(q.reshape(B, T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
+        k = rope(k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+
+        k_flat = k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim).transpose(1, 0, 2)
+        v_flat = v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim).transpose(1, 0, 2)
+        k_l = k_l.at[:, flat_slots].set(k_flat)
+        v_l = v_l.at[:, flat_slots].set(v_flat)
+
+        kk = k_l[:, page_slots]  # [n_kv, B, kv_span, d]
+        vv = v_l[:, page_slots]
+        qg = q.reshape(B, T, cfg.num_kv_heads, group, cfg.head_dim).astype(jnp.float32)
+        logits = jnp.einsum("bthgd,hbsd->bthgs", qg, kk.astype(jnp.float32)) * scale
+        logits = jnp.where(mask[:, :, None, None, :], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bthgs,hbsd->bthgd", w, vv.astype(jnp.float32))
+        attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
+        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last_idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]  # [B, 1, 1]
+    last = jnp.take_along_axis(x, last_idx, axis=1)[:, 0]   # [B, h]
+    return _logits(last, params, cfg), k_cache, v_cache
+
+
 # -- decode ----------------------------------------------------------------
 
 def decode_step_impl(
