@@ -1,0 +1,39 @@
+"""SLA autoscaling planner (parity: reference components/planner)."""
+
+from dynamo_tpu.planner.load_predictor import (
+    ARPredictor,
+    ConstantPredictor,
+    MovingAveragePredictor,
+    PREDICTORS,
+)
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    from_profile,
+)
+from dynamo_tpu.planner.planner_core import (
+    Connector,
+    Observation,
+    Plan,
+    Planner,
+    PlannerConfig,
+    RecordingConnector,
+    SlaTargets,
+)
+
+__all__ = [
+    "ARPredictor",
+    "ConstantPredictor",
+    "Connector",
+    "DecodeInterpolator",
+    "MovingAveragePredictor",
+    "Observation",
+    "PREDICTORS",
+    "Plan",
+    "Planner",
+    "PlannerConfig",
+    "PrefillInterpolator",
+    "RecordingConnector",
+    "SlaTargets",
+    "from_profile",
+]

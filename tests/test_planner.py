@@ -1,0 +1,110 @@
+"""SLA planner: predictors, interpolators, replica math, sinusoidal dry run.
+
+Parity: reference planner dry-run tests
+(`components/planner/test/planner_sla_dryrun.py`) driven by
+`benchmarks/sin_load_generator` traces.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ARPredictor,
+    ConstantPredictor,
+    DecodeInterpolator,
+    MovingAveragePredictor,
+    Observation,
+    Planner,
+    PlannerConfig,
+    PrefillInterpolator,
+    RecordingConnector,
+    SlaTargets,
+    from_profile,
+)
+
+PROFILE = {
+    # One replica: TTFT grows with ISL; ITL grows with concurrency.
+    "prefill": {"isl": [128, 512, 2048, 8192], "ttft_s": [0.02, 0.06, 0.2, 0.9]},
+    "decode": {"concurrency": [1, 8, 32, 64], "itl_s": [0.01, 0.012, 0.02, 0.045]},
+}
+
+
+def make_planner(connector=None, **cfg):
+    p, d = from_profile(PROFILE)
+    return Planner(
+        p, d,
+        connector or RecordingConnector(),
+        sla=SlaTargets(ttft_s=0.2, itl_s=0.02),
+        config=PlannerConfig(predictor=cfg.pop("predictor", "constant"), **cfg),
+    )
+
+
+def test_predictors_track_load():
+    for cls in (ConstantPredictor, MovingAveragePredictor, ARPredictor):
+        pred = cls()
+        for v in [1, 2, 3, 4, 5, 6, 7, 8]:
+            pred.observe(v)
+        assert pred.predict() > 0
+
+    # AR follows a linear ramp beyond the last value.
+    ar = ARPredictor()
+    for v in range(1, 40):
+        ar.observe(float(v))
+    assert ar.predict() > 38.0
+
+
+def test_interpolators():
+    p, d = from_profile(PROFILE)
+    assert p.ttft_at(128) == pytest.approx(0.02)
+    assert 0.06 < p.ttft_at(1024) < 0.2
+    assert p.max_isl_within(0.2) == 2048
+    assert d.max_concurrency_within(0.02) == 32
+    assert d.throughput_at(32) == pytest.approx(32 / 0.02)
+
+
+def test_replica_math_scales_with_rate():
+    planner = make_planner()
+    low = planner.compute_plan(Observation(request_rate=1, mean_isl=512, mean_osl=128))
+    high = planner.compute_plan(Observation(request_rate=20, mean_isl=512, mean_osl=128))
+    assert high.prefill_replicas > low.prefill_replicas
+    assert high.decode_replicas > low.decode_replicas
+    assert low.prefill_replicas >= 1
+
+
+def test_correction_factor_inflates_replicas():
+    planner = make_planner()
+    obs = Observation(request_rate=10, mean_isl=512, mean_osl=128)
+    base = planner.compute_plan(obs)
+    # Live TTFT 3x worse than profile -> correction kicks in.
+    planner2 = make_planner()
+    slow = Observation(
+        request_rate=10, mean_isl=512, mean_osl=128, observed_ttft_s=0.18
+    )
+    worse = planner2.compute_plan(slow)
+    assert worse.correction_prefill > 1.5
+    assert worse.prefill_replicas >= base.prefill_replicas
+
+
+async def test_sinusoidal_dryrun_scales_up_and_down():
+    connector = RecordingConnector()
+    planner = make_planner(connector, predictor="constant", max_replicas=32)
+
+    # Sinusoidal request rate (the reference's sin_load_generator shape).
+    t = np.linspace(0, 2 * math.pi, 48)
+    rates = 10 + 9 * np.sin(t)
+    decode_counts = []
+    for r in rates:
+        plan = planner.compute_plan(
+            Observation(request_rate=float(r), mean_isl=512, mean_osl=256)
+        )
+        await planner.apply(plan)
+        decode_counts.append(plan.decode_replicas)
+
+    assert max(decode_counts) > min(decode_counts), "planner never scaled"
+    # Scaling decisions follow the wave: peak replicas around the rate peak.
+    peak_idx = int(np.argmax(rates))
+    trough_idx = int(np.argmin(rates))
+    assert decode_counts[peak_idx] > decode_counts[trough_idx]
+    assert connector.current("decode") == decode_counts[-1]
