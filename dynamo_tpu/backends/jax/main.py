@@ -286,7 +286,10 @@ async def _remote_prefill_then_decode(
 def main() -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
     ap.add_argument("--model-name", default="tiny")
-    ap.add_argument("--preset", default="tiny", choices=["tiny", "llama3-8b", "llama3-70b"])
+    ap.add_argument(
+        "--preset", default="tiny",
+        choices=["tiny", "tiny-moe", "llama3-1b", "llama3-8b", "llama3-70b", "mixtral-8x7b"],
+    )
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default=None, help="defaults by role")
     ap.add_argument("--tokenizer", default="byte", help="'byte' or an HF tokenizer path")

@@ -37,6 +37,14 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # Byte-level models (test tokenizer) tie embeddings to save params.
     tie_embeddings: bool = False
+    # Sparse MoE (Mixtral-style): 0 experts = dense MLP. Experts shard
+    # over the mesh's model axis (expert parallelism, SURVEY.md §2.6).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @property
     def jax_dtype(self):
@@ -148,6 +156,40 @@ def llama3_1b() -> ModelConfig:
     )
 
 
+def mixtral_8x7b() -> ModelConfig:
+    return ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+    )
+
+
+def tiny_moe(vocab_size: int = 384) -> ModelConfig:
+    return ModelConfig(
+        name="tiny-moe",
+        vocab_size=vocab_size,
+        hidden_size=64,
+        intermediate_size=96,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        dtype="float32",
+        tie_embeddings=True,
+        num_experts=4,
+        num_experts_per_tok=2,
+    )
+
+
 def tiny_model(vocab_size: int = 384) -> ModelConfig:
     """Byte-tokenizer-sized model for tests and CPU smoke runs."""
     return ModelConfig(
@@ -182,5 +224,7 @@ PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "llama3-1b": llama3_1b,
+    "mixtral-8x7b": mixtral_8x7b,
     "tiny": tiny_model,
+    "tiny-moe": tiny_moe,
 }

@@ -47,19 +47,27 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dt)
 
+    layers: dict[str, Any] = {
+        "attn_norm": jnp.ones((L, h), dt),
+        "mlp_norm": jnp.ones((L, h), dt),
+        "wq": dense(keys[1], (L, h, cfg.q_size), h),
+        "wk": dense(keys[2], (L, h, cfg.kv_size), h),
+        "wv": dense(keys[3], (L, h, cfg.kv_size), h),
+        "wo": dense(keys[4], (L, cfg.q_size, h), cfg.q_size),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["w_router"] = dense(jax.random.fold_in(rng, 7), (L, h, E), h)
+        layers["w_gate"] = dense(keys[5], (L, E, h, i), h)
+        layers["w_up"] = dense(keys[6], (L, E, h, i), h)
+        layers["w_down"] = dense(keys[7], (L, E, i, h), i)
+    else:
+        layers["w_gate"] = dense(keys[5], (L, h, i), h)
+        layers["w_up"] = dense(keys[6], (L, h, i), h)
+        layers["w_down"] = dense(keys[7], (L, i, h), i)
     params: Params = {
         "embed": dense(keys[0], (v, h), h),
-        "layers": {
-            "attn_norm": jnp.ones((L, h), dt),
-            "mlp_norm": jnp.ones((L, h), dt),
-            "wq": dense(keys[1], (L, h, cfg.q_size), h),
-            "wk": dense(keys[2], (L, h, cfg.kv_size), h),
-            "wv": dense(keys[3], (L, h, cfg.kv_size), h),
-            "wo": dense(keys[4], (L, cfg.q_size, h), cfg.q_size),
-            "w_gate": dense(keys[5], (L, h, i), h),
-            "w_up": dense(keys[6], (L, h, i), h),
-            "w_down": dense(keys[7], (L, i, h), i),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((h,), dt),
     }
     if not cfg.tie_embeddings:
@@ -94,11 +102,44 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _mlp(x, lp):
+def _mlp(x, lp, cfg: ModelConfig):
+    if cfg.is_moe:
+        return _moe_mlp(x, lp, cfg)
     gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
     up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
     return jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _moe_mlp(x, lp, cfg: ModelConfig):
+    """Mixtral-style sparse MoE: softmax over top-k router logits, weighted
+    sum of expert SwiGLUs.
+
+    Dense-dispatch expert parallelism: every device computes its *local*
+    experts (expert axis sharded over the mesh's model axis) for all
+    tokens; the final contraction over the expert axis becomes a psum XLA
+    inserts. No token all-to-all — the right starting point on ICI, and
+    unselected experts contribute exact zeros. (Token-dropping all-to-all
+    dispatch is the later optimization; reference delegates wide-EP to
+    SGLang, SURVEY.md §2.6.)
+    """
+    shape = x.shape
+    xf = x.reshape(-1, shape[-1])  # [N, h]
+    N = xf.shape[0]
+    router = jnp.dot(xf, lp["w_router"], preferred_element_type=jnp.float32)  # [N, E]
+    vals, idx = jax.lax.top_k(router, cfg.num_experts_per_tok)
+    probs = jax.nn.softmax(vals, axis=-1)
+    weights = (
+        jnp.zeros_like(router)
+        .at[jnp.arange(N)[:, None], idx]
+        .set(probs)
+    )  # [N, E], zero off the top-k
+    gate = jnp.einsum("nh,ehi->nei", xf, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("nh,ehi->nei", xf, lp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    down = jnp.einsum("nei,eih->neh", act, lp["w_down"], preferred_element_type=jnp.float32)
+    out = jnp.einsum("ne,neh->nh", weights, down)
+    return out.astype(x.dtype).reshape(shape)
 
 
 def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
@@ -190,7 +231,7 @@ def prefill_step_impl(
         attn = jnp.einsum("thgs,hsd->thgd", w, vv.astype(jnp.float32))
         attn = attn.reshape(T, cfg.q_size).astype(x.dtype)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
         return x, (k_l, v_l)
 
     x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
@@ -273,7 +314,7 @@ def prefill_batch_impl(
         attn = jnp.einsum("bthgs,hbsd->bthgd", w, vv.astype(jnp.float32))
         attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
         return x, (k_l, v_l)
 
     x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
@@ -323,7 +364,7 @@ def decode_step_impl(
         )  # [B, n_q, d]
         attn = attn.reshape(B, cfg.q_size)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
         return x, (k_l, v_l)
 
     x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))

@@ -21,6 +21,7 @@ constrains the last two dims), and tensor parallelism shards axis 0.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -216,9 +217,13 @@ def paged_attention(
     q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None
 ) -> jax.Array:
     """Dispatch to the Pallas kernel on TPU (tiling permitting), the XLA
-    reference elsewhere — e.g. head_dim < 128 models."""
-    if jax.default_backend() == "tpu" and pallas_supported(
-        q.shape[-1], block_size, k_cache.dtype
+    reference elsewhere — e.g. head_dim < 128 models.
+
+    ``DYNAMO_TPU_PAGED_ATTN=xla`` forces the gather path on TPU (A/B knob)."""
+    if (
+        jax.default_backend() == "tpu"
+        and os.environ.get("DYNAMO_TPU_PAGED_ATTN", "pallas") != "xla"
+        and pallas_supported(q.shape[-1], block_size, k_cache.dtype)
     ):
         return paged_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,

@@ -47,19 +47,32 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    layers = {
+        "attn_norm": s(None, None),
+        "mlp_norm": s(None, None),
+        "wq": s(None, None, "tp"),
+        "wk": s(None, None, "tp"),
+        "wv": s(None, None, "tp"),
+        "wo": s(None, "tp", None),
+    }
+    if cfg.is_moe:
+        # Expert parallelism: the expert axis shards over the model axis;
+        # the expert-sum contraction becomes a psum over 'tp'.
+        if cfg.num_experts % mesh.shape["tp"]:
+            raise ValueError(
+                f"tp={mesh.shape['tp']} must divide num_experts={cfg.num_experts}"
+            )
+        layers["w_router"] = s(None, None, None)
+        layers["w_gate"] = s(None, "tp", None, None)
+        layers["w_up"] = s(None, "tp", None, None)
+        layers["w_down"] = s(None, "tp", None, None)
+    else:
+        layers["w_gate"] = s(None, None, "tp")
+        layers["w_up"] = s(None, None, "tp")
+        layers["w_down"] = s(None, "tp", None)
     shardings = {
         "embed": s(None, None),
-        "layers": {
-            "attn_norm": s(None, None),
-            "mlp_norm": s(None, None),
-            "wq": s(None, None, "tp"),
-            "wk": s(None, None, "tp"),
-            "wv": s(None, None, "tp"),
-            "wo": s(None, "tp", None),
-            "w_gate": s(None, None, "tp"),
-            "w_up": s(None, None, "tp"),
-            "w_down": s(None, "tp", None),
-        },
+        "layers": layers,
         "final_norm": s(None),
     }
     if not cfg.tie_embeddings:
