@@ -90,17 +90,19 @@ class Sequence:
         return self.prefilled >= self.prompt_len
 
 
-def _sample_from_logits(logits, seeds, counters, temperature, top_k, top_p):
+def _sample_from_logits(
+    logits, seeds, counters, temperature, top_k, top_p, need_mask: bool = True
+):
     base = jax.random.PRNGKey(0)
     keys = jax.vmap(
         lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
     )(seeds, counters)
-    return sample(logits, keys, temperature, top_k, top_p)
+    return sample(logits, keys, temperature, top_k, top_p, need_mask=need_mask)
 
 
 def _decode_chain(
     params, k_cache, v_cache, tokens, block_tables, positions, active,
-    seeds, counters, temperature, top_k, top_p, *, n_steps, cfg, engine,
+    seeds, counters, temperature, top_k, top_p, *, n_steps, need_mask, cfg, engine,
 ):
     """n_steps fused decode+sample iterations in one program: each step
     writes the current token's K/V, attends, samples the next token —
@@ -114,7 +116,7 @@ def _decode_chain(
             params, toks, k, v, block_tables, positions + i * step, active, cfg, engine
         )
         nxt = _sample_from_logits(
-            logits, seeds, counters + i, temperature, top_k, top_p
+            logits, seeds, counters + i, temperature, top_k, top_p, need_mask
         )
         return (nxt, k, v), nxt
 
@@ -181,10 +183,10 @@ class EngineCore:
         )
         self._decode = jax.jit(
             partial(_decode_chain, cfg=model_cfg, engine=engine_cfg),
-            static_argnames=("n_steps",),
+            static_argnames=("n_steps", "need_mask"),
             donate_argnums=(1, 2),
         )
-        self._sample1 = jax.jit(_sample_from_logits)
+        self._sample1 = jax.jit(_sample_from_logits, static_argnames=("need_mask",))
 
     # -- request intake (any thread) --------------------------------------
 
@@ -404,6 +406,9 @@ class EngineCore:
             temp[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
+        need_mask = any(
+            seq.sampling.top_k > 0 or seq.sampling.top_p < 1.0 for seq, _ in pairs
+        )
         toks = self._sample1(
             logits,
             jnp.asarray(seeds),
@@ -411,6 +416,7 @@ class EngineCore:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            need_mask=need_mask,
         )
         return [int(t) for t in np.asarray(toks)[: len(pairs)]]
 
@@ -477,6 +483,9 @@ class EngineCore:
             top_p[i] = seq.sampling.top_p
             seeds[i] = seq.seed
             counters[i] = seq.generated
+        need_mask = any(
+            s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
+        )
         out, self.k_cache, self.v_cache = self._decode(
             self.params,
             self.k_cache,
@@ -491,6 +500,7 @@ class EngineCore:
             jnp.asarray(top_k),
             jnp.asarray(top_p),
             n_steps=n_steps,
+            need_mask=need_mask,
         )
         return np.asarray(out)  # [n_steps, B]
 

@@ -337,14 +337,20 @@ def decode_step_impl(
     cfg: ModelConfig,
     engine: EngineConfig,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (logits [B, vocab] f32, k_cache, v_cache)."""
+    """Returns (logits [B, vocab] f32, k_cache, v_cache).
+
+    The layer scan reads the *old* cache and attends to the current token
+    via an explicit self key/value; the new K/V for every layer scatters
+    into the caches in two bulk writes after the scan (a per-layer scatter
+    inside the loop serializes badly on TPU)."""
     from dynamo_tpu.ops.paged_attention import paged_attention
 
     B = tokens.shape[0]
     x = params["embed"][tokens]  # [B, h]
     slots = _slot_for(block_tables, positions, engine.block_size)  # [B]
     slots = jnp.where(active, slots, engine.total_slots - 1)
-    seq_lens = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    # Cached positions only — the current token rides the self term.
+    seq_lens = jnp.where(active, positions, 0).astype(jnp.int32)
 
     def layer(x, xs):
         lp, k_l, v_l = xs
@@ -356,18 +362,19 @@ def decode_step_impl(
         k = rope(k.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim), positions[:, None], cfg.rope_theta)[:, 0]
         v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
 
-        k_l = k_l.at[:, slots].set(k.transpose(1, 0, 2))
-        v_l = v_l.at[:, slots].set(v.transpose(1, 0, 2))
-
         attn = paged_attention(
-            q, k_l, v_l, block_tables, seq_lens, block_size=engine.block_size
+            q, k_l, v_l, block_tables, seq_lens,
+            block_size=engine.block_size, k_self=k, v_self=v,
         )  # [B, n_q, d]
         attn = attn.reshape(B, cfg.q_size)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
-        return x, (k_l, v_l)
+        return x, (k, v)
 
-    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    # k_new/v_new: [L, B, n_kv, d] -> scatter once per cache.
+    k_cache = k_cache.at[:, :, slots, :].set(k_new.transpose(0, 2, 1, 3))
+    v_cache = v_cache.at[:, :, slots, :].set(v_new.transpose(0, 2, 1, 3))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _logits(x, params, cfg), k_cache, v_cache
 

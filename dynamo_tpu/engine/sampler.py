@@ -4,15 +4,25 @@ Per-request sampling params arrive as arrays (one lane per sequence), so a
 single compiled program serves any mix of greedy and sampled requests —
 no per-request recompiles, no host round trip per token.
 
+Full-vocab sorts are the classic decode-step killer (O(V log V) over 128k
+vocab per token), so masking works on a ``k_cap``-sized `lax.top_k` slice:
+top-k is exact for k <= k_cap and the nucleus is computed within those
+top-k_cap candidates (the standard serving approximation — vLLM caps the
+same way). Batches with no top-k/top-p lanes skip the partial sort
+entirely (``need_mask=False`` — a second compiled variant, chosen by the
+host per batch).
+
 Capability parity: the sampling options the reference extracts in its
-preprocessor (`lib/llm/src/protocols/common`, SamplingOptionsProvider) and
-hands to vLLM; here the sampler is part of the first-party engine.
+preprocessor (`lib/llm/src/protocols/common`) and hands to vLLM; here the
+sampler is part of the first-party engine.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+DEFAULT_TOP_CAP = 64
 
 
 def sample(
@@ -21,33 +31,44 @@ def sample(
     temperature: jax.Array,   # [B] float32; 0 => greedy
     top_k: jax.Array,         # [B] int32; <= 0 => disabled
     top_p: jax.Array,         # [B] float32; >= 1 => disabled
+    *,
+    need_mask: bool = True,   # static: False skips top-k/top-p entirely
+    k_cap: int = DEFAULT_TOP_CAP,
 ) -> jax.Array:               # [B] int32
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    # Sort once (descending); both top-k and top-p become rank masks.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)  # rank of each vocab entry
+    def draw(values: jax.Array) -> jax.Array:
+        if rng.ndim == 2:
+            # Per-lane keys: each request draws from its own seeded
+            # stream, reproducible regardless of batch neighbors.
+            return jax.vmap(jax.random.categorical)(rng, values).astype(jnp.int32)
+        return jax.random.categorical(rng, values, axis=-1).astype(jnp.int32)
 
-    k = jnp.where(top_k > 0, top_k, V)[:, None]
+    if not need_mask:
+        sampled = draw(scaled)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    cap = min(k_cap, V)
+    vals, idx = jax.lax.top_k(scaled, cap)  # [B, cap] descending
+    ranks = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, cap), cap)[:, None]
     keep_k = ranks < k
 
-    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    # Keep every rank whose *previous* cumulative mass is < top_p (always
-    # keeps rank 0), matching standard nucleus sampling.
-    cum_prev = cum - probs_sorted
-    keep_p_sorted = cum_prev < jnp.where(top_p >= 1.0, 2.0, top_p)[:, None]
-    keep_p = jnp.take_along_axis(keep_p_sorted, ranks, axis=-1)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum_prev = jnp.cumsum(probs, axis=-1) - probs
+    # Keep ranks whose preceding cumulative mass is < top_p (rank 0 always).
+    keep_p = cum_prev < jnp.where(top_p >= 1.0, 2.0, top_p)[:, None]
 
-    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
-    if rng.ndim == 2:
-        # Per-lane keys: each request draws from its own seeded stream, so
-        # a seeded request reproduces regardless of its batch neighbors.
-        sampled = jax.vmap(jax.random.categorical)(rng, masked).astype(jnp.int32)
-    else:
-        sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    masked = jnp.where(keep_k & keep_p, vals, -jnp.inf)
+    choice = draw(masked)  # index into the capped candidate set
+    sampled_masked = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    # Pure-temperature lanes in a masked batch keep full-vocab sampling
+    # (categorical is sort-free); only lanes that asked for top-k/top-p
+    # get the capped candidate set.
+    sampled_full = draw(scaled)
+    lane_masked = (top_k > 0) | (top_p < 1.0)
+    sampled = jnp.where(lane_masked, sampled_masked, sampled_full)
     return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -148,12 +148,28 @@ class RadixTree:
 
 class KvIndexer:
     """Event-driven indexer: subscribes to the kv_events subject and applies
-    events to its RadixTree on a single task."""
+    events to its radix tree on a single task.
+
+    Uses the C++ tree (native/radix_tree.cpp via ctypes) when the toolchain
+    can provide it — find_matches is the router's per-request hot loop —
+    falling back to the Python tree (`DYNAMO_TPU_NO_NATIVE=1` forces the
+    fallback)."""
 
     def __init__(self, store, subject: str):
+        import os
+
         self._store = store
         self._subject = subject
-        self.tree = RadixTree()
+        self.tree: RadixTree
+        if os.environ.get("DYNAMO_TPU_NO_NATIVE"):
+            self.tree = RadixTree()
+        else:
+            try:
+                from dynamo_tpu.llm.kv_router.native_radix import NativeRadixTree
+
+                self.tree = NativeRadixTree()  # type: ignore[assignment]
+            except (RuntimeError, OSError):
+                self.tree = RadixTree()
         self._task: asyncio.Task | None = None
         self._sub = None
 

@@ -1,0 +1,31 @@
+"""Tool-call and reasoning parsers (parity: reference lib/parsers)."""
+
+from dynamo_tpu.llm.parsers.reasoning import (
+    GptOssChannelParser,
+    REASONING_PARSERS,
+    ReasoningSplit,
+    StreamingThinkParser,
+    ThinkTagParser,
+    parse_reasoning,
+)
+from dynamo_tpu.llm.parsers.tool_calls import (
+    PARSERS,
+    ParsedMessage,
+    ToolCall,
+    detect_format,
+    parse_tool_calls,
+)
+
+__all__ = [
+    "GptOssChannelParser",
+    "PARSERS",
+    "ParsedMessage",
+    "REASONING_PARSERS",
+    "ReasoningSplit",
+    "StreamingThinkParser",
+    "ThinkTagParser",
+    "ToolCall",
+    "detect_format",
+    "parse_reasoning",
+    "parse_tool_calls",
+]

@@ -36,10 +36,12 @@ def paged_attention_reference(
     k_cache: jax.Array,      # [n_kv, total_slots, d]
     v_cache: jax.Array,      # [n_kv, total_slots, d]
     block_tables: jax.Array, # [B, max_blocks] int32 (padding -> garbage block)
-    seq_lens: jax.Array,     # [B] int32, number of valid tokens incl. current
+    seq_lens: jax.Array,     # [B] int32, cached tokens (excl. self when given)
     *,
     block_size: int,
     scale: float | None = None,
+    k_self: jax.Array | None = None,  # [B, n_kv, d]: the current token's K/V,
+    v_self: jax.Array | None = None,  # attended without being in the cache yet
 ) -> jax.Array:              # [B, n_q, d]
     B, n_q, d = q.shape
     n_kv = k_cache.shape[0]
@@ -58,8 +60,17 @@ def paged_attention_reference(
     logits = jnp.einsum("bhgd,hbsd->bhgs", qg, kf) * scale
     mask = jnp.arange(S, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, S]
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    vf = v.astype(jnp.float32)
+    if k_self is not None:
+        # The self position: one extra key/value, always valid. Keeps the
+        # cache write out of the layer loop (deferred-scatter decode).
+        s_self = jnp.einsum("bhgd,bhd->bhg", qg, k_self.astype(jnp.float32)) * scale
+        logits = jnp.concatenate([logits, s_self[..., None]], axis=-1)
+        vf = jnp.concatenate(
+            [vf, v_self.astype(jnp.float32).transpose(1, 0, 2)[:, :, None, :]], axis=2
+        )
     weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhgs,hbsd->bhgd", weights, v.astype(jnp.float32))
+    out = jnp.einsum("bhgs,hbsd->bhgd", weights, vf)
     return out.reshape(B, n_q, d).astype(q.dtype)
 
 
@@ -71,6 +82,8 @@ def _paged_attn_kernel(
     q_ref,             # [1, 1, group, d] VMEM (this sequence, this kv head)
     k_hbm,             # [n_kv, total_slots, d] ANY/HBM
     v_hbm,
+    k_self_ref,        # [1, 1, d] VMEM — current token's K for this head
+    v_self_ref,
     # output
     o_ref,             # [1, 1, group, d] VMEM
     # scratch
@@ -80,6 +93,7 @@ def _paged_attn_kernel(
     *,
     block_size: int,
     scale: float,
+    with_self: bool,
 ):
     # One grid instance = one (sequence, kv head): all matmuls are plain 2D
     # (Mosaic's tpu.matmul does not support mismatched batch dims).
@@ -150,6 +164,17 @@ def _paged_attn_kernel(
     l0 = jnp.zeros((group, 1), jnp.float32)
     acc0 = jnp.zeros((group, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    if with_self:
+        # Fold in the current token (not yet in the cache): one extra
+        # always-valid position, so deferred-scatter decode stays exact.
+        ks = k_self_ref[0, 0].astype(jnp.float32)   # [d]
+        vs = v_self_ref[0, 0].astype(jnp.float32)
+        s_self = jnp.sum(q * ks[None, :], axis=-1, keepdims=True)  # [group, 1]
+        m_new = jnp.maximum(m, s_self)
+        p = jnp.exp(s_self - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + p
+        acc = acc * alpha + p * vs[None, :]
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
@@ -162,6 +187,8 @@ def paged_attention_pallas(
     *,
     block_size: int,
     scale: float | None = None,
+    k_self: jax.Array | None = None,
+    v_self: jax.Array | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     B, n_q, d = q.shape
@@ -171,11 +198,19 @@ def paged_attention_pallas(
 
     group = n_q // n_kv
     qg = q.reshape(B, n_kv, group, d)
+    with_self = k_self is not None
+    if not with_self:
+        k_self = jnp.zeros((B, n_kv, d), k_cache.dtype)
+        v_self = jnp.zeros((B, n_kv, d), v_cache.dtype)
 
     kernel = functools.partial(
         _paged_attn_kernel,
         block_size=block_size,
         scale=scale,
+        with_self=with_self,
+    )
+    self_spec = pl.BlockSpec(
+        (1, 1, d), lambda b, h, *_: (b, h, 0), memory_space=pltpu.VMEM
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -186,6 +221,8 @@ def paged_attention_pallas(
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            self_spec,
+            self_spec,
         ],
         out_specs=pl.BlockSpec(
             (1, 1, group, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
@@ -201,7 +238,10 @@ def paged_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, k_cache, v_cache)
+    )(
+        block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+        qg, k_cache, v_cache, k_self, v_self,
+    )
     return out.reshape(B, n_q, d)
 
 
@@ -214,7 +254,8 @@ def pallas_supported(head_dim: int, block_size: int, dtype) -> bool:
 
 
 def paged_attention(
-    q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None
+    q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None,
+    k_self=None, v_self=None,
 ) -> jax.Array:
     """Dispatch to the Pallas kernel on TPU (tiling permitting), the XLA
     reference elsewhere — e.g. head_dim < 128 models.
@@ -227,9 +268,9 @@ def paged_attention(
     ):
         return paged_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,
-            block_size=block_size, scale=scale,
+            block_size=block_size, scale=scale, k_self=k_self, v_self=v_self,
         )
     return paged_attention_reference(
         q, k_cache, v_cache, block_tables, seq_lens,
-        block_size=block_size, scale=scale,
+        block_size=block_size, scale=scale, k_self=k_self, v_self=v_self,
     )

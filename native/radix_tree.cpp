@@ -1,0 +1,184 @@
+// Native radix prefix index — the KV router's hot loop in C++.
+//
+// Same semantics as the Python RadixTree (dynamo_tpu/llm/kv_router/
+// indexer.py), which itself mirrors the reference's Rust RadixTree
+// (lib/llm/src/kv_router/indexer.rs:222-747): chained block hashes flatten
+// the radix tree into a hash -> node map; find_matches scores each worker
+// by contiguous leading blocks held; removed blocks prune their orphaned
+// subtree; per-worker event ids deduplicate replays.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+// Single-writer discipline is preserved by the Python owner: only the
+// indexer's event task calls mutating functions.
+
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+    std::unordered_set<int64_t> workers;
+    uint64_t parent = 0;
+    bool has_parent = false;
+    std::unordered_set<uint64_t> children;
+};
+
+struct Tree {
+    std::unordered_map<uint64_t, Node> nodes;
+    std::unordered_map<int64_t, int64_t> last_event_id;
+
+    bool dedup(int64_t worker, int64_t event_id) {
+        auto it = last_event_id.find(worker);
+        if (it != last_event_id.end() && event_id <= it->second) return true;
+        last_event_id[worker] = event_id;
+        return false;
+    }
+
+    void prune(uint64_t h) {
+        auto it = nodes.find(h);
+        if (it == nodes.end() || !it->second.workers.empty()) return;
+        // Iterative DFS over the orphaned subtree.
+        std::vector<uint64_t> stack{h};
+        std::vector<uint64_t> order;
+        while (!stack.empty()) {
+            uint64_t cur = stack.back();
+            stack.pop_back();
+            auto nit = nodes.find(cur);
+            if (nit == nodes.end() || !nit->second.workers.empty()) continue;
+            order.push_back(cur);
+            for (uint64_t c : nit->second.children) stack.push_back(c);
+        }
+        for (uint64_t cur : order) {
+            auto nit = nodes.find(cur);
+            if (nit == nodes.end()) continue;
+            if (nit->second.has_parent) {
+                auto pit = nodes.find(nit->second.parent);
+                if (pit != nodes.end()) pit->second.children.erase(cur);
+            }
+            nodes.erase(nit);
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* radix_new() { return new Tree(); }
+
+void radix_free(void* t) { delete static_cast<Tree*>(t); }
+
+void radix_apply_stored(void* tp, int64_t worker, int64_t event_id,
+                        const uint64_t* hashes, int32_t n, uint64_t parent,
+                        int32_t has_parent) {
+    Tree* t = static_cast<Tree*>(tp);
+    if (t->dedup(worker, event_id)) return;
+    bool hp = has_parent != 0;
+    uint64_t p = parent;
+    for (int32_t i = 0; i < n; ++i) {
+        uint64_t h = hashes[i];
+        auto it = t->nodes.find(h);
+        if (it == t->nodes.end()) {
+            Node node;
+            node.parent = p;
+            node.has_parent = hp;
+            it = t->nodes.emplace(h, std::move(node)).first;
+            if (hp) {
+                auto pit = t->nodes.find(p);
+                if (pit != t->nodes.end()) pit->second.children.insert(h);
+            }
+        }
+        it->second.workers.insert(worker);
+        p = h;
+        hp = true;
+    }
+}
+
+void radix_apply_removed(void* tp, int64_t worker, int64_t event_id,
+                         const uint64_t* hashes, int32_t n) {
+    Tree* t = static_cast<Tree*>(tp);
+    if (t->dedup(worker, event_id)) return;
+    for (int32_t i = 0; i < n; ++i) {
+        auto it = t->nodes.find(hashes[i]);
+        if (it == t->nodes.end()) continue;
+        it->second.workers.erase(worker);
+        if (it->second.workers.empty()) t->prune(hashes[i]);
+    }
+}
+
+void radix_remove_worker(void* tp, int64_t worker) {
+    Tree* t = static_cast<Tree*>(tp);
+    std::vector<uint64_t> dead;
+    for (auto& [h, node] : t->nodes) {
+        if (node.workers.erase(worker)) {
+            if (node.workers.empty()) dead.push_back(h);
+        }
+    }
+    for (uint64_t h : dead) t->prune(h);
+    t->last_event_id.erase(worker);
+}
+
+// Per-worker contiguous-prefix depths. Writes up to `cap` (worker, depth)
+// pairs; returns the count.
+int32_t radix_find_matches(void* tp, const uint64_t* hashes, int32_t n,
+                           int64_t* out_workers, int32_t* out_depths,
+                           int32_t cap) {
+    Tree* t = static_cast<Tree*>(tp);
+    std::unordered_map<int64_t, int32_t> scores;
+    std::unordered_set<int64_t> alive;
+    bool first = true;
+    for (int32_t depth = 1; depth <= n; ++depth) {
+        auto it = t->nodes.find(hashes[depth - 1]);
+        if (it == t->nodes.end() || it->second.workers.empty()) break;
+        std::unordered_set<int64_t> present;
+        if (first) {
+            present = it->second.workers;
+        } else {
+            for (int64_t w : alive)
+                if (it->second.workers.count(w)) present.insert(w);
+        }
+        if (present.empty()) break;
+        for (int64_t w : present) scores[w] = depth;
+        alive = std::move(present);
+        first = false;
+    }
+    int32_t i = 0;
+    for (auto& [w, d] : scores) {
+        if (i >= cap) break;
+        out_workers[i] = w;
+        out_depths[i] = d;
+        ++i;
+    }
+    return i;
+}
+
+int32_t radix_num_blocks(void* tp, int64_t worker) {
+    Tree* t = static_cast<Tree*>(tp);
+    if (worker < 0) return static_cast<int32_t>(t->nodes.size());
+    int32_t n = 0;
+    for (auto& [h, node] : t->nodes)
+        if (node.workers.count(worker)) ++n;
+    return n;
+}
+
+// Dump one worker's blocks for replica re-sync. Writes up to `cap`
+// (hash, parent, has_parent) triples; returns the count.
+int32_t radix_dump_worker(void* tp, int64_t worker, uint64_t* out_hashes,
+                          uint64_t* out_parents, int32_t* out_has_parent,
+                          int32_t cap) {
+    Tree* t = static_cast<Tree*>(tp);
+    int32_t i = 0;
+    for (auto& [h, node] : t->nodes) {
+        if (!node.workers.count(worker)) continue;
+        if (i >= cap) break;
+        out_hashes[i] = h;
+        out_parents[i] = node.parent;
+        out_has_parent[i] = node.has_parent ? 1 : 0;
+        ++i;
+    }
+    return i;
+}
+
+}  // extern "C"

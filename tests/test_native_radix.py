@@ -1,0 +1,89 @@
+"""C++ radix index vs Python RadixTree: behavioral parity under fuzzing."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv_router.indexer import RadixTree
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
+
+native = pytest.importorskip("dynamo_tpu.llm.kv_router.native_radix")
+if not native.native_available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+from dynamo_tpu.llm.kv_router.native_radix import NativeRadixTree  # noqa: E402
+
+
+def stored(worker, eid, hashes, parent=None):
+    return RouterEvent(worker, eid, KvCacheEvent(op="stored", block_hashes=tuple(hashes), parent_hash=parent))
+
+
+def removed(worker, eid, hashes):
+    return RouterEvent(worker, eid, KvCacheEvent(op="removed", block_hashes=tuple(hashes)))
+
+
+def test_basic_parity():
+    py, cc = RadixTree(), NativeRadixTree()
+    chain = [101, 202, 303, 404]
+    for t in (py, cc):
+        t.apply_event(stored(1, 1, chain))
+        t.apply_event(stored(2, 1, chain[:2]))
+    assert cc.find_matches(chain) == py.find_matches(chain) == {1: 4, 2: 2}
+    for t in (py, cc):
+        t.apply_event(removed(1, 2, [202]))  # prunes 303/404 for worker 1
+    assert cc.find_matches(chain) == py.find_matches(chain)
+    assert cc.num_blocks() == py.num_blocks()
+
+
+def test_event_id_dedup_parity():
+    py, cc = RadixTree(), NativeRadixTree()
+    for t in (py, cc):
+        t.apply_event(stored(1, 5, [7, 8]))
+        t.apply_event(removed(1, 5, [7]))   # same event id: ignored
+    assert cc.find_matches([7, 8]) == py.find_matches([7, 8]) == {1: 2}
+
+
+def test_remove_worker_parity():
+    py, cc = RadixTree(), NativeRadixTree()
+    for t in (py, cc):
+        t.apply_event(stored(1, 1, [1, 2, 3]))
+        t.apply_event(stored(2, 1, [1, 2]))
+        t.remove_worker(1)
+    assert cc.find_matches([1, 2, 3]) == py.find_matches([1, 2, 3]) == {2: 2}
+    assert cc.num_blocks() == py.num_blocks() == 2
+
+
+def test_dump_parity():
+    py, cc = RadixTree(), NativeRadixTree()
+    for t in (py, cc):
+        t.apply_event(stored(3, 1, [11, 22, 33]))
+    py_dump = {(e.event.block_hashes[0], e.event.parent_hash) for e in py.dump_as_events(3)}
+    cc_dump = {(e.event.block_hashes[0], e.event.parent_hash) for e in cc.dump_as_events(3)}
+    assert cc_dump == py_dump
+
+
+def test_fuzz_parity():
+    rng = random.Random(42)
+    py, cc = RadixTree(), NativeRadixTree()
+    eid = {w: 0 for w in range(4)}
+    chains = [[rng.getrandbits(63) for _ in range(rng.randint(1, 10))] for _ in range(20)]
+    for step in range(400):
+        w = rng.randrange(4)
+        eid[w] += 1
+        chain = rng.choice(chains)
+        cut = rng.randint(1, len(chain))
+        if rng.random() < 0.6:
+            ev = stored(w, eid[w], chain[:cut])
+        elif rng.random() < 0.9:
+            ev = removed(w, eid[w], rng.sample(chain, min(len(chain), rng.randint(1, 3))))
+        else:
+            py.remove_worker(w)
+            cc.remove_worker(w)
+            continue
+        py.apply_event(ev)
+        cc.apply_event(ev)
+        if step % 20 == 0:
+            probe = rng.choice(chains)
+            assert cc.find_matches(probe) == py.find_matches(probe), f"step {step}"
+    for w in range(4):
+        assert cc.num_blocks(w) == py.num_blocks(w)

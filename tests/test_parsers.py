@@ -1,0 +1,121 @@
+"""Tool-call and reasoning parser tests (parity: reference lib/parsers)."""
+
+import pytest
+
+from dynamo_tpu.llm.parsers import (
+    StreamingThinkParser,
+    detect_format,
+    parse_reasoning,
+    parse_tool_calls,
+)
+
+
+def test_hermes():
+    text = 'Sure!\n<tool_call>\n{"name": "get_weather", "arguments": {"city": "SF"}}\n</tool_call>'
+    out = parse_tool_calls(text, "hermes")
+    assert out.content == "Sure!"
+    assert out.tool_calls[0].name == "get_weather"
+    assert out.tool_calls[0].arguments == {"city": "SF"}
+    assert out.tool_calls[0].to_openai()["function"]["name"] == "get_weather"
+
+
+def test_hermes_multiple_calls():
+    text = (
+        '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+        '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>'
+    )
+    out = parse_tool_calls(text, "hermes")
+    assert [c.name for c in out.tool_calls] == ["a", "b"]
+    assert out.content is None
+
+
+def test_mistral():
+    text = '[TOOL_CALLS][{"name": "search", "arguments": {"q": "tpu"}}]'
+    out = parse_tool_calls(text, "mistral")
+    assert out.tool_calls[0].name == "search"
+    assert out.content is None
+
+
+def test_llama3_json():
+    text = '<|python_tag|>{"name": "lookup", "parameters": {"id": 7}}'
+    out = parse_tool_calls(text, "llama3_json")
+    assert out.tool_calls[0].name == "lookup"
+    assert out.tool_calls[0].arguments == {"id": 7}
+
+
+def test_pythonic():
+    out = parse_tool_calls('[get_weather(city="SF", units="c"), ping()]', "pythonic")
+    assert [c.name for c in out.tool_calls] == ["get_weather", "ping"]
+    assert out.tool_calls[0].arguments == {"city": "SF", "units": "c"}
+
+
+def test_pythonic_rejects_non_calls():
+    out = parse_tool_calls("[1, 2, 3]", "pythonic")
+    assert out.tool_calls == []
+    assert out.content == "[1, 2, 3]"
+
+
+def test_nemotron():
+    text = '<TOOLCALL>[{"name": "f", "arguments": {"k": 2}}]</TOOLCALL>'
+    out = parse_tool_calls(text, "nemotron")
+    assert out.tool_calls[0].arguments == {"k": 2}
+
+
+def test_json_arguments_as_string():
+    text = '{"name": "f", "arguments": "{\\"a\\": 1}"}'
+    out = parse_tool_calls(text, "json")
+    assert out.tool_calls[0].arguments == {"a": 1}
+
+
+def test_detect_format():
+    assert detect_format("<tool_call>{}</tool_call>") == "hermes"
+    assert detect_format("[TOOL_CALLS][]") == "mistral"
+    assert detect_format('{"name": "x", "arguments": {}}') == "json"
+    assert detect_format("plain text answer") is None
+
+
+def test_unknown_parser_raises():
+    with pytest.raises(ValueError):
+        parse_tool_calls("x", "nope")
+
+
+def test_reasoning_think_tags():
+    out = parse_reasoning("<think>step 1. step 2.</think>The answer is 4.", "deepseek_r1")
+    assert out.reasoning_content == "step 1. step 2."
+    assert out.content == "The answer is 4."
+
+
+def test_reasoning_missing_open_tag():
+    out = parse_reasoning("reasoning here</think>answer", "deepseek_r1")
+    assert out.reasoning_content == "reasoning here"
+    assert out.content == "answer"
+
+
+def test_reasoning_gpt_oss_channels():
+    text = "<|channel|>analysis\nlet me think<|channel|>final\n42"
+    out = parse_reasoning(text, "gpt_oss")
+    assert out.reasoning_content == "let me think"
+    assert out.content == "42"
+
+
+def test_streaming_think_parser():
+    p = StreamingThinkParser()
+    chunks = ["<thi", "nk>ab", "c</th", "ink>he", "llo"]
+    reasoning, content = "", ""
+    for c in chunks:
+        r, t = p.feed(c)
+        reasoning += r
+        content += t
+    r, t = p.flush()
+    reasoning += r
+    content += t
+    assert reasoning == "abc"
+    assert content == "hello"
+
+
+def test_streaming_without_think():
+    p = StreamingThinkParser()
+    r, t = p.feed("just an answer")
+    r2, t2 = p.flush()
+    assert r + r2 == ""
+    assert t + t2 == "just an answer"
