@@ -1,0 +1,54 @@
+"""Sinusoidal load traces for planner dry runs and elasticity tests.
+
+Capability parity: reference `benchmarks/sin_load_generator/sin_synth.py` —
+request-rate (and optionally ISL/OSL) traces shaped as offset sinusoids,
+emitted as (timestamp, rate) pairs or expanded to request arrival times.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class SinLoadConfig:
+    duration_s: float = 600.0
+    period_s: float = 300.0
+    mean_rps: float = 5.0
+    amplitude_rps: float = 4.0
+    tick_s: float = 10.0
+    # Optional sinusoidal ISL/OSL modulation (None = constant).
+    mean_isl: int = 512
+    mean_osl: int = 128
+    seed: int = 0
+
+
+def rate_trace(cfg: SinLoadConfig | None = None) -> list[tuple[float, float]]:
+    cfg = cfg or SinLoadConfig()
+    out = []
+    t = 0.0
+    while t < cfg.duration_s:
+        rate = cfg.mean_rps + cfg.amplitude_rps * math.sin(2 * math.pi * t / cfg.period_s)
+        out.append((t, max(0.0, rate)))
+        t += cfg.tick_s
+    return out
+
+
+def arrival_times(cfg: SinLoadConfig | None = None) -> list[float]:
+    """Poisson arrivals following the sinusoidal intensity."""
+    cfg = cfg or SinLoadConfig()
+    rng = random.Random(cfg.seed)
+    arrivals: list[float] = []
+    for t0, rate in rate_trace(cfg):
+        n = 0
+        t = t0
+        end = t0 + cfg.tick_s
+        while rate > 0:
+            t += rng.expovariate(rate)
+            if t >= end:
+                break
+            arrivals.append(t)
+            n += 1
+    return arrivals

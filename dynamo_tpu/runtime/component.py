@@ -85,6 +85,9 @@ class DistributedRuntime:
         self.primary_lease_id = lease_id
         self.ingress = IngressServer(host=ingress_host)
         self.egress = EgressClient()
+        # Optional per-process status server (worker.py starts it from
+        # DYN_SYSTEM_* config); endpoints report health into it on serve.
+        self.status = None
         self._ingress_started = False
         self._ingress_lock = asyncio.Lock()
         self._shutdown = asyncio.Event()
@@ -176,6 +179,8 @@ class Endpoint:
         """
         ingress = await self.runtime.ensure_ingress()
         ingress.register(self.path, handler)
+        if self.runtime.status is not None:
+            self.runtime.status.set_endpoint_health(self.path, True)
         inst = Instance(
             namespace=self.namespace,
             component=self.component,

@@ -1,0 +1,79 @@
+"""Per-worker system status server: /health, /live, /metrics.
+
+Capability parity: reference `lib/runtime/src/system_status_server.rs:31-712`
+(axum server per process; per-endpoint health states; uptime gauge;
+Prometheus text). Enabled through `DYN_SYSTEM_ENABLED` / `DYN_SYSTEM_PORT`
+(`config.rs` DYN_SYSTEM_* prefix).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+log = logging.getLogger("dynamo_tpu.status")
+
+
+class SystemStatusServer:
+    def __init__(
+        self,
+        metrics: MetricsRegistry | None = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.metrics = metrics or MetricsRegistry()
+        self.host = host
+        self.port = port
+        self._started_at = time.monotonic()
+        # endpoint path -> "ready" | "notready"
+        self.endpoint_health: dict[str, str] = {}
+        self.app = web.Application()
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/live", self.live)
+        self.app.router.add_get("/metrics", self.prometheus)
+        self._runner: web.AppRunner | None = None
+
+    def set_endpoint_health(self, path: str, ready: bool) -> None:
+        self.endpoint_health[path] = "ready" if ready else "notready"
+
+    @property
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._started_at
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for addr in self._runner.addresses:
+            self.port = addr[1]
+        log.info("status server on http://%s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def health(self, request: web.Request) -> web.Response:
+        ready = all(s == "ready" for s in self.endpoint_health.values())
+        status = "healthy" if ready and self.endpoint_health else "starting"
+        return web.json_response(
+            {
+                "status": status,
+                "uptime_s": round(self.uptime_s, 3),
+                "endpoints": dict(self.endpoint_health),
+            },
+            status=200 if status == "healthy" else 503,
+        )
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        self.metrics.scoped(service="system").gauge("system_uptime_seconds").set(
+            self.uptime_s
+        )
+        return web.Response(body=self.metrics.render(), content_type="text/plain")

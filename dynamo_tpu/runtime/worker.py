@@ -43,6 +43,11 @@ async def _run(fn: Callable[..., Awaitable[Any]], cfg: RuntimeConfig, *args, **k
     runtime = await DistributedRuntime.create(
         cfg.store_address, lease_ttl=cfg.lease_ttl_s, ingress_host=cfg.ingress_host
     )
+    if cfg.system_enabled:
+        from dynamo_tpu.runtime.status_server import SystemStatusServer
+
+        runtime.status = SystemStatusServer(port=cfg.system_port)
+        await runtime.status.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
@@ -52,4 +57,6 @@ async def _run(fn: Callable[..., Awaitable[Any]], cfg: RuntimeConfig, *args, **k
     try:
         return await fn(runtime, *args, **kwargs)
     finally:
+        if runtime.status is not None:
+            await runtime.status.stop()
         await runtime.shutdown()

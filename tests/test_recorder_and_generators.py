@@ -1,0 +1,70 @@
+"""KV-event recorder round trip + workload generators."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.prefix_synthesizer import (  # noqa: E402
+    PrefixWorkloadConfig,
+    analyze_prefix_reuse,
+    synthesize,
+)
+from benchmarks.sin_load import SinLoadConfig, arrival_times, rate_trace  # noqa: E402
+from dynamo_tpu.llm.kv_router.indexer import RadixTree  # noqa: E402
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent  # noqa: E402
+from dynamo_tpu.llm.kv_router.recorder import (  # noqa: E402
+    KvEventRecorder,
+    replay_events,
+    replay_into,
+)
+
+
+def _stored(worker, eid, hashes, parent=None):
+    return RouterEvent(worker, eid, KvCacheEvent("stored", tuple(hashes), parent))
+
+
+def test_recorder_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    events = [
+        _stored(1, 1, [10, 20, 30]),
+        _stored(2, 1, [10, 20]),
+        RouterEvent(1, 2, KvCacheEvent("removed", (30,), None)),
+    ]
+    with KvEventRecorder(path) as rec:
+        for ev in events:
+            rec.record(ev)
+    assert rec.recorded == 3
+
+    replayed = [ev for _, ev in replay_events(path)]
+    assert replayed == events
+
+    tree = RadixTree()
+    assert replay_into(path, tree) == 3
+    assert tree.find_matches([10, 20, 30]) == {1: 2, 2: 2}
+
+
+def test_prefix_synthesizer_produces_shared_prefixes():
+    wl = synthesize(PrefixWorkloadConfig(num_requests=50, seed=3))
+    assert len(wl.prompts) == 50
+    stats = analyze_prefix_reuse(wl.prompts, block_size=32)
+    # Radix-shaped corpus: substantial reuse, but suffixes stay unique.
+    assert stats["reuse_fraction"] > 0.3
+    assert stats["unique_blocks"] < stats["total_blocks"]
+
+
+def test_prefix_synthesizer_deterministic():
+    a = synthesize(PrefixWorkloadConfig(num_requests=10, seed=7))
+    b = synthesize(PrefixWorkloadConfig(num_requests=10, seed=7))
+    assert a.prompts == b.prompts
+
+
+def test_sin_load_trace_shape():
+    cfg = SinLoadConfig(duration_s=300, period_s=300, mean_rps=5, amplitude_rps=4)
+    trace = rate_trace(cfg)
+    rates = [r for _, r in trace]
+    assert max(rates) > 8
+    assert min(rates) < 2
+    arr = arrival_times(cfg)
+    assert len(arr) > 0
+    assert all(arr[i] <= arr[i + 1] for i in range(len(arr) - 1))

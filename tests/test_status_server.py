@@ -1,0 +1,40 @@
+"""System status server: health transitions, liveness, prometheus text."""
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.status_server import SystemStatusServer
+
+pytestmark = [pytest.mark.unit]
+
+
+async def test_status_server_lifecycle():
+    srv = SystemStatusServer(host="127.0.0.1", port=0)
+    await srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 503  # no endpoints yet -> starting
+                body = await r.json()
+                assert body["status"] == "starting"
+
+            srv.set_endpoint_health("/dynamo/backend/generate", True)
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["status"] == "healthy"
+                assert body["endpoints"]["/dynamo/backend/generate"] == "ready"
+
+            srv.set_endpoint_health("/dynamo/backend/generate", False)
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 503
+
+            async with s.get(f"{base}/live") as r:
+                assert (await r.json())["status"] == "live"
+
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+                assert "system_uptime_seconds" in text
+    finally:
+        await srv.stop()
