@@ -82,8 +82,8 @@ def _paged_attn_kernel(
     q_ref,             # [1, 1, group, d] VMEM (this sequence, this kv head)
     k_hbm,             # [n_kv, total_slots, d] ANY/HBM
     v_hbm,
-    k_self_ref,        # [1, n_kv, d] VMEM — current token's K (all heads;
-    v_self_ref,        #   per-head slicing happens in-kernel for tiling)
+    k_self_ref,        # [1, 1, 1, d] VMEM — current token's K, this head
+    v_self_ref,
     # output
     o_ref,             # [1, 1, group, d] VMEM
     # scratch
@@ -167,9 +167,8 @@ def _paged_attn_kernel(
     if with_self:
         # Fold in the current token (not yet in the cache): one extra
         # always-valid position, so deferred-scatter decode stays exact.
-        h = pl.program_id(1)
-        ks = k_self_ref[0, h].astype(jnp.float32)   # [d]
-        vs = v_self_ref[0, h].astype(jnp.float32)
+        ks = k_self_ref[0, 0, 0].astype(jnp.float32)   # [d]
+        vs = v_self_ref[0, 0, 0].astype(jnp.float32)
         s_self = jnp.sum(q * ks[None, :], axis=-1, keepdims=True)  # [group, 1]
         m_new = jnp.maximum(m, s_self)
         p = jnp.exp(s_self - m_new)
@@ -203,6 +202,10 @@ def paged_attention_pallas(
     if not with_self:
         k_self = jnp.zeros((B, n_kv, d), k_cache.dtype)
         v_self = jnp.zeros((B, n_kv, d), v_cache.dtype)
+    # 4D so the tiled trailing dims are (1, d) == the array dims — the
+    # head index stays on an untiled axis (Mosaic alignment rules).
+    k_self4 = k_self.reshape(B, n_kv, 1, d)
+    v_self4 = v_self.reshape(B, n_kv, 1, d)
 
     kernel = functools.partial(
         _paged_attn_kernel,
@@ -210,11 +213,8 @@ def paged_attention_pallas(
         scale=scale,
         with_self=with_self,
     )
-    # Full n_kv in the block: (1, 1, d) would violate TPU tiling (middle
-    # dim must divide 8 or equal the array dim); the head is picked
-    # in-kernel instead.
     self_spec = pl.BlockSpec(
-        (1, n_kv, d), lambda b, h, *_: (b, 0, 0), memory_space=pltpu.VMEM
+        (1, 1, 1, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -244,7 +244,7 @@ def paged_attention_pallas(
         interpret=interpret,
     )(
         block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-        qg, k_cache, v_cache, k_self, v_self,
+        qg, k_cache, v_cache, k_self4, v_self4,
     )
     return out.reshape(B, n_q, d)
 

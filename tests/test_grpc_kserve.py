@@ -1,0 +1,98 @@
+"""KServe gRPC frontend e2e against a mocker worker."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from dynamo_tpu.backends.mocker import run_mocker
+from dynamo_tpu.grpc import kserve_pb2 as pb
+from dynamo_tpu.grpc.kserve_service import KserveGrpcService
+from dynamo_tpu.llm.mocker import MockEngineArgs
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.e2e]
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _method(channel, name, req_cls, resp_cls):
+    return channel.unary_unary(
+        f"/{SERVICE}/{name}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+async def test_kserve_grpc_end_to_end():
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    front_rt = await DistributedRuntime.create(store.address)
+    served = asyncio.Event()
+    worker = asyncio.create_task(
+        run_mocker(
+            worker_rt, model_name="mock",
+            engine_args=MockEngineArgs(speedup_ratio=200.0),
+            served_event=served,
+        )
+    )
+    await asyncio.wait_for(served.wait(), 10)
+
+    manager = ModelManager(front_rt, router_mode="kv")
+    await manager.start()
+    for _ in range(100):
+        if manager.list_models():
+            break
+        await asyncio.sleep(0.05)
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    await svc.start()
+
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{svc.port}") as ch:
+            live = await _method(ch, "ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse)(
+                pb.ServerLiveRequest()
+            )
+            assert live.live
+
+            ready = await _method(ch, "ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse)(
+                pb.ServerReadyRequest()
+            )
+            assert ready.ready
+
+            mready = await _method(ch, "ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse)(
+                pb.ModelReadyRequest(name="mock")
+            )
+            assert mready.ready
+
+            req = pb.ModelInferRequest(model_name="mock", id="t1")
+            tensor = req.inputs.add()
+            tensor.name = "text_input"
+            tensor.datatype = "BYTES"
+            tensor.shape.append(1)
+            tensor.contents.bytes_contents.append(b"hello kserve")
+            req.parameters["max_tokens"].int64_param = 6
+            infer = _method(ch, "ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)
+            resp = await infer(req)
+            assert resp.model_name == "mock"
+            out = resp.outputs[0]
+            assert out.name == "text_output"
+            assert out.contents.bytes_contents[0] == b"abcdef"  # mocker text
+
+            missing = _method(ch, "ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse)
+            r = await missing(pb.ModelReadyRequest(name="nope"))
+            assert not r.ready
+    finally:
+        await svc.stop()
+        await manager.stop()
+        for rt in (front_rt, worker_rt):
+            rt.signal_shutdown()
+        worker.cancel()
+        for rt in (front_rt, worker_rt):
+            try:
+                await rt.shutdown()
+            except Exception:
+                pass
+        await store.stop()
