@@ -1,0 +1,119 @@
+"""dynamo-tpu doctor: environment and cluster diagnostics.
+
+Capability parity: reference `deploy/dynamo_check.py:68-318` (env/GPU/
+install doctor) — checks the Python stack, JAX devices, the native
+library, the control-plane store, and live workers, and prints one line
+per check.
+
+    python -m dynamo_tpu.check [--store-address HOST:PORT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import sys
+
+
+def _line(ok: bool, label: str, detail: str = "") -> bool:
+    mark = "ok " if ok else "FAIL"
+    print(f"[{mark}] {label}" + (f" — {detail}" if detail else ""))
+    return ok
+
+
+def check_imports() -> bool:
+    ok = True
+    for mod in ("jax", "numpy", "aiohttp", "msgpack", "xxhash", "pydantic", "grpc"):
+        try:
+            importlib.import_module(mod)
+            _line(True, f"import {mod}")
+        except ImportError as e:
+            ok = _line(False, f"import {mod}", str(e))
+    return ok
+
+
+def check_jax() -> bool:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return _line(True, "jax devices", f"{jax.default_backend()}: {len(devs)}x {devs[0].device_kind}")
+    except Exception as e:  # noqa: BLE001
+        return _line(False, "jax devices", str(e))
+
+
+def check_native() -> bool:
+    try:
+        from dynamo_tpu.llm.kv_router.native_radix import native_available
+
+        if native_available():
+            return _line(True, "native radix index (C++)")
+        return _line(True, "native radix index", "unavailable; Python fallback active")
+    except Exception as e:  # noqa: BLE001
+        return _line(False, "native radix index", str(e))
+
+
+def check_engine() -> bool:
+    try:
+        from dynamo_tpu.engine import EngineCore, tiny_engine, tiny_model
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        core = EngineCore(tiny_model(), tiny_engine(), seed=0)
+        core.add_request(
+            PreprocessedRequest(
+                model="doctor", token_ids=[1, 2, 3], request_id="doctor",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=2),
+            )
+        )
+        toks = 0
+        for _ in range(50):
+            for _, out in core.step():
+                toks += len(out.token_ids)
+            if not core.has_work():
+                break
+        return _line(toks >= 2, "engine smoke (tiny model, 2 tokens)", f"{toks} tokens")
+    except Exception as e:  # noqa: BLE001
+        return _line(False, "engine smoke", str(e))
+
+
+async def check_store(address: str | None) -> bool:
+    if not address:
+        return _line(True, "store", "skipped (no --store-address)")
+    try:
+        from dynamo_tpu.llm.discovery import MODEL_ROOT
+        from dynamo_tpu.runtime.store.client import StoreClient
+
+        client = await asyncio.wait_for(StoreClient.open(address), 5)
+        entries = await client.kv_get_prefix(MODEL_ROOT + "/")
+        instances = await client.kv_get_prefix("/dynamo/instances/")
+        await client.close()
+        return _line(
+            True, "store", f"{address}: {len(entries)} models, {len(instances)} instances"
+        )
+    except Exception as e:  # noqa: BLE001
+        return _line(False, f"store {address}", str(e))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu environment doctor")
+    ap.add_argument("--store-address", default=None)
+    ap.add_argument("--skip-engine", action="store_true")
+    args = ap.parse_args()
+
+    ok = check_imports()
+    ok &= check_jax()
+    ok &= check_native()
+    if not args.skip_engine:
+        ok &= check_engine()
+    ok &= asyncio.run(check_store(args.store_address))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -261,13 +261,15 @@ def paged_attention(
     q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None,
     k_self=None, v_self=None,
 ) -> jax.Array:
-    """Dispatch to the Pallas kernel on TPU (tiling permitting), the XLA
-    reference elsewhere — e.g. head_dim < 128 models.
-
-    ``DYNAMO_TPU_PAGED_ATTN=xla`` forces the gather path on TPU (A/B knob)."""
+    """Dispatch: XLA gather path by default — measured faster than the
+    current Pallas kernel at serving context lengths (the kernel's
+    (batch x head) grid runs serially per TensorCore; its page DMAs are
+    latency-bound). ``DYNAMO_TPU_PAGED_ATTN=pallas`` opts into the kernel
+    (wins when live context is a small fraction of the table span; also
+    the base for the next-round ragged multi-page kernel)."""
     if (
         jax.default_backend() == "tpu"
-        and os.environ.get("DYNAMO_TPU_PAGED_ATTN", "pallas") != "xla"
+        and os.environ.get("DYNAMO_TPU_PAGED_ATTN", "xla") == "pallas"
         and pallas_supported(q.shape[-1], block_size, k_cache.dtype)
     ):
         return paged_attention_pallas(

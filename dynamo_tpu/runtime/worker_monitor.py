@@ -1,0 +1,71 @@
+"""Worker load monitor: mark workers busy above a KV-usage threshold.
+
+Capability parity: reference `lib/runtime/src/utils/worker_monitor.rs:50-89`
+— the frontend watches per-worker ForwardPassMetrics and routes around
+workers whose KV usage exceeds ``busy_threshold`` (busy-aware routing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, load_metrics_subject
+
+log = logging.getLogger("dynamo_tpu.worker_monitor")
+
+
+class WorkerMonitor:
+    def __init__(
+        self,
+        store,
+        namespace: str,
+        component: str,
+        busy_threshold: float = 0.95,
+        on_busy_change: Callable[[int, bool], None] | None = None,
+    ):
+        self.store = store
+        self.subject = load_metrics_subject(namespace, component)
+        self.busy_threshold = busy_threshold
+        self.on_busy_change = on_busy_change or (lambda w, b: None)
+        self.metrics: dict[int, ForwardPassMetrics] = {}
+        self.busy: set[int] = set()
+        self._task: asyncio.Task | None = None
+        self._sub = None
+
+    async def start(self) -> None:
+        self._sub = await self.store.subscribe(self.subject)
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.unsubscribe()
+
+    async def _loop(self) -> None:
+        assert self._sub is not None
+        async for msg in self._sub:
+            try:
+                fpm = ForwardPassMetrics.from_wire(msg["p"])
+            except Exception:  # noqa: BLE001
+                continue
+            worker_id = fpm.worker_id
+            self.metrics[worker_id] = fpm
+            usage = fpm.kv.gpu_cache_usage_perc
+            was_busy = worker_id in self.busy
+            now_busy = usage >= self.busy_threshold
+            if now_busy != was_busy:
+                (self.busy.add if now_busy else self.busy.discard)(worker_id)
+                log.info("worker %d busy=%s (kv %.0f%%)", worker_id, now_busy, usage * 100)
+                self.on_busy_change(worker_id, now_busy)
+
+    def eligible(self, workers: list[int]) -> list[int]:
+        """Filter busy workers out (all-busy falls back to the full set)."""
+        free = [w for w in workers if w not in self.busy]
+        return free or workers
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.metrics.pop(worker_id, None)
+        self.busy.discard(worker_id)

@@ -1,0 +1,83 @@
+"""Leader/worker barrier + busy-aware worker monitor over the store."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+    load_metrics_subject,
+)
+from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.runtime.store.client import StoreClient
+from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+pytestmark = [pytest.mark.integration]
+
+
+async def test_leader_worker_barrier():
+    store = StoreServer()
+    await store.start()
+    client = await StoreClient.open(store.address)
+    try:
+        leader = LeaderBarrier(client, "kvbm-init", num_workers=3)
+        workers = [WorkerBarrier(client, "kvbm-init", f"w{i}") for i in range(3)]
+
+        async def worker(b):
+            return await b.sync(timeout=10)
+
+        leader_task = asyncio.create_task(leader.sync({"layout": "flat", "n": 7}))
+        datas = await asyncio.gather(*[worker(b) for b in workers])
+        checked_in = await leader_task
+        assert sorted(checked_in) == ["w0", "w1", "w2"]
+        assert all(d == {"layout": "flat", "n": 7} for d in datas)
+    finally:
+        await client.close()
+        await store.stop()
+
+
+async def test_worker_monitor_busy_marking():
+    store = StoreServer()
+    await store.start()
+    client = await StoreClient.open(store.address)
+    pub = await StoreClient.open(store.address)
+    try:
+        changes: list[tuple[int, bool]] = []
+        mon = WorkerMonitor(
+            client, "dynamo", "backend", busy_threshold=0.9,
+            on_busy_change=lambda w, b: changes.append((w, b)),
+        )
+        await mon.start()
+        subject = load_metrics_subject("dynamo", "backend")
+
+        def fpm(worker, usage):
+            return ForwardPassMetrics(
+                worker_id=worker,
+                worker=WorkerStats(request_active_slots=1, request_total_slots=4),
+                kv=KvStats(gpu_cache_usage_perc=usage),
+            ).to_wire()
+
+        await pub.publish(subject, fpm(1, 0.5))
+        await pub.publish(subject, fpm(2, 0.97))
+        await asyncio.sleep(0.2)
+        assert mon.eligible([1, 2]) == [1]
+        assert (2, True) in changes
+
+        await pub.publish(subject, fpm(2, 0.3))
+        await asyncio.sleep(0.2)
+        assert mon.eligible([1, 2]) == [1, 2]
+        assert (2, False) in changes
+
+        # All busy -> fall back to everyone rather than dead-ending.
+        await pub.publish(subject, fpm(1, 0.99))
+        await pub.publish(subject, fpm(2, 0.99))
+        await asyncio.sleep(0.2)
+        assert mon.eligible([1, 2]) == [1, 2]
+        await mon.stop()
+    finally:
+        await client.close()
+        await pub.close()
+        await store.stop()
