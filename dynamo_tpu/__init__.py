@@ -17,8 +17,8 @@ Layer map (bottom → top):
   detokenizer + stop engine, model cards/discovery, KV router, KVBM,
   migration, disaggregation, mocker engine.
 - ``dynamo_tpu.engine``   — the native JAX TPU worker: paged KV cache,
-  continuous batching scheduler, sampling.
-- ``dynamo_tpu.models``   — model families (llama, qwen, mixtral-MoE, ...).
+  continuous batching scheduler, sampling, model presets (llama family +
+  mixtral-MoE in ``engine/config.py``), HF weight loading.
 - ``dynamo_tpu.ops``      — Pallas TPU kernels (ragged paged attention,
   chunked prefill flash attention, fused rmsnorm/rope, ...).
 - ``dynamo_tpu.parallel`` — mesh construction, TP/DP/EP/SP sharding rules,

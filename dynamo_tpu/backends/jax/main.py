@@ -180,9 +180,14 @@ async def run_jax_worker(
                 prefill_client.instance_ids()
                 and disagg.should_remote_prefill(uncached)
             ):
+                # Track what already reached the client: a mid-stream
+                # failure must resume by token replay (migration.py
+                # semantics), never replay tokens the client has seen.
+                emitted: list[int] = []
                 try:
                     async for out in _remote_prefill_then_decode(
-                        core, engine, pre, context, prefill_client, transfer_client
+                        core, engine, pre, context, prefill_client,
+                        transfer_client, emitted,
                     ):
                         yield out
                     return
@@ -190,6 +195,16 @@ async def run_jax_worker(
                     log.exception(
                         "remote prefill failed for %s; falling back to local",
                         pre.request_id,
+                    )
+                if emitted:
+                    stop = pre.stop.after_replay(len(emitted))
+                    if stop.max_tokens is not None:
+                        stop.max_tokens = max(1, stop.max_tokens)
+                    pre = dataclasses.replace(
+                        pre,
+                        token_ids=list(pre.token_ids) + emitted,
+                        stop=stop,
+                        kv_transfer_params=None,
                     )
             async for out in engine.generate(pre.to_wire(), context):
                 yield out
@@ -227,10 +242,13 @@ async def run_jax_worker(
 
 async def _remote_prefill_then_decode(
     core, engine, pre: PreprocessedRequest, context: Context,
-    prefill_client, transfer_client,
+    prefill_client, transfer_client, emitted: list[int] | None = None,
 ) -> AsyncIterator[Any]:
     """Decode-first disaggregation: remote prefill, block pull, local
-    continuation by token replay (reference handlers.py:113-151)."""
+    continuation by token replay (reference handlers.py:113-151).
+
+    ``emitted`` (if given) collects every token yielded to the caller so a
+    mid-stream failure can resume instead of replaying the stream."""
     from dynamo_tpu.llm.protocols.common import LLMEngineOutput
 
     prefill_req = dataclasses.replace(
@@ -262,25 +280,41 @@ async def _remote_prefill_then_decode(
     first_chunk = LLMEngineOutput(
         token_ids=[token1], meta=dict(out1.meta, remote_prefill=True)
     )
-    if pre.stop.max_tokens is not None and pre.stop.max_tokens <= 1:
-        first_chunk.finish_reason = out1.finish_reason or "length"
+    # Remote prefill ran with ignore_eos=True: evaluate token1 against the
+    # *original* stop conditions before continuing the stream.
+    finish = _first_token_finish(core, pre.stop, token1)
+    if finish is None and pre.stop.max_tokens is not None and pre.stop.max_tokens <= 1:
+        finish = out1.finish_reason or "length"
+    if finish is not None:
+        first_chunk.finish_reason = finish
         first_chunk.prompt_tokens = len(pre.token_ids)
         first_chunk.completion_tokens = 1
+        if emitted is not None:
+            emitted.append(token1)
         yield first_chunk.to_wire()
         return
+    if emitted is not None:
+        emitted.append(token1)
     yield first_chunk.to_wire()
 
     cont = dataclasses.replace(
         pre,
         token_ids=list(pre.token_ids) + [token1],
-        stop=dataclasses.replace(
-            pre.stop,
-            max_tokens=None if pre.stop.max_tokens is None else pre.stop.max_tokens - 1,
-        ),
+        stop=pre.stop.after_replay(1),
         kv_transfer_params=None,
     )
     async for out in engine.generate(cont.to_wire(), context):
+        if emitted is not None:
+            emitted.extend(LLMEngineOutput.from_wire(out).token_ids)
         yield out
+
+
+def _first_token_finish(core, stop: StopConditions, token: int) -> str | None:
+    """Stop-condition check for a remotely-prefilled first token (the
+    prefill ran with ignore_eos and no stop set; see migration.py for the
+    same replay-boundary problem). max_tokens is handled by the caller."""
+    reason = stop.check_token(token, 1, core.eos_token_ids)
+    return None if reason == "length" else reason
 
 
 def main() -> None:

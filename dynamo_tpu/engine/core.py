@@ -195,6 +195,9 @@ class EngineCore:
             self._req_counter += 1
             n = self._req_counter
         seed = pre.sampling.seed if pre.sampling.seed is not None else n
+        # Device seed arrays are int32; fold arbitrary (64-bit) client seeds
+        # into range instead of letting numpy raise OverflowError mid-step.
+        seed = (seed ^ (seed >> 31)) & 0x7FFFFFFF
         seq = Sequence(
             request_id=pre.request_id or f"req-{n}",
             prompt=list(pre.token_ids),
@@ -615,15 +618,7 @@ class EngineCore:
         return out
 
     def _check_stop(self, seq: Sequence, token: int) -> str | None:
-        st = seq.stop
-        n = seq.generated  # includes `token`
-        if token in self.eos_token_ids and not st.ignore_eos and n >= st.min_tokens:
-            return FinishReason.EOS.value
-        if token in st.stop_token_ids and n >= st.min_tokens:
-            return FinishReason.STOP.value
-        if st.max_tokens is not None and n >= st.max_tokens:
-            return FinishReason.LENGTH.value
-        return None
+        return seq.stop.check_token(token, seq.generated, self.eos_token_ids)
 
     def _finish(self, seq: Sequence) -> None:
         if seq in self.running:
@@ -655,7 +650,10 @@ class EngineCore:
                 sl = slice(bid * bs, (bid + 1) * bs)
                 k = np.asarray(self.k_cache[:, :, sl, :])
                 v = np.asarray(self.v_cache[:, :, sl, :])
-                h = seq.prompt_hashes[i]
+                # pinned_hashes tracks every committed block in order —
+                # including generated-token blocks past the prompt, which
+                # prompt_hashes would miss (IndexError at large max_tokens).
+                h = seq.pinned_hashes[i]
                 blocks.append(
                     {
                         "hash": h,

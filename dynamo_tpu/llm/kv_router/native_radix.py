@@ -40,11 +40,19 @@ def _load() -> ctypes.CDLL | None:
         if _lib is not None or _load_failed:
             return _lib
         try:
-            if not _SO.exists():
+            # Always invoke make: the Makefile is dependency-driven, so a
+            # fresh .so is a no-op and a stale one (edited .cpp) rebuilds.
+            # A failed make (no toolchain / stripped sources) still falls
+            # through to CDLL when a prebuilt .so is present.
+            try:
                 subprocess.run(
                     ["make", "-C", str(_NATIVE_DIR)],
                     check=True, capture_output=True, timeout=120,
                 )
+            except (OSError, subprocess.SubprocessError) as e:
+                if not _SO.exists():
+                    raise
+                log.debug("make failed (%s); loading existing %s", e, _SO.name)
             lib = ctypes.CDLL(str(_SO))
         except (OSError, subprocess.SubprocessError) as e:
             log.warning("native radix unavailable (%s); using Python tree", e)

@@ -92,21 +92,19 @@ class Migration:
                         "request %s exhausted %d migrations", pre.request_id, self.limit
                     )
                     raise
-                # Replay: generated tokens become prompt suffix; budget shrinks.
-                new_stop = replace(current.stop)
-                if new_stop.max_tokens is not None:
-                    remaining = (pre.stop.max_tokens or 0) - len(generated)
-                    if remaining <= 0:
-                        # Budget exhausted exactly at failure: close the
-                        # stream with an explicit length finish.
-                        yield LLMEngineOutput(
-                            token_ids=[],
-                            finish_reason="length",
-                            prompt_tokens=len(pre.token_ids),
-                            completion_tokens=len(generated),
-                        )
-                        return
-                    new_stop.max_tokens = remaining
+                # Replay: generated tokens become prompt suffix; budget
+                # and minimum shrink by what the client already has.
+                new_stop = pre.stop.after_replay(len(generated))
+                if new_stop.max_tokens is not None and new_stop.max_tokens <= 0:
+                    # Budget exhausted exactly at failure: close the
+                    # stream with an explicit length finish.
+                    yield LLMEngineOutput(
+                        token_ids=[],
+                        finish_reason="length",
+                        prompt_tokens=len(pre.token_ids),
+                        completion_tokens=len(generated),
+                    )
+                    return
                 current = replace(
                     current,
                     token_ids=list(pre.token_ids) + generated,

@@ -20,7 +20,11 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError, MockKvManager
-from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.protocols.common import (
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
 
@@ -58,8 +62,7 @@ class _Seq:
     prefilled: int = 0
     generated: int = 0
     cancelled: bool = False
-    ignore_eos: bool = True
-    eos_token_id: int | None = None
+    stop: StopConditions = field(default_factory=StopConditions)
 
     @property
     def prefill_done(self) -> bool:
@@ -71,8 +74,14 @@ class MockTpuEngine:
 
     _FINISHED = object()
 
-    def __init__(self, args: MockEngineArgs | None = None, kv_manager: MockKvManager | None = None):
+    def __init__(
+        self,
+        args: MockEngineArgs | None = None,
+        kv_manager: MockKvManager | None = None,
+        eos_token_ids: tuple[int, ...] = (),
+    ):
         self.args = args or MockEngineArgs()
+        self.eos_token_ids = set(eos_token_ids)
         self.kv = kv_manager or MockKvManager(
             num_blocks=self.args.num_kv_blocks,
             block_size=self.args.block_size,
@@ -97,7 +106,7 @@ class MockTpuEngine:
             out=asyncio.Queue(),
             seq=TokenBlockSequence(pre.token_ids, self.args.block_size),
             prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
-            ignore_eos=pre.stop.ignore_eos,
+            stop=pre.stop,
         )
         self._waiting.append(seq)
         self._ensure_loop()
@@ -234,8 +243,9 @@ class MockTpuEngine:
                     "cached_tokens": seq.cached_blocks * self.args.block_size,
                     "iteration": self._iterations,
                 }
-            if seq.generated >= seq.max_tokens:
-                out.finish_reason = "length"
+            finish = self._check_stop(seq, token)
+            if finish is not None:
+                out.finish_reason = finish
                 out.prompt_tokens = len(seq.prompt)
                 out.completion_tokens = seq.generated
                 seq.out.put_nowait(out.to_wire())
@@ -247,6 +257,12 @@ class MockTpuEngine:
             self._running.remove(seq)
             self._finish(seq, emit=True)
         return prefill_tokens, decode_seqs
+
+    def _check_stop(self, seq: _Seq, token: int) -> str | None:
+        reason = seq.stop.check_token(token, seq.generated, self.eos_token_ids)
+        if reason is None and seq.generated >= seq.max_tokens:
+            reason = "length"  # mocker defaults max_tokens when unset
+        return reason
 
     def seq_tail(self, seq: _Seq) -> list[int]:
         return seq.seq.partial_tokens

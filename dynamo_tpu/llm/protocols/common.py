@@ -52,6 +52,36 @@ class StopConditions:
     stop_token_ids: list[int] = field(default_factory=list)
     ignore_eos: bool = False
 
+    def check_token(
+        self, token: int, n_generated: int, eos_token_ids
+    ) -> str | None:
+        """Token-level stop trigger: the single source of the eos > stop >
+        length precedence used by the engine, the mocker, and the disagg
+        first-token check (reference backend.rs:316 StopTrigger). String
+        ``stop`` sequences are text-level and live in the detokenizer.
+        ``n_generated`` includes ``token``."""
+        if token in eos_token_ids and not self.ignore_eos and n_generated >= self.min_tokens:
+            return FinishReason.EOS.value
+        if token in self.stop_token_ids and n_generated >= self.min_tokens:
+            return FinishReason.STOP.value
+        if self.max_tokens is not None and n_generated >= self.max_tokens:
+            return FinishReason.LENGTH.value
+        return None
+
+    def after_replay(self, n_emitted: int) -> "StopConditions":
+        """Stop conditions for a token-replay continuation (migration /
+        disagg fallback): ``n_emitted`` tokens already reached the client,
+        so both the generation budget and the minimum shrink."""
+        return StopConditions(
+            max_tokens=(
+                None if self.max_tokens is None else self.max_tokens - n_emitted
+            ),
+            min_tokens=max(0, self.min_tokens - n_emitted),
+            stop=list(self.stop),
+            stop_token_ids=list(self.stop_token_ids),
+            ignore_eos=self.ignore_eos,
+        )
+
 
 @dataclass
 class OutputOptions:

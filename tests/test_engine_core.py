@@ -54,6 +54,23 @@ def test_single_request_generates_to_length():
     assert core.allocator.used_blocks == len(core.allocator._inactive)
 
 
+def test_64bit_seed_does_not_crash_step():
+    # OpenAI clients send 64-bit seeds; int32 device arrays must not
+    # overflow (the old failure poisoned the engine loop permanently).
+    core = make_core()
+    pre = PreprocessedRequest(
+        model="tiny",
+        token_ids=list(range(1, 20)),
+        request_id="big-seed",
+        sampling=SamplingOptions(temperature=0.8, seed=2**40 + 17),
+        stop=StopConditions(max_tokens=4),
+    )
+    seq = core.add_request(pre)
+    done, finishes = run_to_completion(core, [seq])
+    assert len(done["big-seed"]) == 4
+    assert finishes["big-seed"] == "length"
+
+
 def test_greedy_determinism_and_prefix_cache_hit():
     core = make_core()
     prompt = list(range(3, 60))  # several full blocks

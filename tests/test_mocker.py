@@ -99,6 +99,32 @@ async def test_engine_generates_to_max_tokens():
     assert outs[0]["meta"]["cached_tokens"] == 0
 
 
+async def test_engine_stop_token_ids_and_eos():
+    # Mock decode emits 'a','b','c',... — stop on 'd' (the 4th token).
+    engine = MockTpuEngine(FAST)
+    req = PreprocessedRequest(
+        model="mock",
+        token_ids=[1] * 10,
+        stop=StopConditions(max_tokens=20, stop_token_ids=[ord("d")]),
+        request_id="stop1",
+    ).to_wire()
+    outs = [o async for o in engine.generate(req, Context())]
+    assert [t for o in outs for t in o["token_ids"]] == [97, 98, 99, 100]
+    assert outs[-1]["finish_reason"] == "stop"
+
+    # EOS finishes unless ignore_eos; min_tokens defers it.
+    engine = MockTpuEngine(FAST, eos_token_ids=(ord("b"),))
+    req = PreprocessedRequest(
+        model="mock",
+        token_ids=[1] * 10,
+        stop=StopConditions(max_tokens=20),
+        request_id="eos1",
+    ).to_wire()
+    outs = [o async for o in engine.generate(req, Context())]
+    assert outs[-1]["finish_reason"] == "eos"
+    assert [t for o in outs for t in o["token_ids"]] == [97, 98]
+
+
 async def test_engine_prefix_cache_hit_second_request():
     engine = MockTpuEngine(FAST)
     prompt = list(range(16))  # 4 full blocks
