@@ -43,8 +43,14 @@ def build_engine(
     eos_token_ids: tuple[int, ...] = (),
     on_stored=None,
     on_removed=None,
+    tp: int = 1,
+    dp: int = 1,
 ):
     """Construct (EngineCore, TpuEngine) for a model preset.
+
+    ``tp``/``dp`` > 1 build a device mesh and shard the engine in-process
+    (TP over ICI; the reference's tp plumbing is vllm/args.py:239-258 —
+    here the partitioning is first-party, SURVEY.md §2.6).
 
     Imported lazily so the CLI can print --help without touching jax.
     """
@@ -58,10 +64,21 @@ def build_engine(
 
     model_cfg = PRESETS[preset]()
     overrides = dict(engine_overrides or {})
-    if preset == "tiny":
+    if preset in ("tiny", "tiny-moe"):
         engine_cfg = tiny_engine(**overrides)
     else:
         engine_cfg = EngineConfig(**overrides) if overrides else EngineConfig()
+    mesh = None
+    if tp * dp > 1:
+        from dynamo_tpu.parallel.sharding import make_mesh
+
+        mesh = make_mesh(dp=dp, tp=tp)
+        # Decode widths must split evenly over dp lanes.
+        buckets = tuple(b for b in engine_cfg.decode_buckets if b % dp == 0)
+        if buckets != engine_cfg.decode_buckets:
+            if not buckets:
+                buckets = (dp * max(1, engine_cfg.decode_buckets[-1] // dp),)
+            engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
     core = EngineCore(
         model_cfg,
         engine_cfg,
@@ -69,6 +86,7 @@ def build_engine(
         eos_token_ids=eos_token_ids,
         on_stored=on_stored,
         on_removed=on_removed,
+        mesh=mesh,
     )
     return core, TpuEngine(core)
 
@@ -86,6 +104,8 @@ async def run_jax_worker(
     disagg_config: DisaggConfig | None = None,
     served_event: asyncio.Event | None = None,
     core_out: list | None = None,
+    tp: int = 1,
+    dp: int = 1,
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
@@ -118,6 +138,8 @@ async def run_jax_worker(
         eos_token_ids=eos,
         on_stored=on_stored,
         on_removed=on_removed,
+        tp=tp,
+        dp=dp,
     )
 
     if core_out is not None:
@@ -332,6 +354,14 @@ def main() -> None:
     ap.add_argument("--max-num-seqs", type=int, default=None)
     ap.add_argument("--max-model-len", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel degree (shards heads/mlp over the mesh's tp axis)",
+    )
+    ap.add_argument(
+        "--dp", type=int, default=1,
+        help="in-engine data-parallel degree (decode batch splits over dp)",
+    )
     ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
     ap.add_argument(
         "--max-local-prefill-length", type=int, default=50,
@@ -365,6 +395,8 @@ def main() -> None:
             disagg_config=DisaggConfig(
                 max_local_prefill_length=args.max_local_prefill_length
             ),
+            tp=args.tp,
+            dp=args.dp,
         )
 
     entry()

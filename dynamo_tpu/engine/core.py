@@ -136,7 +136,14 @@ class EngineCore:
         eos_token_ids: tuple[int, ...] = (),
         on_stored: Callable[[list[int], int | None], None] | None = None,
         on_removed: Callable[[list[int]], None] | None = None,
+        mesh: Any = None,
     ):
+        """``mesh`` (a jax.sharding.Mesh with axes ("dp", "tp")) turns on
+        in-engine model parallelism: params/cache shard per
+        parallel/sharding.py (megatron TP over ICI; MoE experts over the
+        same axis), decode batches shard over dp. The reference only plumbs
+        tp_size flags to its engines (vllm/args.py:239-258); here the
+        partitioning is first-party."""
         bs = engine_cfg.block_size
         for b in engine_cfg.prefill_buckets:
             if b % bs:
@@ -144,10 +151,45 @@ class EngineCore:
         self.cfg = model_cfg
         self.engine = engine_cfg
         self.eos_token_ids = set(eos_token_ids)
-        self.params = params if params is not None else init_params(
-            jax.random.PRNGKey(seed), model_cfg
-        )
-        self.k_cache, self.v_cache = init_cache(model_cfg, engine_cfg)
+        self.mesh = mesh
+        self._dp = 1
+        self._batch_shardings = None
+        if mesh is not None:
+            from dynamo_tpu.parallel.sharding import (
+                cache_sharding,
+                decode_batch_shardings,
+                param_shardings,
+                shard_params,
+            )
+
+            self._dp = int(mesh.shape["dp"])
+            for b in engine_cfg.decode_buckets:
+                if b % self._dp:
+                    raise ValueError(
+                        f"decode bucket {b} not a multiple of dp={self._dp}"
+                    )
+            self._batch_shardings = decode_batch_shardings(mesh)
+            if params is None:
+                # Initialize directly into the sharded layout — no
+                # single-device staging (a 70B pytree never fits one chip).
+                params = jax.jit(
+                    init_params,
+                    static_argnums=1,
+                    out_shardings=param_shardings(model_cfg, mesh),
+                )(jax.random.PRNGKey(seed), model_cfg)
+            else:
+                params = shard_params(params, model_cfg, mesh)
+            self.params = params
+            csh = cache_sharding(mesh)
+            self.k_cache, self.v_cache = jax.jit(
+                partial(init_cache, model_cfg, engine_cfg),
+                out_shardings=(csh, csh),
+            )()
+        else:
+            self.params = params if params is not None else init_params(
+                jax.random.PRNGKey(seed), model_cfg
+            )
+            self.k_cache, self.v_cache = init_cache(model_cfg, engine_cfg)
         self.allocator = DeviceBlockAllocator(
             engine_cfg.num_kv_blocks,
             bs,
@@ -332,6 +374,16 @@ class EngineCore:
 
     # -- device-step assembly ---------------------------------------------
 
+    def _put_batch(self, arr: np.ndarray) -> jax.Array:
+        """Place a host batch array: leading axis split over dp when the
+        mesh is on and the width divides (decode buckets always do)."""
+        if self.mesh is None or arr.shape[0] % self._dp:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec("dp", *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
     def _table_array(self, block_ids: list[int]) -> np.ndarray:
         t = np.full(self.engine.max_blocks_per_seq, self.engine.garbage_block, np.int32)
         t[: len(block_ids)] = block_ids
@@ -372,12 +424,12 @@ class EngineCore:
             start[i] = seq.prefilled
         logits, self.k_cache, self.v_cache = self._prefill(
             self.params,
-            jnp.asarray(tokens),
+            self._put_batch(tokens),
             self.k_cache,
             self.v_cache,
-            jnp.asarray(tables),
-            jnp.asarray(seq_lens),
-            jnp.asarray(start),
+            self._put_batch(tables),
+            self._put_batch(seq_lens),
+            self._put_batch(start),
             kv_span=kv_span,
         )
         for seq, chunk in zip(seqs, chunks):
@@ -493,15 +545,15 @@ class EngineCore:
             self.params,
             self.k_cache,
             self.v_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(tables),
-            jnp.asarray(positions),
-            jnp.asarray(active),
-            jnp.asarray(seeds),
-            jnp.asarray(counters),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
+            self._put_batch(tokens),
+            self._put_batch(tables),
+            self._put_batch(positions),
+            self._put_batch(active),
+            self._put_batch(seeds),
+            self._put_batch(counters),
+            self._put_batch(temp),
+            self._put_batch(top_k),
+            self._put_batch(top_p),
             n_steps=n_steps,
             need_mask=need_mask,
         )

@@ -20,9 +20,17 @@ pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
 
 
 class JaxCluster:
-    def __init__(self, num_workers: int = 1, router_mode: str = "kv"):
+    def __init__(
+        self,
+        num_workers: int = 1,
+        router_mode: str = "kv",
+        tp: int = 1,
+        dp: int = 1,
+    ):
         self.num_workers = num_workers
         self.router_mode = router_mode
+        self.tp = tp
+        self.dp = dp
         self.store = StoreServer()
         self.runtimes: list[DistributedRuntime] = []
         self.tasks: list[asyncio.Task] = []
@@ -42,6 +50,8 @@ class JaxCluster:
                         preset="tiny",
                         seed=0,
                         served_event=served,
+                        tp=self.tp,
+                        dp=self.dp,
                     )
                 )
             )
@@ -115,6 +125,22 @@ async def test_jax_worker_completion_e2e():
             assert out2["choices"][0]["message"] == choice["message"]
             cached = out2["usage"].get("prompt_tokens_details", {}).get("cached_tokens", 0)
             assert cached > 0
+
+
+async def test_jax_worker_tp_dp_sharded_e2e():
+    """HTTP → router → TP×DP-sharded EngineCore on the virtual CPU mesh,
+    greedy-identical to the unsharded engine (VERDICT #1 done-criterion)."""
+    async with JaxCluster(tp=2, dp=2) as c:
+        async with aiohttp.ClientSession() as s:
+            out = await _chat(s, c.base_url, "sharded hello", max_tokens=6)
+            choice = out["choices"][0]
+            assert choice["finish_reason"] == "length"
+            assert out["usage"]["completion_tokens"] == 6
+            sharded_text = choice["message"]["content"]
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            out = await _chat(s, c.base_url, "sharded hello", max_tokens=6)
+            assert out["choices"][0]["message"]["content"] == sharded_text
 
 
 async def test_jax_worker_concurrent_streams():

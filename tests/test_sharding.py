@@ -94,6 +94,61 @@ def test_sharded_prefill_decode_matches_single_device():
     np.testing.assert_allclose(np.asarray(got_d), np.asarray(want_d), rtol=1e-4, atol=1e-4)
 
 
+def test_engine_core_on_mesh_matches_single_device():
+    """The REAL EngineCore (scheduler + jitted steps + fused sampling) on a
+    dp=2 x tp=2 mesh produces byte-identical greedy output."""
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    def run(mesh):
+        core = EngineCore(CFG, ENG, seed=0, mesh=mesh)
+        seqs = [
+            core.add_request(
+                PreprocessedRequest(
+                    model="t",
+                    token_ids=list(range(3 + i, 40 + i)),
+                    request_id=f"r{i}",
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=5),
+                )
+            )
+            for i in range(3)
+        ]
+        done: dict[str, list[int]] = {s.request_id: [] for s in seqs}
+        fins: dict[str, str] = {}
+        for _ in range(200):
+            for seq, out in core.step():
+                done[seq.request_id].extend(out.token_ids)
+                if out.finish_reason:
+                    fins[seq.request_id] = out.finish_reason
+            if len(fins) == 3:
+                break
+        assert len(fins) == 3
+        return done
+
+    assert run(make_mesh(dp=2, tp=2)) == run(None)
+
+
+def test_engine_core_rejects_bad_decode_bucket_for_dp():
+    from dynamo_tpu.engine.core import EngineCore
+
+    mesh = make_mesh(dp=4, tp=2)
+    bad = EngineConfig(
+        num_kv_blocks=32,
+        block_size=8,
+        max_num_seqs=8,
+        max_model_len=128,
+        prefill_buckets=(32,),
+        decode_buckets=(6,),  # 6 % dp=4 != 0
+    )
+    with pytest.raises(ValueError, match="decode bucket"):
+        EngineCore(CFG, bad, seed=0, mesh=mesh)
+
+
 def test_param_shardings_reject_bad_tp():
     mesh = make_mesh(dp=1, tp=8)
     bad = ModelConfig(name="bad", num_kv_heads=6, num_heads=12)
