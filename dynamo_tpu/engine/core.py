@@ -3,11 +3,15 @@
 The TPU-native analogue of vLLM's engine loop, which the reference only
 wraps (`components/backends/vllm`); here it is first-party. One `step()`
 is one engine iteration: drain new requests, admit under a free-block
-watermark, then either run one prefill chunk (prefill-priority, like
-vLLM's default scheduler) or one batched decode+sample for every running
-sequence. All device programs are static-shaped — prompt lengths snap to
-prefill buckets, decode width to decode buckets — so XLA compiles a small
-fixed set of programs and every later call replays them.
+watermark, then either run one ragged prefill wave (prefill-priority,
+like vLLM's default scheduler) or one batched decode+sample chain for
+every running sequence. Both ride the SAME unified ragged forward
+(`model.forward_tokens`): a prefill wave is S sequences with ragged chunk
+lengths packed into one token buffer (no per-lane padding), a decode step
+is S sequences of q_len 1. Programs are static-shaped — total prefill
+tokens snap to `prefill_buckets`, decode width to `decode_buckets` — so
+XLA compiles a small fixed set of programs and every later call replays
+them.
 
 Design notes:
 - Sampling is fused into the decode program (one dispatch, one [B] int
@@ -36,10 +40,10 @@ import numpy as np
 from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.model import (
-    decode_step_impl,
+    decode_tokens,
+    forward_tokens,
     init_cache,
     init_params,
-    prefill_batch_impl,
 )
 from dynamo_tpu.engine.sampler import sample
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
@@ -101,8 +105,9 @@ def _sample_from_logits(
 
 
 def _decode_chain(
-    params, k_cache, v_cache, tokens, block_tables, positions, active,
-    seeds, counters, temperature, top_k, top_p, *, n_steps, need_mask, cfg, engine,
+    params, cache, tokens, block_tables, positions, active,
+    seeds, counters, temperature, top_k, top_p,
+    *, n_steps, need_mask, cfg, engine, mesh=None,
 ):
     """n_steps fused decode+sample iterations in one program: each step
     writes the current token's K/V, attends, samples the next token —
@@ -111,19 +116,40 @@ def _decode_chain(
     step = jnp.asarray(active, jnp.int32)
 
     def body(carry, i):
-        toks, k, v = carry
-        logits, k, v = decode_step_impl(
-            params, toks, k, v, block_tables, positions + i * step, active, cfg, engine
+        toks, cache = carry
+        logits, cache = decode_tokens(
+            params, cache, toks, block_tables, positions + i * step, active,
+            cfg, engine, mesh,
         )
         nxt = _sample_from_logits(
             logits, seeds, counters + i, temperature, top_k, top_p, need_mask
         )
-        return (nxt, k, v), nxt
+        return (nxt, cache), nxt
 
-    (_, k_cache, v_cache), sampled = jax.lax.scan(
-        body, (tokens, k_cache, v_cache), jnp.arange(n_steps)
+    (_, cache), sampled = jax.lax.scan(
+        body, (tokens, cache), jnp.arange(n_steps)
     )
-    return sampled, k_cache, v_cache
+    return sampled, cache
+
+
+def _prefill_and_sample(
+    params, cache, tokens, positions, write_pages, write_offs,
+    kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
+    seeds, counters, temperature, top_k, top_p,
+    *, need_mask, cfg, engine, mesh=None,
+):
+    """One ragged prefill wave + fused first-token sampling: every row of
+    the [S, vocab] last-token logits is sampled on-device; the host keeps
+    only rows whose prompt completed this wave."""
+    logits, cache = forward_tokens(
+        params, cache, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
+        cfg, engine, mesh,
+    )
+    toks = _sample_from_logits(
+        logits, seeds, counters, temperature, top_k, top_p, need_mask
+    )
+    return toks, cache
 
 
 class EngineCore:
@@ -169,27 +195,27 @@ class EngineCore:
                         f"decode bucket {b} not a multiple of dp={self._dp}"
                     )
             self._batch_shardings = decode_batch_shardings(mesh)
+            tp = int(mesh.shape["tp"])
             if params is None:
                 # Initialize directly into the sharded layout — no
                 # single-device staging (a 70B pytree never fits one chip).
                 params = jax.jit(
                     init_params,
-                    static_argnums=1,
+                    static_argnums=(1, 2),
                     out_shardings=param_shardings(model_cfg, mesh),
-                )(jax.random.PRNGKey(seed), model_cfg)
+                )(jax.random.PRNGKey(seed), model_cfg, tp)
             else:
                 params = shard_params(params, model_cfg, mesh)
             self.params = params
-            csh = cache_sharding(mesh)
-            self.k_cache, self.v_cache = jax.jit(
+            self.cache = jax.jit(
                 partial(init_cache, model_cfg, engine_cfg),
-                out_shardings=(csh, csh),
+                out_shardings=cache_sharding(mesh),
             )()
         else:
             self.params = params if params is not None else init_params(
                 jax.random.PRNGKey(seed), model_cfg
             )
-            self.k_cache, self.v_cache = init_cache(model_cfg, engine_cfg)
+            self.cache = init_cache(model_cfg, engine_cfg)
         self.allocator = DeviceBlockAllocator(
             engine_cfg.num_kv_blocks,
             bs,
@@ -219,16 +245,15 @@ class EngineCore:
         self._held: dict[str, Sequence] = {}
 
         self._prefill = jax.jit(
-            partial(prefill_batch_impl, cfg=model_cfg, engine=engine_cfg),
-            static_argnames=("kv_span",),
-            donate_argnums=(2, 3),
+            partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
+            static_argnames=("need_mask",),
+            donate_argnums=(1,),
         )
         self._decode = jax.jit(
-            partial(_decode_chain, cfg=model_cfg, engine=engine_cfg),
+            partial(_decode_chain, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
             static_argnames=("n_steps", "need_mask"),
-            donate_argnums=(1, 2),
+            donate_argnums=(1,),
         )
-        self._sample1 = jax.jit(_sample_from_logits, static_argnames=("need_mask",))
 
     # -- request intake (any thread) --------------------------------------
 
@@ -275,18 +300,11 @@ class EngineCore:
         return bool(self._inbox or self.waiting or self.running)
 
     def _bucket_for(self, n: int) -> int:
+        """Token-budget bucket: total ragged tokens in a prefill wave."""
         for b in self.engine.prefill_buckets:
             if b >= n:
                 return b
         raise ValueError(f"{n} exceeds largest prefill bucket")
-
-    def _kv_span_for(self, total: int) -> int:
-        cap = self.engine.max_blocks_per_seq * self.engine.block_size
-        for b in self.engine.prefill_buckets:
-            if b >= total:
-                return min(b, cap)
-        big = self.engine.prefill_buckets[-1]
-        return min(-(-total // big) * big, cap)
 
     def _decode_width(self, n: int) -> int:
         for b in self.engine.decode_buckets:
@@ -400,72 +418,77 @@ class EngineCore:
             seq.committed_blocks += 1
 
     def _run_prefill_wave(self, seqs: list[Sequence]):
-        """One dispatch prefills up to ``prefill_batch`` sequences (one
-        chunk each). Returns device logits [W, vocab]; rows of sequences
-        that finished their prompt feed the batched first-token sampler."""
-        W = self.engine.prefill_batch
-        seqs = seqs[:W]
-        max_bucket = self.engine.prefill_buckets[-1]
-        chunks = [min(s.prompt_len - s.prefilled, max_bucket) for s in seqs]
-        bucket = self._bucket_for(max(chunks))
-        kv_span = self._kv_span_for(
-            max(s.prefilled + c for s, c in zip(seqs, chunks))
-        )
-        tokens = np.zeros((W, bucket), np.int32)
-        tables = np.full(
-            (W, self.engine.max_blocks_per_seq), self.engine.garbage_block, np.int32
-        )
-        seq_lens = np.zeros(W, np.int32)
-        start = np.zeros(W, np.int32)
-        for i, (seq, chunk) in enumerate(zip(seqs, chunks)):
-            tokens[i, :chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
-            tables[i, : len(seq.block_ids)] = seq.block_ids
-            seq_lens[i] = chunk
-            start[i] = seq.prefilled
-        logits, self.k_cache, self.v_cache = self._prefill(
-            self.params,
-            self._put_batch(tokens),
-            self.k_cache,
-            self.v_cache,
-            self._put_batch(tables),
-            self._put_batch(seq_lens),
-            self._put_batch(start),
-            kv_span=kv_span,
-        )
-        for seq, chunk in zip(seqs, chunks):
-            completed = seq.hashed.extend(
-                seq.prompt[seq.prefilled : seq.prefilled + chunk]
-            )
-            self._commit_completed(seq, completed)
-            seq.prefilled += chunk
-            seq.processed = seq.prefilled
-        return seqs, logits
+        """One ragged dispatch prefills up to ``prefill_batch`` sequences
+        under a shared token budget (largest prefill bucket) — different
+        chunk lengths pack into one token buffer with no per-lane padding.
+        First-token sampling is fused into the same program; returns
+        [(seq, chunk, sampled_or_None)] with the sampled token for every
+        sequence that completed its prompt this wave."""
+        S = self.engine.prefill_batch
+        P = self.engine.max_blocks_per_seq
+        bs = self.engine.block_size
+        budget = self.engine.prefill_buckets[-1]
+        chosen: list[tuple[Sequence, int]] = []
+        total = 0
+        for seq in seqs:
+            if len(chosen) == S or total >= budget:
+                break
+            chunk = min(seq.prompt_len - seq.prefilled, budget - total)
+            if chunk <= 0:
+                continue
+            chosen.append((seq, chunk))
+            total += chunk
+        T = self._bucket_for(total)
 
-    def _sample_first_tokens(self, pairs: list[tuple[Sequence, Any]]) -> list[int]:
-        """One padded sampling program + one device->host sync for every
-        sequence that completed prefill this iteration."""
-        W = self.engine.max_num_seqs  # fixed width -> exactly one compile
-        pairs = pairs[:W]
-        logits = jnp.stack([lg for _, lg in pairs])
-        if len(pairs) < W:
-            pad = jnp.zeros((W - len(pairs), logits.shape[1]), logits.dtype)
-            logits = jnp.concatenate([logits, pad])
-        seeds = np.zeros(W, np.int32)
-        counters = np.zeros(W, np.int32)
-        temp = np.ones(W, np.float32)
-        top_k = np.zeros(W, np.int32)
-        top_p = np.ones(W, np.float32)
-        for i, (seq, _) in enumerate(pairs):
+        tokens = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        write_pages = np.full(T, self.engine.garbage_block, np.int32)
+        write_offs = np.zeros(T, np.int32)
+        kv_lens = np.zeros(S, np.int32)
+        tables = np.full((S, P), self.engine.garbage_block, np.int32)
+        cu = np.zeros(S + 1, np.int32)
+        last_rows = np.zeros(S, np.int32)
+        seeds = np.zeros(S, np.int32)
+        counters = np.zeros(S, np.int32)
+        temp = np.ones(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+
+        t = 0
+        for i, (seq, chunk) in enumerate(chosen):
+            pos = np.arange(seq.prefilled, seq.prefilled + chunk, dtype=np.int32)
+            tokens[t : t + chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
+            positions[t : t + chunk] = pos
+            ids = np.asarray(seq.block_ids, np.int32)
+            write_pages[t : t + chunk] = ids[pos // bs]
+            write_offs[t : t + chunk] = pos % bs
+            kv_lens[i] = seq.prefilled + chunk
+            tables[i, : len(ids)] = ids
+            last_rows[i] = t + chunk - 1
             seeds[i] = seq.seed
             counters[i] = seq.generated
             temp[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
+            t += chunk
+        cu[1 : len(chosen) + 1] = np.cumsum([c for _, c in chosen])
+        cu[len(chosen) + 1 :] = cu[len(chosen)]
         need_mask = any(
-            seq.sampling.top_k > 0 or seq.sampling.top_p < 1.0 for seq, _ in pairs
+            s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s, _ in chosen
         )
-        toks = self._sample1(
-            logits,
+
+        toks, self.cache = self._prefill(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(write_pages),
+            jnp.asarray(write_offs),
+            jnp.asarray(kv_lens),
+            jnp.asarray(tables),
+            jnp.asarray(cu),
+            jnp.asarray(np.array([len(chosen)], np.int32)),
+            jnp.asarray(last_rows),
             jnp.asarray(seeds),
             jnp.asarray(counters),
             jnp.asarray(temp),
@@ -473,7 +496,18 @@ class EngineCore:
             jnp.asarray(top_p),
             need_mask=need_mask,
         )
-        return [int(t) for t in np.asarray(toks)[: len(pairs)]]
+        toks = np.asarray(toks)
+
+        out = []
+        for i, (seq, chunk) in enumerate(chosen):
+            completed = seq.hashed.extend(
+                seq.prompt[seq.prefilled : seq.prefilled + chunk]
+            )
+            self._commit_completed(seq, completed)
+            seq.prefilled += chunk
+            seq.processed = seq.prefilled
+            out.append((seq, chunk, int(toks[i]) if seq.prefill_done else None))
+        return out
 
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
         """Ensure physical blocks exist for the next ``n_tokens`` decode

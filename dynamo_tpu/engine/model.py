@@ -1,26 +1,30 @@
-"""Functional llama-family transformer with a paged KV cache.
+"""Functional llama-family transformer over a paged KV cache, built
+around ONE ragged forward for prefill, decode, and mixed batches.
 
 Pure functions over a params pytree — no flax Module state — so `jit`,
-`shard_map`, and donation compose cleanly. Layers are *stacked* (every
-weight carries a leading ``num_layers`` axis) and the forward pass is a
-`lax.scan` over them: compile time is O(1) in depth, which matters at 80
-layers (llama3-70b).
+`shard_map`, and donation compose cleanly. Design choices (all measured on
+v5e, round 2):
 
-Two entry points, both static-shaped:
-
-- :func:`prefill_step` — one sequence padded to a length bucket. Computes
-  plain causal self-attention (the sequence is self-contained), scatters
-  K/V into the paged cache via the block table, returns next-token logits.
-- :func:`decode_step` — a batch of sequences, one new token each. Scatters
-  the new K/V, then paged attention over each sequence's block table.
-
-Cache layout: head-major ``[num_layers, n_kv, total_slots, head_dim]``
-where ``slot = block * block_size + offset``; the last block is a garbage
-block absorbing padded-position writes (config.py). Head-major keeps
-per-head page DMAs on untiled leading axes (TPU tiles the last two dims)
-and puts the tensor-parallel shard axis first. The reference delegates all
-of this to vLLM's CUDA paged attention; on TPU it is first-party
-(SURVEY.md §7 stage 6).
+- **Unified ragged entry point** :func:`forward_tokens`: every scheduled
+  token this step rides one program — prefill chunks of different lengths
+  and single decode tokens together, no per-sequence padding. Attention is
+  :mod:`dynamo_tpu.ops.ragged_attention` (Pallas kernel on TPU). The
+  reference delegates this to vLLM (`components/backends/vllm`); here it
+  is first-party (SURVEY.md §7 stage 6).
+- **Combined paged cache** ``[L, n_pages, page_size, 2*n_kv, d]`` with K/V
+  interleaved on the combined-head axis (K even, V odd): one page is one
+  DMA covering K+V for all heads; the tensor-parallel shard axis is the
+  combined-head axis.
+- **Unrolled layers, in-place page writes**: carrying the cache through a
+  `lax.scan` over layers streams the whole cache through HBM every step
+  (measured +12 ms/step at 1B scale); a Python-level layer loop with
+  donated buffers scatters just the new tokens' pages.
+- **Fused projections, shard-blocked**: wq/wk/wv fuse into one ``wqkv``
+  matmul and gate/up into ``wgu`` (measured −0.6 ms/step). Under tensor
+  parallelism the fused columns are laid out shard-blocked —
+  ``[q_s | k_s | v_s]`` per shard ``s`` — so a plain ``P(None, None, "tp")``
+  sharding gives every shard its own (q, k, v) block and
+  :func:`split_qkv` reassembles the natural head order.
 """
 
 from __future__ import annotations
@@ -32,14 +36,65 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.ops.ragged_attention import (
+    ragged_paged_attention,
+    sharded_ragged_attention,
+)
 
 Params = dict[str, Any]
 
 
+# -- fused-projection layout ------------------------------------------------
+
+def fuse_qkv(wq: jax.Array, wk: jax.Array, wv: jax.Array, tp: int = 1) -> jax.Array:
+    """Concatenate per-shard blocks ``[q_s | k_s | v_s]`` along the output
+    axis. With tp=1 this is plain ``[q | k | v]``. Inputs ``[..., h, out]``."""
+    qs = jnp.split(wq, tp, axis=-1)
+    ks = jnp.split(wk, tp, axis=-1)
+    vs = jnp.split(wv, tp, axis=-1)
+    return jnp.concatenate(
+        [blk for s in range(tp) for blk in (qs[s], ks[s], vs[s])], axis=-1
+    )
+
+
+def fuse_gu(wg: jax.Array, wu: jax.Array, tp: int = 1) -> jax.Array:
+    gs = jnp.split(wg, tp, axis=-1)
+    us = jnp.split(wu, tp, axis=-1)
+    return jnp.concatenate(
+        [blk for s in range(tp) for blk in (gs[s], us[s])], axis=-1
+    )
+
+
+def split_qkv(qkv: jax.Array, cfg: ModelConfig, tp: int = 1):
+    """Inverse of :func:`fuse_qkv` on activations ``[T, q+2kv]``: returns
+    (q [T, q_size], k [T, kv_size], v [T, kv_size]) in natural head order."""
+    T = qkv.shape[0]
+    qs, kvs = cfg.q_size // tp, cfg.kv_size // tp
+    blocks = qkv.reshape(T, tp, qs + 2 * kvs)
+    q = blocks[:, :, :qs].reshape(T, cfg.q_size)
+    k = blocks[:, :, qs : qs + kvs].reshape(T, cfg.kv_size)
+    v = blocks[:, :, qs + kvs :].reshape(T, cfg.kv_size)
+    return q, k, v
+
+
+def split_gu(gu: jax.Array, tp: int = 1):
+    T = gu.shape[0]
+    half = gu.shape[-1] // (2 * tp)
+    blocks = gu.reshape(T, tp, 2 * half)
+    return (
+        blocks[:, :, :half].reshape(T, -1),
+        blocks[:, :, half:].reshape(T, -1),
+    )
+
+
 # -- initialization --------------------------------------------------------
 
-def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
-    """Random init (serving benchmarks + tests; real weights via loader)."""
+def init_params(rng: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
+    """Random init (serving benchmarks + tests; real weights via loader).
+
+    ``tp`` fixes the shard-blocked layout of the fused projections; it must
+    match the serving mesh's tp axis (1 for single-chip).
+    """
     h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
     dt = cfg.jax_dtype
     keys = jax.random.split(rng, 8)
@@ -47,12 +102,13 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dt)
 
+    wq = dense(keys[1], (L, h, cfg.q_size), h)
+    wk = dense(keys[2], (L, h, cfg.kv_size), h)
+    wv = dense(keys[3], (L, h, cfg.kv_size), h)
     layers: dict[str, Any] = {
         "attn_norm": jnp.ones((L, h), dt),
         "mlp_norm": jnp.ones((L, h), dt),
-        "wq": dense(keys[1], (L, h, cfg.q_size), h),
-        "wk": dense(keys[2], (L, h, cfg.kv_size), h),
-        "wv": dense(keys[3], (L, h, cfg.kv_size), h),
+        "wqkv": fuse_qkv(wq, wk, wv, tp),
         "wo": dense(keys[4], (L, cfg.q_size, h), cfg.q_size),
     }
     if cfg.is_moe:
@@ -62,8 +118,9 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         layers["w_up"] = dense(keys[6], (L, E, h, i), h)
         layers["w_down"] = dense(keys[7], (L, E, i, h), i)
     else:
-        layers["w_gate"] = dense(keys[5], (L, h, i), h)
-        layers["w_up"] = dense(keys[6], (L, h, i), h)
+        layers["wgu"] = fuse_gu(
+            dense(keys[5], (L, h, i), h), dense(keys[6], (L, h, i), h), tp
+        )
         layers["w_down"] = dense(keys[7], (L, i, h), i)
     params: Params = {
         "embed": dense(keys[0], (v, h), h),
@@ -75,11 +132,18 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     return params
 
 
-def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple[jax.Array, jax.Array]:
-    """(k_cache, v_cache), each [L, n_kv, total_slots, head_dim]."""
+def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> jax.Array:
+    """Combined KV cache ``[L, n_pages, page_size, 2*n_kv, d]`` (the last
+    page is the garbage page absorbing padded-position writes)."""
     dtype = dtype or cfg.jax_dtype
-    shape = (cfg.num_layers, cfg.num_kv_heads, engine.total_slots, cfg.head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    shape = (
+        cfg.num_layers,
+        engine.num_kv_blocks + 1,
+        engine.block_size,
+        2 * cfg.num_kv_heads,
+        cfg.head_dim,
+    )
+    return jnp.zeros(shape, dtype)
 
 
 # -- building blocks -------------------------------------------------------
@@ -102,12 +166,12 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _mlp(x, lp, cfg: ModelConfig):
+def _mlp(x, lp, cfg: ModelConfig, tp: int):
     if cfg.is_moe:
         return _moe_mlp(x, lp, cfg)
-    gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    gu = jnp.dot(x, lp["wgu"], preferred_element_type=jnp.float32)
+    g, u = split_gu(gu, tp)
+    act = (jax.nn.silu(g) * u).astype(x.dtype)
     return jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
 
 
@@ -147,243 +211,99 @@ def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     return jnp.dot(x, head, preferred_element_type=jnp.float32)
 
 
-def _slot_for(block_tables: jax.Array, positions: jax.Array, block_size: int) -> jax.Array:
-    """Flat cache slot for each position, via its sequence's block table.
+def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """[T, kv_size] x2 -> [T, 2*n_kv, d] with K at even, V at odd heads."""
+    T = k.shape[0]
+    return jnp.stack(
+        [
+            k.reshape(T, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(T, cfg.num_kv_heads, cfg.head_dim),
+        ],
+        axis=2,
+    ).reshape(T, 2 * cfg.num_kv_heads, cfg.head_dim)
 
-    block_tables: [..., max_blocks]; positions: [...] or [..., T].
-    """
-    blk = positions // block_size
-    off = positions % block_size
-    page = jnp.take_along_axis(
-        block_tables, blk.reshape(block_tables.shape[0], -1), axis=-1
-    ).reshape(blk.shape) if block_tables.ndim == 2 else block_tables[blk]
-    return page * block_size + off
 
+# -- the unified forward ----------------------------------------------------
 
-# -- prefill ---------------------------------------------------------------
-
-def prefill_step_impl(
+def forward_tokens(
     params: Params,
-    tokens: jax.Array,       # [T] int32, padded to a bucket
-    k_cache: jax.Array,      # [L, n_kv, total_slots, d] (donated)
-    v_cache: jax.Array,
-    block_table: jax.Array,  # [max_blocks_per_seq] int32
-    seq_len: jax.Array,      # scalar int32: valid tokens in `tokens`
-    start_pos: jax.Array,    # scalar int32: absolute position of tokens[0]
+    cache: jax.Array,        # [L, n_pages, page_size, 2*n_kv, d] (donated)
+    tokens: jax.Array,       # [T] i32 — all scheduled tokens, ragged-concat
+    positions: jax.Array,    # [T] i32 — absolute position of each token
+    write_pages: jax.Array,  # [T] i32 — destination page (garbage for pads)
+    write_offs: jax.Array,   # [T] i32 — destination offset within page
+    kv_lens: jax.Array,      # [S] i32 — cache tokens per seq incl. this chunk
+    block_tables: jax.Array, # [S, pages_per_seq] i32
+    cu_q_lens: jax.Array,    # [S+1] i32
+    num_seqs: jax.Array,     # [1] i32
+    last_rows: jax.Array,    # [S] i32 — row of each seq's last token (0 pad)
     cfg: ModelConfig,
     engine: EngineConfig,
-    kv_span: int | None = None,  # static: KV positions attended, >= start_pos+seq_len
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (last-token logits [vocab], k_cache, v_cache).
-
-    ``start_pos`` > 0 resumes a sequence whose first blocks are already
-    cached (prefix-cache hit or chunked prefill): positions/RoPE/slots all
-    shift, and attention additionally covers the cached prefix via the
-    paged cache (earlier chunks were written there).
-
-    ``kv_span`` bounds attention cost to the sequence's reachable range —
-    callers round ``start_pos + seq_len`` up to a bucket so short prompts
-    don't pay O(max_model_len) attention. Defaults to the full table.
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """One step over every scheduled token. Returns (last-token logits
+    [S, vocab] f32, cache). Prefill chunks, decode tokens, and mixed
+    batches are all this function — a decode step is S sequences of
+    q_len 1 (reference chunked-prefill semantics, vLLM scheduler shape).
     """
     T = tokens.shape[0]
-    positions = start_pos + jnp.arange(T, dtype=jnp.int32)
+    tp = int(mesh.shape["tp"]) if mesh is not None else 1
+    sm_scale = cfg.head_dim ** -0.5
     x = params["embed"][tokens]  # [T, h]
+    lp_all = params["layers"]
 
-    slots = _slot_for(block_table, positions, engine.block_size)  # [T]
-    # Padded tail writes land in the garbage block.
-    slots = jnp.where(jnp.arange(T) < seq_len, slots, engine.total_slots - 1)
-
-    # Attention over the paged cache covers positions [0, start_pos + T):
-    # earlier chunks already live there; this chunk is written before reading.
-    if kv_span is None:
-        kv_span = engine.max_blocks_per_seq * engine.block_size
-    if kv_span % engine.block_size:
-        raise ValueError(f"kv_span {kv_span} not a multiple of block_size")
-    causal = positions[:, None] >= jnp.arange(kv_span, dtype=jnp.int32)[None, :]
-    valid = jnp.arange(kv_span, dtype=jnp.int32)[None, :] < (start_pos + seq_len)
-    mask = causal & valid  # [T, kv_span]
-    scale = cfg.head_dim ** -0.5
-
-    page_offsets = jnp.arange(engine.block_size, dtype=jnp.int32)
-    span_table = block_table[: kv_span // engine.block_size]
-    page_slots = (span_table[:, None] * engine.block_size + page_offsets[None, :]).reshape(-1)
-
-    def layer(x, xs):
-        lp, k_l, v_l = xs
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[l], lp_all)
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        qkv = jnp.dot(y, lp["wqkv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q, k, v = split_qkv(qkv, cfg, tp)
         q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
         k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
-        v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
-
-        k_l = k_l.at[:, slots].set(k.transpose(1, 0, 2))
-        v_l = v_l.at[:, slots].set(v.transpose(1, 0, 2))
-
-        kk = k_l[:, page_slots]  # [n_kv, kv_span, d]
-        vv = v_l[:, page_slots]
-        group = cfg.num_heads // cfg.num_kv_heads
-        qg = q.reshape(T, cfg.num_kv_heads, group, cfg.head_dim).astype(jnp.float32)
-        logits = jnp.einsum("thgd,hsd->thgs", qg, kk.astype(jnp.float32)) * scale
-        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-        w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("thgs,hsd->thgd", w, vv.astype(jnp.float32))
-        attn = attn.reshape(T, cfg.q_size).astype(x.dtype)
+        kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
+        cache = cache.at[l, write_pages, write_offs].set(kvn)
+        if mesh is not None:
+            attn = sharded_ragged_attention(
+                mesh, q, cache[l], kv_lens, block_tables, cu_q_lens, num_seqs,
+                sm_scale=sm_scale,
+            )
+        else:
+            attn = ragged_paged_attention(
+                q, cache[l], kv_lens, block_tables, cu_q_lens, num_seqs,
+                sm_scale=sm_scale,
+            )
+        attn = attn.reshape(T, cfg.q_size)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
-        return x, (k_l, v_l)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp)
 
-    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = x[jnp.maximum(seq_len - 1, 0)]
-    return _logits(last, params, cfg), k_cache, v_cache
+    last = x[last_rows]  # [S, h]
+    return _logits(last, params, cfg), cache
 
 
-def prefill_batch_impl(
+def decode_tokens(
     params: Params,
-    tokens: jax.Array,        # [B, T] int32, padded to buckets in both dims
-    k_cache: jax.Array,       # [L, n_kv, total_slots, d] (donated)
-    v_cache: jax.Array,
-    block_tables: jax.Array,  # [B, max_blocks_per_seq] int32
-    seq_lens: jax.Array,      # [B] valid tokens in each row (0 = inactive lane)
-    start_pos: jax.Array,     # [B] absolute position of tokens[b, 0]
+    cache: jax.Array,
+    tokens: jax.Array,        # [B] i32 — one new token per sequence
+    block_tables: jax.Array,  # [B, pages_per_seq] i32
+    positions: jax.Array,     # [B] i32 — position of `tokens`
+    active: jax.Array,        # [B] bool
     cfg: ModelConfig,
     engine: EngineConfig,
-    kv_span: int | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Batched prefill: B sequences in one program — one dispatch prefills
-    a whole admission wave (and short prompts batch onto the MXU instead
-    of underfilling it). Returns (last-token logits [B, vocab], caches).
-
-    Per-lane ``start_pos`` keeps chunked resumption: different lanes may
-    be at different chunks of different prompts.
-    """
-    B, T = tokens.shape
-    bs = engine.block_size
-    if kv_span is None:
-        kv_span = engine.max_blocks_per_seq * bs
-    if kv_span % bs:
-        raise ValueError(f"kv_span {kv_span} not a multiple of block_size")
-
-    positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
-    x = params["embed"][tokens]  # [B, T, h]
-
-    blk = positions // bs
-    page = jnp.take_along_axis(block_tables, blk, axis=1)  # [B, T]
-    slots = page * bs + positions % bs
-    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]
-    slots = jnp.where(valid, slots, engine.total_slots - 1)
-    flat_slots = slots.reshape(-1)  # [B*T]
-
-    kv_pos = jnp.arange(kv_span, dtype=jnp.int32)
-    causal = positions[:, :, None] >= kv_pos[None, None, :]
-    in_seq = kv_pos[None, None, :] < (start_pos + seq_lens)[:, None, None]
-    mask = causal & in_seq  # [B, T, kv_span]
-    scale = cfg.head_dim ** -0.5
-
-    span_tables = block_tables[:, : kv_span // bs]  # [B, span_blocks]
-    page_offsets = jnp.arange(bs, dtype=jnp.int32)
-    page_slots = (
-        span_tables[:, :, None] * bs + page_offsets[None, None, :]
-    ).reshape(B, kv_span)
-
-    group = cfg.num_heads // cfg.num_kv_heads
-
-    def layer(x, xs):
-        lp, k_l, v_l = xs
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
-        q = rope(q.reshape(B, T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
-        k = rope(k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
-        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-
-        k_flat = k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim).transpose(1, 0, 2)
-        v_flat = v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim).transpose(1, 0, 2)
-        k_l = k_l.at[:, flat_slots].set(k_flat)
-        v_l = v_l.at[:, flat_slots].set(v_flat)
-
-        kk = k_l[:, page_slots]  # [n_kv, B, kv_span, d]
-        vv = v_l[:, page_slots]
-        qg = q.reshape(B, T, cfg.num_kv_heads, group, cfg.head_dim).astype(jnp.float32)
-        logits = jnp.einsum("bthgd,hbsd->bthgs", qg, kk.astype(jnp.float32)) * scale
-        logits = jnp.where(mask[:, :, None, None, :], logits, -1e30)
-        w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bthgs,hbsd->bthgd", w, vv.astype(jnp.float32))
-        attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
-        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
-        return x, (k_l, v_l)
-
-    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last_idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]  # [B, 1, 1]
-    last = jnp.take_along_axis(x, last_idx, axis=1)[:, 0]   # [B, h]
-    return _logits(last, params, cfg), k_cache, v_cache
-
-
-# -- decode ----------------------------------------------------------------
-
-def decode_step_impl(
-    params: Params,
-    tokens: jax.Array,        # [B] int32 — the just-sampled token per seq
-    k_cache: jax.Array,       # donated
-    v_cache: jax.Array,
-    block_tables: jax.Array,  # [B, max_blocks_per_seq] int32
-    positions: jax.Array,     # [B] int32 — position of `tokens` (0-based)
-    active: jax.Array,        # [B] bool — padding lanes write to garbage
-    cfg: ModelConfig,
-    engine: EngineConfig,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (logits [B, vocab] f32, k_cache, v_cache).
-
-    The layer scan reads the *old* cache and attends to the current token
-    via an explicit self key/value; the new K/V for every layer scatters
-    into the caches in two bulk writes after the scan (a per-layer scatter
-    inside the loop serializes badly on TPU)."""
-    from dynamo_tpu.ops.paged_attention import paged_attention
-
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Pure-decode step: B sequences, one token each. Thin assembly over
+    :func:`forward_tokens` — in-jit slot computation so decode chains can
+    advance positions on-device."""
     B = tokens.shape[0]
-    x = params["embed"][tokens]  # [B, h]
-    slots = _slot_for(block_tables, positions, engine.block_size)  # [B]
-    slots = jnp.where(active, slots, engine.total_slots - 1)
-    # Cached positions only — the current token rides the self term.
-    seq_lens = jnp.where(active, positions, 0).astype(jnp.int32)
-
-    def layer(x, xs):
-        lp, k_l, v_l = xs
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(y, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(y, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(y, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
-        q = rope(q.reshape(B, 1, cfg.num_heads, cfg.head_dim), positions[:, None], cfg.rope_theta)[:, 0]
-        k = rope(k.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim), positions[:, None], cfg.rope_theta)[:, 0]
-        v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
-
-        attn = paged_attention(
-            q, k_l, v_l, block_tables, seq_lens,
-            block_size=engine.block_size, k_self=k, v_self=v,
-        )  # [B, n_q, d]
-        attn = attn.reshape(B, cfg.q_size)
-        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg)
-        return x, (k, v)
-
-    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
-    # k_new/v_new: [L, B, n_kv, d] -> scatter once per cache.
-    k_cache = k_cache.at[:, :, slots, :].set(k_new.transpose(0, 2, 1, 3))
-    v_cache = v_cache.at[:, :, slots, :].set(v_new.transpose(0, 2, 1, 3))
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _logits(x, params, cfg), k_cache, v_cache
-
-
-# Jitted entry points (standalone use / tests). The engine core wraps the
-# *_impl functions in its own jits to fuse sampling into the same program.
-prefill_step = jax.jit(
-    prefill_step_impl, static_argnames=("cfg", "engine", "kv_span"), donate_argnums=(2, 3)
-)
-decode_step = jax.jit(
-    decode_step_impl, static_argnames=("cfg", "engine"), donate_argnums=(2, 3)
-)
+    bs = engine.block_size
+    page = jnp.take_along_axis(block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+    write_pages = jnp.where(active, page, engine.garbage_block)
+    write_offs = positions % bs
+    kv_lens = jnp.where(active, positions + 1, 1).astype(jnp.int32)
+    cu = jnp.arange(B + 1, dtype=jnp.int32)
+    num_seqs = jnp.array([B], jnp.int32)
+    rows = jnp.arange(B, dtype=jnp.int32)
+    return forward_tokens(
+        params, cache, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu, num_seqs, rows, cfg, engine, mesh,
+    )

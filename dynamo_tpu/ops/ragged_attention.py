@@ -1,0 +1,143 @@
+"""Ragged paged attention: one attention call for prefill, decode, and
+mixed batches over the paged KV cache.
+
+Semantics (vLLM-TPU style; the reference outsources this op to vLLM's CUDA
+kernels — on TPU it is first-party, SURVEY.md §7 "hard parts"):
+
+- ``q``: ``[T, n_q_heads, d]`` — every scheduled token this step,
+  concatenated across sequences (ragged; no per-sequence padding).
+- ``kv_pages``: ``[n_pages, page_size, 2 * n_kv_heads, d]`` — the paged KV
+  cache for ONE layer, K/V interleaved on the combined-head axis (K at
+  even indices, V at odd). The new tokens' K/V must already be written.
+- ``kv_lens[s]``: tokens of sequence ``s`` IN CACHE (including this
+  step's chunk).
+- ``page_indices``: ``[S, pages_per_seq]`` block table per sequence.
+- ``cu_q_lens``: ``[S + 1]`` cumulative query lengths; sequence ``s`` owns
+  q rows ``cu[s]:cu[s+1]``. Entries past ``num_seqs`` repeat ``cu[num_seqs]``.
+- ``num_seqs``: ``i32[1]`` — valid sequences (dynamic).
+
+Query token ``i`` of sequence ``s`` sits at absolute position
+``kv_lens[s] - q_len_s + i`` and attends all cache positions ``<=`` its own
+— exactly chunked-prefill causality; a decode step is the ``q_len_s == 1``
+special case.
+
+On TPU dispatches to the Pallas kernel
+(jax.experimental.pallas.ops.tpu.ragged_paged_attention); elsewhere (CPU
+test meshes) runs a vectorized jnp reference with identical semantics.
+Under tensor parallelism wrap with :func:`sharded_ragged_attention` —
+attention is embarrassingly parallel over heads, so the shard_map has no
+collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# Decode-shape tuned Pallas grid (measured on v5e, ctx ~200): 8-page DMA
+# batches, 32-token query blocks. Long-context calls use the kernel's own
+# tuned table instead.
+_DECODE_KV_PAGES_PER_BLOCK = 8
+_DECODE_QUERIES_PER_BLOCK = 32
+
+
+def ragged_paged_attention_ref(
+    q: jax.Array,             # [T, n_q, d]
+    kv_pages: jax.Array,      # [n_pages, page_size, 2*n_kv, d]
+    kv_lens: jax.Array,       # [S] i32
+    page_indices: jax.Array,  # [S, pages_per_seq] i32
+    cu_q_lens: jax.Array,     # [S+1] i32
+    num_seqs: jax.Array,      # [1] i32
+    *,
+    sm_scale: float,
+) -> jax.Array:               # [T, n_q, d]
+    T, n_q, d = q.shape
+    n_pages, page_size, n_comb, _ = kv_pages.shape
+    n_kv = n_comb // 2
+    group = n_q // n_kv
+    S, pages_per_seq = page_indices.shape
+    span = pages_per_seq * page_size
+
+    t = jnp.arange(T, dtype=jnp.int32)
+    # seq_id[t] = s such that cu[s] <= t < cu[s+1]
+    seq_id = jnp.sum(t[:, None] >= cu_q_lens[None, 1:], axis=1).astype(jnp.int32)
+    seq_id = jnp.minimum(seq_id, S - 1)
+    valid_row = t < cu_q_lens[num_seqs[0]]
+
+    q_len = cu_q_lens[seq_id + 1] - cu_q_lens[seq_id]          # [T]
+    abs_pos = kv_lens[seq_id] - q_len + (t - cu_q_lens[seq_id])  # [T]
+
+    tables_t = page_indices[seq_id]                      # [T, pages_per_seq]
+    offs = jnp.arange(page_size, dtype=jnp.int32)
+    slots = (tables_t[:, :, None] * page_size + offs[None, None, :]).reshape(T, span)
+    flat = kv_pages.reshape(n_pages * page_size, n_comb, d)
+    kv = flat[slots]                                     # [T, span, 2*n_kv, d]
+    k = kv[:, :, 0::2, :].astype(jnp.float32)            # [T, span, n_kv, d]
+    v = kv[:, :, 1::2, :].astype(jnp.float32)
+
+    qg = q.reshape(T, n_kv, group, d).astype(jnp.float32)
+    s = jnp.einsum("thgd,tshd->thgs", qg, k) * sm_scale  # [T, n_kv, group, span]
+    pos = jnp.arange(span, dtype=jnp.int32)
+    mask = (pos[None, :] <= abs_pos[:, None]) & (pos[None, :] < kv_lens[seq_id][:, None])
+    mask = mask & valid_row[:, None]
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    w = jnp.where(valid_row[:, None, None, None], w, 0.0)
+    out = jnp.einsum("thgs,tshd->thgd", w, v)
+    return out.reshape(T, n_q, d).astype(q.dtype)
+
+
+def ragged_paged_attention(
+    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale: float
+) -> jax.Array:
+    """Backend dispatch: Pallas kernel on TPU, jnp reference elsewhere."""
+    if jax.default_backend() == "tpu":
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            ragged_paged_attention as _kernel,
+        )
+
+        kw = {}
+        # Short-context decode grids benefit from the measured block sizes;
+        # leave long tables to the kernel's tuned defaults.
+        if page_indices.shape[1] <= 32:
+            kw = dict(
+                num_kv_pages_per_block=min(
+                    _DECODE_KV_PAGES_PER_BLOCK, page_indices.shape[1]
+                ),
+                num_queries_per_block=_DECODE_QUERIES_PER_BLOCK,
+            )
+        return _kernel(
+            q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
+            sm_scale=sm_scale, **kw,
+        )
+    return ragged_paged_attention_ref(
+        q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, sm_scale=sm_scale
+    )
+
+
+def sharded_ragged_attention(
+    mesh: Mesh,
+    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale: float
+) -> jax.Array:
+    """Ragged attention under tensor parallelism: heads split over the
+    mesh's ``tp`` axis, zero collectives (each shard owns its q heads and
+    the matching combined-KV block; dp replicates)."""
+    fn = functools.partial(
+        ragged_paged_attention, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),          # q: heads sharded
+            P(None, None, "tp", None),    # kv_pages: combined heads sharded
+            P(), P(), P(), P(),
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs)
