@@ -131,7 +131,12 @@ async def run_jax_worker(
 
         eos = (ByteTokenizer.EOS,)
 
-    core, engine = build_engine(
+    # Build (and compile) off the event loop: on real TPU hardware the
+    # first jit takes tens of seconds, and blocking the loop that long
+    # starves the store lease keepalive (ttl 10s) — the worker would
+    # arrive at registration with its lease already expired.
+    core, engine = await asyncio.to_thread(
+        build_engine,
         preset,
         engine_overrides,
         seed=seed,

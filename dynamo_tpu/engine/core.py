@@ -358,23 +358,16 @@ class EngineCore:
     # -- host KV tier (G2) -------------------------------------------------
 
     def _offload_block(self, block_id: int, block_hash: int, parent: int | None) -> None:
-        """Device eviction hook: demote the block's pages to host RAM."""
-        import jax.numpy as jnp  # noqa: F401
-
-        bs = self.engine.block_size
-        sl = slice(block_id * bs, (block_id + 1) * bs)
-        k = np.asarray(self.k_cache[:, :, sl, :])
-        v = np.asarray(self.v_cache[:, :, sl, :])
-        self.host_pool.put(block_hash, parent, k, v)
+        """Device eviction hook: demote the block's combined KV page
+        ``[L, page_size, 2*n_kv, d]`` to host RAM."""
+        page = np.asarray(self.cache[:, block_id])
+        self.host_pool.put(block_hash, parent, page)
 
     def _onboard_from_host(
         self, hashes: list[int], cached_ids: list[int], ncached: int, cap: int
     ) -> tuple[list[int], int]:
         """Extend a device-cached prefix with host-tier hits: promote each
         consecutive host block back to HBM and pin it."""
-        import jax.numpy as jnp
-
-        bs = self.engine.block_size
         while ncached < cap and hashes[ncached] in self.host_pool:
             h = hashes[ncached]
             try:
@@ -382,9 +375,7 @@ class EngineCore:
             except OutOfBlocksError:
                 break
             blk = self.host_pool.pop(h)
-            sl = slice(bid * bs, (bid + 1) * bs)
-            self.k_cache = self.k_cache.at[:, :, sl, :].set(jnp.asarray(blk.k))
-            self.v_cache = self.v_cache.at[:, :, sl, :].set(jnp.asarray(blk.v))
+            self.cache = self.cache.at[:, bid].set(jnp.asarray(blk.kv))
             self.allocator.register_inactive(bid, h, blk.parent_hash, emit=False)
             cached_ids.extend(self.allocator.acquire_cached([h]))
             ncached += 1
@@ -575,10 +566,9 @@ class EngineCore:
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
         )
-        out, self.k_cache, self.v_cache = self._decode(
+        out, self.cache = self._decode(
             self.params,
-            self.k_cache,
-            self.v_cache,
+            self.cache,
             self._put_batch(tokens),
             self._put_batch(tables),
             self._put_batch(positions),
@@ -613,20 +603,14 @@ class EngineCore:
 
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
-            finished_pairs: list[tuple[Sequence, Any]] = []
-            wave, logits = self._run_prefill_wave(prefills)
-            for i, seq in enumerate(wave):
-                if seq.prefill_done:
-                    finished_pairs.append((seq, logits[i]))
-            if finished_pairs:
-                for (seq, _), tok in zip(
-                    finished_pairs, self._sample_first_tokens(finished_pairs)
-                ):
-                    seq.pending = tok
-                    seq.generated += 1
-                    outputs.append((seq, self._emit(seq, tok)))
-                    if seq.finish is not None:
-                        self._finish(seq)
+            for seq, _chunk, tok in self._run_prefill_wave(prefills):
+                if tok is None:
+                    continue  # prompt not finished this wave
+                seq.pending = tok
+                seq.generated += 1
+                outputs.append((seq, self._emit(seq, tok)))
+                if seq.finish is not None:
+                    self._finish(seq)
             return outputs
 
         decoding = [s for s in self.running if s.pending is not None]
@@ -720,22 +704,19 @@ class EngineCore:
         """Gather a held prefill's committed blocks off the device.
 
         Returns (block descriptors, none) and releases the hold. Each
-        descriptor carries the hash chain plus raw K/V page bytes
-        [L, n_kv, block_size, d]. The TPU-native analogue of NIXL
+        descriptor carries the hash chain plus the raw combined KV page
+        bytes [L, block_size, 2*n_kv, d]. The TPU-native analogue of NIXL
         descriptor export (reference nixl_connect/__init__.py:501).
         """
         with self._step_lock:
             seq = self._held.pop(request_id, None)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
-            bs = self.engine.block_size
             blocks: list[dict] = []
             parent: int | None = None
             for i in range(seq.committed_blocks):
                 bid = seq.block_ids[i]
-                sl = slice(bid * bs, (bid + 1) * bs)
-                k = np.asarray(self.k_cache[:, :, sl, :])
-                v = np.asarray(self.v_cache[:, :, sl, :])
+                page = np.asarray(self.cache[:, bid])
                 # pinned_hashes tracks every committed block in order —
                 # including generated-token blocks past the prompt, which
                 # prompt_hashes would miss (IndexError at large max_tokens).
@@ -744,9 +725,8 @@ class EngineCore:
                     {
                         "hash": h,
                         "parent": parent,
-                        "k": k.tobytes(),
-                        "v": v.tobytes(),
-                        "shape": list(k.shape),
+                        "kv": page.tobytes(),
+                        "shape": list(page.shape),
                         "dtype": np.dtype(self.cfg.jax_dtype).name,
                     }
                 )
@@ -770,10 +750,8 @@ class EngineCore:
         """Write transferred KV pages into the local cache as inactive
         cached content; a following admission prefix-matches them. Returns
         blocks actually imported (already-cached hashes are skipped)."""
-        import jax.numpy as jnp
         import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 
-        bs = self.engine.block_size
         with self._step_lock:
             imported = 0
             for blk in blocks:
@@ -785,12 +763,8 @@ class EngineCore:
                 except OutOfBlocksError:
                     break
                 dtype = np.dtype(blk["dtype"])
-                shape = tuple(blk["shape"])
-                k = np.frombuffer(blk["k"], dtype=dtype).reshape(shape)
-                v = np.frombuffer(blk["v"], dtype=dtype).reshape(shape)
-                sl = slice(bid * bs, (bid + 1) * bs)
-                self.k_cache = self.k_cache.at[:, :, sl, :].set(jnp.asarray(k))
-                self.v_cache = self.v_cache.at[:, :, sl, :].set(jnp.asarray(v))
+                page = np.frombuffer(blk["kv"], dtype=dtype).reshape(tuple(blk["shape"]))
+                self.cache = self.cache.at[:, bid].set(jnp.asarray(page))
                 self.allocator.register_inactive(bid, h, blk["parent"])
                 imported += 1
             return imported

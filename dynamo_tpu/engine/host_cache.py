@@ -29,8 +29,7 @@ class HostPoolStats:
 @dataclass
 class _HostBlock:
     parent_hash: int | None
-    k: np.ndarray  # [L, n_kv, block_size, d]
-    v: np.ndarray
+    kv: np.ndarray  # combined page [L, block_size, 2*n_kv, d]
 
 
 class HostKvPool:
@@ -50,7 +49,7 @@ class HostKvPool:
     def __len__(self) -> int:
         return len(self._blocks)
 
-    def put(self, block_hash: int, parent_hash: int | None, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, block_hash: int, parent_hash: int | None, kv: np.ndarray) -> None:
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
             return
@@ -58,7 +57,7 @@ class HostKvPool:
             h, _ = self._blocks.popitem(last=False)
             self.stats.evictions += 1
             self.on_removed([h])
-        self._blocks[block_hash] = _HostBlock(parent_hash, k, v)
+        self._blocks[block_hash] = _HostBlock(parent_hash, kv)
         self.stats.offloads += 1
 
     def get(self, block_hash: int) -> _HostBlock | None:

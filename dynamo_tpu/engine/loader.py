@@ -68,9 +68,15 @@ def _read_state_dict(path: Path) -> dict[str, np.ndarray]:
     return tensors
 
 
-def load_hf_llama(path: str | Path, dtype=None) -> tuple[ModelConfig, Any]:
-    """Returns (ModelConfig, params pytree) from an HF llama checkpoint."""
+def load_hf_llama(path: str | Path, dtype=None, tp: int = 1) -> tuple[ModelConfig, Any]:
+    """Returns (ModelConfig, params pytree) from an HF llama checkpoint.
+
+    ``tp`` fixes the shard-blocked layout of the fused wqkv/wgu projections
+    (model.fuse_qkv/fuse_gu) and must match the serving mesh's tp axis.
+    """
     import jax.numpy as jnp
+
+    from dynamo_tpu.engine.model import fuse_gu, fuse_qkv
 
     path = Path(path)
     cfg = config_from_hf(path)
@@ -83,19 +89,24 @@ def load_hf_llama(path: str | Path, dtype=None) -> tuple[ModelConfig, Any]:
     def proj(i: int, name: str) -> np.ndarray:
         return t(f"model.layers.{i}.{name}.weight").T  # [in, out]
 
+    def stack(name: str) -> np.ndarray:
+        return np.stack([proj(i, name) for i in range(cfg.num_layers)])
+
     L = cfg.num_layers
     layers = {
         "attn_norm": np.stack([t(f"model.layers.{i}.input_layernorm.weight") for i in range(L)]),
         "mlp_norm": np.stack(
             [t(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)]
         ),
-        "wq": np.stack([proj(i, "self_attn.q_proj") for i in range(L)]),
-        "wk": np.stack([proj(i, "self_attn.k_proj") for i in range(L)]),
-        "wv": np.stack([proj(i, "self_attn.v_proj") for i in range(L)]),
-        "wo": np.stack([proj(i, "self_attn.o_proj") for i in range(L)]),
-        "w_gate": np.stack([proj(i, "mlp.gate_proj") for i in range(L)]),
-        "w_up": np.stack([proj(i, "mlp.up_proj") for i in range(L)]),
-        "w_down": np.stack([proj(i, "mlp.down_proj") for i in range(L)]),
+        "wqkv": np.asarray(fuse_qkv(
+            stack("self_attn.q_proj"),
+            stack("self_attn.k_proj"),
+            stack("self_attn.v_proj"),
+            tp,
+        )),
+        "wo": stack("self_attn.o_proj"),
+        "wgu": np.asarray(fuse_gu(stack("mlp.gate_proj"), stack("mlp.up_proj"), tp)),
+        "w_down": stack("mlp.down_proj"),
     }
     params: dict[str, Any] = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),

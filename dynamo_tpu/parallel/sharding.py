@@ -6,16 +6,23 @@ SURVEY.md §2.6); on TPU the partitioning is first-party and rides ICI via
 XLA collectives — no NCCL.
 
 Mapping (classic megatron over axes ``("dp", "tp")``):
-- attention qkv projections: column-parallel (heads split across tp)
+- fused ``wqkv``: column-parallel — the shard-blocked fuse layout
+  (``[q_s | k_s | v_s]`` per shard, model.fuse_qkv) makes a plain
+  ``P(None, None, "tp")`` hand each shard its own (q, k, v) block
 - attention output / mlp down: row-parallel (XLA inserts the psum)
-- mlp gate/up: column-parallel (intermediate split)
+- fused ``wgu``: column-parallel, same shard-blocked trick
 - lm_head: vocab-split (sampling reduces across shards inside jit)
-- paged KV cache: kv-head axis split across tp — the head-major layout
-  [L, n_kv, slots, d] makes this the leading per-layer axis
+- combined paged KV cache ``[L, n_pages, page_size, 2*n_kv, d]``:
+  combined-head axis split across tp (K/V interleaved, so K and V of a
+  head land on the same shard)
 - decode batch: split across dp; prefill (one sequence) replicated on dp
 
-Requires ``num_kv_heads % tp == 0`` (llama3 GQA: tp ≤ 8). Larger tp would
-split head_dim — future work, noted in EngineConfig docs.
+Requires ``tp`` to divide num_heads, num_kv_heads, and intermediate_size
+(llama3 GQA: tp ≤ 8). Larger tp would split head_dim — future work.
+
+IMPORTANT: the fused params must have been built with THIS tp
+(``init_params(rng, cfg, tp)`` / ``load_hf_llama(path, tp=...)``) — the
+shard-blocked column order depends on it.
 """
 
 from __future__ import annotations
@@ -39,10 +46,14 @@ def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
 
 def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     """NamedSharding pytree matching `model.init_params` structure."""
-    if cfg.num_kv_heads % mesh.shape["tp"]:
-        raise ValueError(
-            f"tp={mesh.shape['tp']} must divide num_kv_heads={cfg.num_kv_heads}"
-        )
+    tp = mesh.shape["tp"]
+    for what, n in (
+        ("num_kv_heads", cfg.num_kv_heads),
+        ("num_heads", cfg.num_heads),
+        ("intermediate_size", cfg.intermediate_size),
+    ):
+        if n % tp:
+            raise ValueError(f"tp={tp} must divide {what}={n}")
 
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
@@ -50,25 +61,22 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     layers = {
         "attn_norm": s(None, None),
         "mlp_norm": s(None, None),
-        "wq": s(None, None, "tp"),
-        "wk": s(None, None, "tp"),
-        "wv": s(None, None, "tp"),
+        "wqkv": s(None, None, "tp"),
         "wo": s(None, "tp", None),
     }
     if cfg.is_moe:
         # Expert parallelism: the expert axis shards over the model axis;
         # the expert-sum contraction becomes a psum over 'tp'.
-        if cfg.num_experts % mesh.shape["tp"]:
+        if cfg.num_experts % tp:
             raise ValueError(
-                f"tp={mesh.shape['tp']} must divide num_experts={cfg.num_experts}"
+                f"tp={tp} must divide num_experts={cfg.num_experts}"
             )
         layers["w_router"] = s(None, None, None)
         layers["w_gate"] = s(None, "tp", None, None)
         layers["w_up"] = s(None, "tp", None, None)
         layers["w_down"] = s(None, "tp", None, None)
     else:
-        layers["w_gate"] = s(None, None, "tp")
-        layers["w_up"] = s(None, None, "tp")
+        layers["wgu"] = s(None, None, "tp")
         layers["w_down"] = s(None, "tp", None)
     shardings = {
         "embed": s(None, None),
@@ -81,8 +89,8 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """[L, n_kv, slots, d] — kv heads split across tp."""
-    return NamedSharding(mesh, P(None, "tp", None, None))
+    """[L, n_pages, page_size, 2*n_kv, d] — combined-head axis on tp."""
+    return NamedSharding(mesh, P(None, None, None, "tp", None))
 
 
 def decode_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:

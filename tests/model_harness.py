@@ -1,0 +1,65 @@
+"""Shared test helpers for driving `model.forward_tokens` directly:
+assemble the ragged-batch operands for a single-sequence prefill chunk
+the same way EngineCore._run_prefill_wave does."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model import forward_tokens
+
+
+def prefill_chunk(
+    params,
+    cache,
+    chunk: list[int],
+    start_pos: int,
+    block_ids: list[int],
+    cfg: ModelConfig,
+    eng: EngineConfig,
+    bucket: int,
+    mesh=None,
+):
+    """Prefill one chunk of a single sequence (tokens at positions
+    start_pos .. start_pos+len(chunk)-1). Returns (last-token logits
+    [vocab], cache)."""
+    n = len(chunk)
+    assert n <= bucket
+    bs = eng.block_size
+    ids = np.asarray(block_ids, np.int32)
+
+    tokens = np.zeros(bucket, np.int32)
+    tokens[:n] = chunk
+    positions = np.zeros(bucket, np.int32)
+    pos = np.arange(start_pos, start_pos + n, dtype=np.int32)
+    positions[:n] = pos
+    write_pages = np.full(bucket, eng.garbage_block, np.int32)
+    write_pages[:n] = ids[pos // bs]
+    write_offs = np.zeros(bucket, np.int32)
+    write_offs[:n] = pos % bs
+
+    table = np.full((1, eng.max_blocks_per_seq), eng.garbage_block, np.int32)
+    table[0, : len(ids)] = ids
+    kv_lens = np.array([start_pos + n], np.int32)
+    cu = np.array([0, n], np.int32)
+    last_rows = np.array([n - 1], np.int32)
+
+    logits, cache = forward_tokens(
+        params,
+        cache,
+        jnp.asarray(tokens),
+        jnp.asarray(positions),
+        jnp.asarray(write_pages),
+        jnp.asarray(write_offs),
+        jnp.asarray(kv_lens),
+        jnp.asarray(table),
+        jnp.asarray(cu),
+        jnp.asarray(np.array([1], np.int32)),
+        jnp.asarray(last_rows),
+        cfg,
+        eng,
+        mesh,
+    )
+    return logits[0], cache

@@ -1,4 +1,5 @@
-"""Engine-core numerics: paged cache consistency, pallas parity, sampling."""
+"""Engine-model numerics over the unified ragged forward: paged-cache
+consistency across prefill/decode splits, pallas parity, sampling."""
 
 import jax
 import jax.numpy as jnp
@@ -6,12 +7,13 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.engine import config as cfgmod
-from dynamo_tpu.engine.model import decode_step, init_cache, init_params, prefill_step
+from dynamo_tpu.engine.model import decode_tokens, init_cache, init_params
 from dynamo_tpu.engine.sampler import sample
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_pallas,
     paged_attention_reference,
 )
+from tests.model_harness import prefill_chunk
 
 CFG = cfgmod.tiny_model()
 ENG = cfgmod.tiny_engine()
@@ -22,40 +24,30 @@ def params():
     return init_params(jax.random.PRNGKey(0), CFG)
 
 
-def _table(blocks: list[int]) -> np.ndarray:
-    t = np.full(ENG.max_blocks_per_seq, ENG.garbage_block, np.int32)
-    t[: len(blocks)] = blocks
+def _tables(block_ids: list[int], B: int) -> np.ndarray:
+    t = np.full((B, ENG.max_blocks_per_seq), ENG.garbage_block, np.int32)
+    t[0, : len(block_ids)] = block_ids
     return t
 
 
 def test_prefill_then_decode_matches_monolithic_prefill(params):
-    """Prefill(n) + k decode steps == prefill(n+k) logits at each position."""
+    """Prefill(n) + k decode steps == one monolithic prefill(n+k)."""
     rng = np.random.RandomState(7)
     prompt = rng.randint(0, CFG.vocab_size, size=37).tolist()
     extra = rng.randint(0, CFG.vocab_size, size=5).tolist()
+    blocks = list(range(6))
 
     # Ground truth: one monolithic prefill over the whole sequence.
-    k1, v1 = init_cache(CFG, ENG)
-    full = prompt + extra
-    bucket = 64
-    toks = np.zeros(bucket, np.int32)
-    toks[: len(full)] = full
-    table = _table(list(range(6)))
-    want, _, _ = prefill_step(
-        params, jnp.asarray(toks), k1, v1, jnp.asarray(table),
-        jnp.int32(len(full)), jnp.int32(0), CFG, ENG,
+    want, _ = prefill_chunk(
+        params, init_cache(CFG, ENG), prompt + extra, 0, blocks, CFG, ENG, 64
     )
 
     # Paged path: prefill the prompt, then decode the extra tokens.
-    k2, v2 = init_cache(CFG, ENG)
-    toks2 = np.zeros(bucket, np.int32)
-    toks2[: len(prompt)] = prompt
-    logits, k2, v2 = prefill_step(
-        params, jnp.asarray(toks2), k2, v2, jnp.asarray(table),
-        jnp.int32(len(prompt)), jnp.int32(0), CFG, ENG,
+    logits, cache = prefill_chunk(
+        params, init_cache(CFG, ENG), prompt, 0, blocks, CFG, ENG, 64
     )
     B = ENG.max_num_seqs
-    tables = np.stack([_table(list(range(6)))] + [_table([])] * (B - 1))
+    tables = _tables(blocks, B)
     for i, tok in enumerate(extra):
         toks_b = np.zeros(B, np.int32)
         toks_b[0] = tok
@@ -63,8 +55,8 @@ def test_prefill_then_decode_matches_monolithic_prefill(params):
         pos[0] = len(prompt) + i
         active = np.zeros(B, bool)
         active[0] = True
-        logits_b, k2, v2 = decode_step(
-            params, jnp.asarray(toks_b), k2, v2, jnp.asarray(tables),
+        logits_b, cache = decode_tokens(
+            params, cache, jnp.asarray(toks_b), jnp.asarray(tables),
             jnp.asarray(pos), jnp.asarray(active), CFG, ENG,
         )
         logits = logits_b[0]
@@ -75,30 +67,65 @@ def test_prefill_then_decode_matches_monolithic_prefill(params):
 def test_chunked_prefill_matches_monolithic(params):
     rng = np.random.RandomState(3)
     seq = rng.randint(0, CFG.vocab_size, size=48).tolist()
-    table = _table(list(range(8)))
+    blocks = list(range(8))
 
-    k1, v1 = init_cache(CFG, ENG)
-    toks = np.zeros(64, np.int32)
-    toks[:48] = seq
-    want, k1, v1 = prefill_step(
-        params, jnp.asarray(toks), k1, v1, jnp.asarray(table),
-        jnp.int32(48), jnp.int32(0), CFG, ENG,
+    want, _ = prefill_chunk(
+        params, init_cache(CFG, ENG), seq, 0, blocks, CFG, ENG, 64
     )
 
-    k2, v2 = init_cache(CFG, ENG)
-    a = np.zeros(32, np.int32)
-    a[:] = seq[:32]
-    _, k2, v2 = prefill_step(
-        params, jnp.asarray(a), k2, v2, jnp.asarray(table),
-        jnp.int32(32), jnp.int32(0), CFG, ENG,
-    )
-    b = np.zeros(32, np.int32)
-    b[:16] = seq[32:]
-    got, k2, v2 = prefill_step(
-        params, jnp.asarray(b), k2, v2, jnp.asarray(table),
-        jnp.int32(16), jnp.int32(32), CFG, ENG,
-    )
+    cache = init_cache(CFG, ENG)
+    _, cache = prefill_chunk(params, cache, seq[:32], 0, blocks, CFG, ENG, 32)
+    got, cache = prefill_chunk(params, cache, seq[32:], 32, blocks, CFG, ENG, 32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+def test_mixed_ragged_batch_matches_separate_calls(params):
+    """Two sequences of different chunk lengths in ONE forward_tokens call
+    match two single-sequence calls (the engine's mixed-wave shape)."""
+    from dynamo_tpu.engine.model import forward_tokens
+
+    rng = np.random.RandomState(11)
+    p1 = rng.randint(0, CFG.vocab_size, size=19).tolist()
+    p2 = rng.randint(0, CFG.vocab_size, size=9).tolist()
+    bs = ENG.block_size
+
+    want1, _ = prefill_chunk(
+        params, init_cache(CFG, ENG), p1, 0, [0, 1, 2], CFG, ENG, 32
+    )
+    want2, _ = prefill_chunk(
+        params, init_cache(CFG, ENG), p2, 0, [3, 4], CFG, ENG, 32
+    )
+
+    T = 32
+    n = len(p1) + len(p2)
+    tokens = np.zeros(T, np.int32)
+    tokens[:n] = p1 + p2
+    positions = np.zeros(T, np.int32)
+    positions[: len(p1)] = np.arange(len(p1))
+    positions[len(p1) : n] = np.arange(len(p2))
+    ids1, ids2 = np.array([0, 1, 2], np.int32), np.array([3, 4], np.int32)
+    write_pages = np.full(T, ENG.garbage_block, np.int32)
+    write_pages[: len(p1)] = ids1[np.arange(len(p1)) // bs]
+    write_pages[len(p1) : n] = ids2[np.arange(len(p2)) // bs]
+    write_offs = np.zeros(T, np.int32)
+    write_offs[:n] = positions[:n] % bs
+    tables = np.full((2, ENG.max_blocks_per_seq), ENG.garbage_block, np.int32)
+    tables[0, :3] = ids1
+    tables[1, :2] = ids2
+    kv_lens = np.array([len(p1), len(p2)], np.int32)
+    cu = np.array([0, len(p1), n], np.int32)
+    last_rows = np.array([len(p1) - 1, n - 1], np.int32)
+
+    logits, _ = forward_tokens(
+        params, init_cache(CFG, ENG),
+        jnp.asarray(tokens), jnp.asarray(positions),
+        jnp.asarray(write_pages), jnp.asarray(write_offs),
+        jnp.asarray(kv_lens), jnp.asarray(tables), jnp.asarray(cu),
+        jnp.asarray(np.array([2], np.int32)), jnp.asarray(last_rows),
+        CFG, ENG,
+    )
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(want1), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(logits[1]), np.asarray(want2), rtol=2e-3, atol=2e-3)
 
 
 def test_paged_attention_pallas_matches_reference():

@@ -11,7 +11,8 @@ import jax.numpy as jnp  # noqa: E402
 
 from dynamo_tpu.engine.config import EngineConfig  # noqa: E402
 from dynamo_tpu.engine.loader import load_hf_llama  # noqa: E402
-from dynamo_tpu.engine.model import init_cache, prefill_step_impl  # noqa: E402
+from dynamo_tpu.engine.model import init_cache  # noqa: E402
+from tests.model_harness import prefill_chunk  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -47,13 +48,25 @@ def test_loader_matches_transformers_logits(hf_checkpoint):
         num_kv_blocks=16, block_size=8, max_num_seqs=2, max_model_len=64,
         prefill_buckets=(16, 32), decode_buckets=(2,),
     )
-    k, v = init_cache(cfg, eng, dtype=jnp.float32)
-    table = np.full(eng.max_blocks_per_seq, eng.garbage_block, np.int32)
-    table[:2] = [0, 1]
-    toks = np.zeros(16, np.int32)
-    toks[: len(prompt)] = prompt
-    got, _, _ = prefill_step_impl(
-        params, jnp.asarray(toks), k, v, jnp.asarray(table),
-        jnp.int32(len(prompt)), jnp.int32(0), cfg, eng, kv_span=16,
-    )
+    cache = init_cache(cfg, eng, dtype=jnp.float32)
+    got, _ = prefill_chunk(params, cache, prompt, 0, [0, 1], cfg, eng, 16)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_loader_tp_blocked_layout_matches_tp1(hf_checkpoint):
+    """load_hf_llama(tp=2) is a column permutation of tp=1 — same model."""
+    from dynamo_tpu.engine.model import split_gu, split_qkv
+
+    path, _ = hf_checkpoint
+    cfg, p1 = load_hf_llama(path, dtype=jnp.float32, tp=1)
+    _, p2 = load_hf_llama(path, dtype=jnp.float32, tp=2)
+    x = np.random.RandomState(0).randn(4, cfg.hidden_size).astype(np.float32)
+    for a, b in zip(
+        split_qkv(jnp.asarray(x) @ p1["layers"]["wqkv"][0], cfg, 1),
+        split_qkv(jnp.asarray(x) @ p2["layers"]["wqkv"][0], cfg, 2),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+    g1, u1 = split_gu(jnp.asarray(x) @ p1["layers"]["wgu"][0], 1)
+    g2, u2 = split_gu(jnp.asarray(x) @ p2["layers"]["wgu"][0], 2)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u2), rtol=1e-5, atol=1e-5)

@@ -6,8 +6,15 @@ import numpy as np
 
 from dynamo_tpu.engine import EngineCore, tiny_engine
 from dynamo_tpu.engine.config import ModelConfig, tiny_moe
-from dynamo_tpu.engine.model import _mlp, _moe_mlp, init_cache, init_params, prefill_step_impl
+from dynamo_tpu.engine.model import (
+    _mlp,
+    _moe_mlp,
+    fuse_gu,
+    init_cache,
+    init_params,
+)
 from dynamo_tpu.parallel.sharding import cache_sharding, make_mesh, shard_params
+from tests.model_harness import prefill_chunk
 from tests.test_engine_core import _req, run_to_completion
 
 MOE = tiny_moe()
@@ -21,16 +28,15 @@ def test_moe_reduces_to_dense_with_identical_experts():
         num_experts=4, num_experts_per_tok=4, tie_embeddings=True,
     )
     rng = jax.random.PRNGKey(0)
-    dense_w = {
-        "w_gate": jax.random.normal(rng, (16, 32)) * 0.1,
-        "w_up": jax.random.normal(jax.random.fold_in(rng, 1), (16, 32)) * 0.1,
-        "w_down": jax.random.normal(jax.random.fold_in(rng, 2), (32, 16)) * 0.1,
-    }
+    w_gate = jax.random.normal(rng, (16, 32)) * 0.1
+    w_up = jax.random.normal(jax.random.fold_in(rng, 1), (16, 32)) * 0.1
+    w_down = jax.random.normal(jax.random.fold_in(rng, 2), (32, 16)) * 0.1
+    dense_w = {"wgu": fuse_gu(w_gate, w_up), "w_down": w_down}
     moe_lp = {
         "w_router": jnp.zeros((16, 4)),  # uniform routing
-        "w_gate": jnp.tile(dense_w["w_gate"][None], (4, 1, 1)),
-        "w_up": jnp.tile(dense_w["w_up"][None], (4, 1, 1)),
-        "w_down": jnp.tile(dense_w["w_down"][None], (4, 1, 1)),
+        "w_gate": jnp.tile(w_gate[None], (4, 1, 1)),
+        "w_up": jnp.tile(w_up[None], (4, 1, 1)),
+        "w_down": jnp.tile(w_down[None], (4, 1, 1)),
     }
     x = jax.random.normal(jax.random.fold_in(rng, 3), (6, 16))
     dense_cfg = ModelConfig(
@@ -38,7 +44,7 @@ def test_moe_reduces_to_dense_with_identical_experts():
         num_layers=1, num_heads=2, num_kv_heads=2, head_dim=8, dtype="float32",
         tie_embeddings=True,
     )
-    want = _mlp(x, dense_w, dense_cfg)
+    want = _mlp(x, dense_w, dense_cfg, tp=1)
     got = _moe_mlp(x, moe_lp, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
@@ -72,26 +78,17 @@ def test_moe_engine_generates_end_to_end():
 
 def test_moe_expert_parallel_matches_single_device():
     eng = tiny_engine()
-    params = init_params(jax.random.PRNGKey(2), MOE)
-    prompt = np.arange(1, 21, dtype=np.int32)
-    table = np.full(eng.max_blocks_per_seq, eng.garbage_block, np.int32)
-    table[:4] = [0, 1, 2, 3]
-    toks = np.zeros(32, np.int32)
-    toks[:20] = prompt
+    prompt = list(np.arange(1, 21))
+    blocks = [0, 1, 2, 3]
 
-    def run(p, k, v):
-        logits, k, v = prefill_step_impl(
-            p, jnp.asarray(toks), k, v, jnp.asarray(table),
-            jnp.int32(20), jnp.int32(0), MOE, eng, kv_span=32,
-        )
-        return logits
-
-    k0, v0 = init_cache(MOE, eng)
-    want = run(params, k0, v0)
+    params1 = init_params(jax.random.PRNGKey(2), MOE, tp=1)
+    want, _ = prefill_chunk(
+        params1, init_cache(MOE, eng), prompt, 0, blocks, MOE, eng, 32
+    )
 
     mesh = make_mesh(dp=2, tp=2)  # ep rides the tp axis: 4 experts / 2
-    sp = shard_params(params, MOE, mesh)
-    kd = jax.device_put(jnp.zeros_like(k0), cache_sharding(mesh))
-    vd = jax.device_put(jnp.zeros_like(v0), cache_sharding(mesh))
-    got = jax.jit(run)(sp, kd, vd)
+    params2 = init_params(jax.random.PRNGKey(2), MOE, tp=2)
+    sp = shard_params(params2, MOE, mesh)
+    cd = jax.device_put(init_cache(MOE, eng), cache_sharding(mesh))
+    got, _ = prefill_chunk(sp, cd, prompt, 0, blocks, MOE, eng, 32, mesh=mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)

@@ -2,7 +2,10 @@
 
 Sharded prefill+decode must compile, execute, and match the unsharded
 single-device results (GSPMD inserts the collectives; numerics identical
-up to reduction order).
+up to reduction order). The fused wqkv/wgu projections are shard-blocked:
+`init_params(rng, cfg, tp)` with different tp values describes the SAME
+model with permuted fused columns, so a tp=4 run and a tp=1 run are
+directly comparable.
 """
 
 import jax
@@ -11,12 +14,7 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
-from dynamo_tpu.engine.model import (
-    decode_step_impl,
-    init_cache,
-    init_params,
-    prefill_step_impl,
-)
+from dynamo_tpu.engine.model import decode_tokens, init_cache, init_params
 from dynamo_tpu.parallel.sharding import (
     cache_sharding,
     decode_batch_shardings,
@@ -24,6 +22,7 @@ from dynamo_tpu.parallel.sharding import (
     param_shardings,
     shard_params,
 )
+from tests.model_harness import prefill_chunk
 
 CFG = ModelConfig(
     name="dryrun",
@@ -53,42 +52,56 @@ def test_mesh_construction():
     assert mesh.shape == {"dp": 2, "tp": 4}
 
 
-def test_sharded_prefill_decode_matches_single_device():
-    params = init_params(jax.random.PRNGKey(0), CFG)
-    prompt = list(np.random.RandomState(1).randint(1, 500, size=20))
-    table = np.full(ENG.max_blocks_per_seq, ENG.garbage_block, np.int32)
-    table[:4] = [0, 1, 2, 3]
-    toks = np.zeros(32, np.int32)
-    toks[:20] = prompt
+def test_fused_layouts_describe_same_model():
+    """init_params(tp=4) is a column permutation of init_params(tp=1):
+    split_qkv recovers identical natural-order projections."""
+    from dynamo_tpu.engine.model import split_gu, split_qkv
 
-    def run(params_in, k, v):
-        logits, k, v = prefill_step_impl(
-            params_in, jnp.asarray(toks), k, v, jnp.asarray(table),
-            jnp.int32(20), jnp.int32(0), CFG, ENG, kv_span=32,
+    p1 = init_params(jax.random.PRNGKey(0), CFG, tp=1)
+    p4 = init_params(jax.random.PRNGKey(0), CFG, tp=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, CFG.hidden_size))
+    qkv1 = x @ p1["layers"]["wqkv"][0]
+    qkv4 = x @ p4["layers"]["wqkv"][0]
+    for a, b in zip(split_qkv(qkv1, CFG, 1), split_qkv(qkv4, CFG, 4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+    g1, u1 = split_gu(x @ p1["layers"]["wgu"][0], 1)
+    g4, u4 = split_gu(x @ p4["layers"]["wgu"][0], 4)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g4), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u4), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_prefill_decode_matches_single_device():
+    prompt = list(np.random.RandomState(1).randint(1, 500, size=20))
+    blocks = [0, 1, 2, 3]
+
+    def run(params_in, cache, mesh):
+        logits, cache = prefill_chunk(
+            params_in, cache, prompt, 0, blocks, CFG, ENG, 32, mesh=mesh
         )
         B = 8
-        tables = np.tile(table, (B, 1))
+        tables = np.full((B, ENG.max_blocks_per_seq), ENG.garbage_block, np.int32)
+        tables[0, :4] = blocks
         tok_b = jnp.zeros(B, jnp.int32).at[0].set(jnp.argmax(logits).astype(jnp.int32))
         pos = np.zeros(B, np.int32)
         pos[0] = 20
         act = np.zeros(B, bool)
         act[0] = True
-        logits_b, k, v = decode_step_impl(
-            params_in, tok_b, k, v, jnp.asarray(tables),
-            jnp.asarray(pos), jnp.asarray(act), CFG, ENG,
+        logits_b, cache = decode_tokens(
+            params_in, cache, tok_b, jnp.asarray(tables),
+            jnp.asarray(pos), jnp.asarray(act), CFG, ENG, mesh,
         )
         return logits, logits_b[0]
 
-    # Single-device ground truth.
-    k0, v0 = init_cache(CFG, ENG)
-    want_p, want_d = run(params, k0, v0)
+    # Single-device ground truth (tp=1 fused layout).
+    params1 = init_params(jax.random.PRNGKey(0), CFG, tp=1)
+    want_p, want_d = run(params1, init_cache(CFG, ENG), None)
 
-    # Sharded: params on tp, cache kv-heads on tp, batch on dp.
+    # Sharded: tp=4-blocked params on the mesh, cache combined-heads on tp.
     mesh = make_mesh(dp=2, tp=4)
-    sp = shard_params(params, CFG, mesh)
-    kd = jax.device_put(jnp.zeros_like(k0), cache_sharding(mesh))
-    vd = jax.device_put(jnp.zeros_like(v0), cache_sharding(mesh))
-    got_p, got_d = jax.jit(run)(sp, kd, vd)
+    params4 = init_params(jax.random.PRNGKey(0), CFG, tp=4)
+    sp = shard_params(params4, CFG, mesh)
+    cd = jax.device_put(init_cache(CFG, ENG), cache_sharding(mesh))
+    got_p, got_d = run(sp, cd, mesh)
 
     np.testing.assert_allclose(np.asarray(got_p), np.asarray(want_p), rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(got_d), np.asarray(want_d), rtol=1e-4, atol=1e-4)
