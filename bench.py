@@ -1,17 +1,26 @@
-"""Serving throughput bench on the flagship single-chip model.
+"""Serving benchmark on the flagship single-chip model — north-star
+metrics per BASELINE.md: tokens/sec/chip + p50 TTFT/TPOT per config.
 
-Drives EngineCore (the real jitted engine: bucketed prefill, batched
-paged-attention decode with fused sampling) through a fixed synthetic
-workload and prints ONE JSON line:
+Drives EngineCore (the real jitted engine: bucketed ragged prefill,
+batched paged-attention decode chains with fused sampling) through
+synthetic workloads shaped after the reference's harness
+(`/root/reference/benchmarks/llm/perf.sh:18-27`: ISL/OSL presets and a
+concurrency sweep scaled to one chip).
 
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Prints one JSON line per secondary config, then the PRIMARY line last
+(the driver records the final line):
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "configs": [...]}
 
 ``vs_baseline`` is measured throughput over an HBM-bandwidth roofline for
 the decode phase (decode is bandwidth-bound: every step streams the full
 weights plus the batch's live KV), so 1.0 means saturating the chip's
-memory system — the honest ceiling for autoregressive decode. Workload
-shape follows the reference's harness defaults scaled to one chip
-(`benchmarks/llm/perf.sh:18-27`, SURVEY.md §6).
+memory system — the honest ceiling for autoregressive decode.
+
+Engine shapes account for the axon-relay chip: every device program
+invocation costs ~58 ms fixed (tools/profile_decode.py, PERF.md), so
+prefill buckets pack whole admission waves and decode chains fuse up to
+128 steps.
 """
 
 from __future__ import annotations
@@ -19,21 +28,42 @@ from __future__ import annotations
 import json
 import os
 import time
+from dataclasses import dataclass, field
 
 import numpy as np
 
-BATCH = 32
-ISL = 128
-OSL = 128
-
 # HBM bandwidth by TPU generation (GB/s); v5e default.
 HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819))
+QUICK = bool(os.environ.get("BENCH_QUICK"))
 
 
-def main() -> None:
-    import jax
+@dataclass
+class Config:
+    name: str
+    batch: int
+    isl: int
+    osl: int
+    engine_kw: dict = field(default_factory=dict)
+    primary: bool = False
 
-    from dynamo_tpu.engine.config import EngineConfig, llama3_1b
+
+CONFIGS = [
+    # Saturation throughput (the primary metric, reference perf.sh shape
+    # scaled to one chip).
+    Config("saturated", batch=32, isl=128, osl=128, primary=True),
+    # Wider batch: more tokens/sec, roofline rises too.
+    Config("wide", batch=64, isl=128, osl=128,
+           engine_kw=dict(num_kv_blocks=1024)),
+    # Low-concurrency latency.
+    Config("low-conc", batch=8, isl=128, osl=128),
+    # Long-prefill, TTFT-heavy (reference default ISL is 3000).
+    Config("long-prefill", batch=8, isl=2048, osl=64,
+           engine_kw=dict(max_model_len=4096, num_kv_blocks=1024)),
+]
+
+
+def run_config(cfg_model, c: Config) -> dict:
+    from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
@@ -41,72 +71,214 @@ def main() -> None:
         StopConditions,
     )
 
-    cfg = llama3_1b()
-    eng = EngineConfig(
-        num_kv_blocks=512,
+    kw = dict(
+        num_kv_blocks=768,
         block_size=32,
-        max_num_seqs=BATCH,
+        max_num_seqs=c.batch,
         max_model_len=512,
-        prefill_buckets=(ISL,),
-        decode_buckets=(BATCH,),
-        decode_chain=32,
+        prefill_buckets=(2048,),
+        prefill_batch=16,
+        decode_buckets=(c.batch,),
+        decode_chain=min(128, c.osl),
     )
-    core = EngineCore(cfg, eng, seed=0)
+    kw.update(c.engine_kw)
+    kw["prefill_buckets"] = tuple(
+        b for b in kw["prefill_buckets"] if b <= kw["max_model_len"]
+    ) or (kw["max_model_len"],)
+    eng = EngineConfig(**kw)
+    core = EngineCore(cfg_model, eng, seed=0)
     rng = np.random.RandomState(0)
 
     def req(i: int, n_out: int) -> PreprocessedRequest:
         return PreprocessedRequest(
             model="bench",
-            token_ids=rng.randint(1, cfg.vocab_size, size=ISL).tolist(),
+            token_ids=rng.randint(1, cfg_model.vocab_size, size=c.isl).tolist(),
             request_id=f"bench-{i}",
             sampling=SamplingOptions(temperature=0.0),
             stop=StopConditions(max_tokens=n_out, ignore_eos=True),
         )
 
-    def drain(n_expected: int) -> tuple[int, float, float]:
-        """Run until n_expected finishes; returns (tokens, ttft_sum, t)."""
+    def drain(n_expected: int):
+        """Run to completion; per-request first/last token timestamps."""
         finished = 0
         tokens = 0
-        first_seen: dict[str, float] = {}
+        first: dict[str, float] = {}
+        last: dict[str, float] = {}
+        counts: dict[str, int] = {}
         t0 = time.perf_counter()
         while finished < n_expected:
             for seq, out in core.step():
+                now = time.perf_counter()
                 tokens += len(out.token_ids)
-                if seq.request_id not in first_seen:
-                    first_seen[seq.request_id] = time.perf_counter() - t0
+                rid = seq.request_id
+                counts[rid] = counts.get(rid, 0) + len(out.token_ids)
+                first.setdefault(rid, now - t0)
+                last[rid] = now - t0
                 if out.finish_reason:
                     finished += 1
-        return tokens, sum(first_seen.values()), time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        tpots = [
+            (last[r] - first[r]) / (counts[r] - 1) for r in first if counts[r] > 1
+        ]
+        return tokens, elapsed, first, tpots
 
-    # Warmup: trigger the prefill + full-chain decode compiles.
-    core.add_request(req(9999, eng.decode_chain))
-    drain(1)
+    # Warmup: compile the prefill bucket + decode chain programs.
+    core.add_request(req(99990, eng.decode_chain))
+    core.add_request(req(99991, eng.decode_chain))
+    drain(2)
 
-    for i in range(BATCH):
-        core.add_request(req(i, OSL))
-    tokens, ttft_sum, elapsed = drain(BATCH)
+    for i in range(c.batch):
+        core.add_request(req(i, c.osl))
+    tokens, elapsed, first, tpots = drain(c.batch)
+    del core
 
     throughput = tokens / elapsed
 
     # Decode roofline: per step, weights + live KV of the batch stream
     # from HBM. Mean context during decode = ISL + OSL/2.
     kv_bytes_per_tok = (
-        cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2 * 2  # K+V, bf16
+        cfg_model.num_layers * cfg_model.num_kv_heads * cfg_model.head_dim * 2 * 2
     )
-    mean_ctx = ISL + OSL / 2
-    step_bytes = cfg.param_bytes() + BATCH * mean_ctx * kv_bytes_per_tok
-    roofline = BATCH / (step_bytes / (HBM_GBPS * 1e9))
+    mean_ctx = c.isl + c.osl / 2
+    step_bytes = cfg_model.param_bytes() + c.batch * mean_ctx * kv_bytes_per_tok
+    roofline = c.batch / (step_bytes / (HBM_GBPS * 1e9))
 
-    print(
-        json.dumps(
-            {
-                "metric": f"llama3-1b agg tokens/sec/chip (B={BATCH}, {ISL}/{OSL})",
-                "value": round(throughput, 1),
-                "unit": "tokens/sec",
-                "vs_baseline": round(throughput / roofline, 4),
-            }
-        )
+    ttfts = sorted(first.values())
+    return {
+        "metric": (
+            f"{cfg_model.name} agg tokens/sec/chip "
+            f"({c.name}: B={c.batch}, {c.isl}/{c.osl})"
+        ),
+        "value": round(throughput, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(throughput / roofline, 4),
+        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+        "tpot_p50_ms": (
+            round(sorted(tpots)[len(tpots) // 2] * 1e3, 2) if tpots else None
+        ),
+    }
+
+
+def run_disagg_ab(model) -> dict:
+    """Aggregated-vs-disaggregated A/B sharing the one chip: a prefill
+    core and a decode core move KV via the v2 descriptor transfer
+    (EngineCore.export_descriptors / read_held_pages / import_blocks),
+    mirroring the P/D worker flow in backends/jax/main.py. Reports TTFT
+    and 8-token completion latency for a 2048-token prompt
+    (BASELINE.md disagg A/B; reference architecture.md:75)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
     )
+
+    ISL, OSL = 2048, 8
+    kw = dict(
+        num_kv_blocks=768, block_size=32, max_num_seqs=8, max_model_len=4096,
+        prefill_buckets=(2048,), prefill_batch=8, decode_buckets=(8,),
+        decode_chain=8,
+    )
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, model.vocab_size, size=ISL).tolist()
+
+    def req(tokens, rid, n_out, hold=False):
+        return PreprocessedRequest(
+            model="bench", token_ids=list(tokens), request_id=rid,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=n_out, ignore_eos=True),
+            kv_transfer_params={"do_remote_decode": True} if hold else None,
+        )
+
+    def run_until_done(core, seq):
+        toks, first_t = [], None
+        t0 = time.perf_counter()
+        while seq.finish is None:
+            for s, out in core.step():
+                if s is seq:
+                    if first_t is None:
+                        first_t = time.perf_counter() - t0
+                    toks.extend(out.token_ids)
+        return toks, first_t, time.perf_counter() - t0
+
+    # Aggregated baseline.
+    agg = EngineCore(model, EngineConfig(**kw), seed=0)
+    warm = agg.add_request(req(prompt[:64], "w", 8))
+    run_until_done(agg, warm)
+    seq = agg.add_request(req(prompt, "agg", OSL))
+    agg_toks, agg_ttft, agg_total = run_until_done(agg, seq)
+    del agg
+
+    # Disaggregated: prefill core holds blocks; decode core imports them
+    # and continues (prefix-cached, so its "prefill" is one token).
+    p_core = EngineCore(model, EngineConfig(**kw), seed=0)
+    d_core = EngineCore(model, EngineConfig(**kw), seed=0, params=p_core.params)
+    for core in (p_core, d_core):
+        w = core.add_request(req(prompt[:64], "w", 8))
+        run_until_done(core, w)
+
+    t0 = time.perf_counter()
+    pseq = p_core.add_request(req(prompt, "pf", 1, hold=True))
+    tok1, ttft_d, _ = run_until_done(p_core, pseq)
+    descs = p_core.export_descriptors("pf")
+    blocks = []
+    for s in range(0, len(descs), 8):
+        pages = p_core.read_held_pages("pf", s, 8)
+        blocks.extend(dict(descs[s + j], kv=kv) for j, kv in enumerate(pages))
+    p_core.release_held("pf")
+    d_core.import_blocks(blocks)
+    dseq = d_core.add_request(req(prompt + tok1, "dec", OSL - 1))
+    d_toks, _, _ = run_until_done(d_core, dseq)
+    disagg_total = time.perf_counter() - t0
+    assert tok1 + d_toks == agg_toks, "disagg output diverged from aggregated"
+    del p_core, d_core
+
+    return {
+        "metric": f"{model.name} disagg-vs-agg total latency ratio ({ISL}/{OSL})",
+        "value": round(disagg_total / agg_total, 3),
+        "unit": "x (1.0 = parity)",
+        "vs_baseline": round(agg_total / disagg_total, 4),
+        "ttft_agg_ms": round(agg_ttft * 1e3, 1),
+        "ttft_disagg_ms": round(ttft_d * 1e3, 1),
+        "ttft_ratio": round(ttft_d / agg_ttft, 3),
+    }
+
+
+def main() -> None:
+    from dynamo_tpu.engine.config import llama3_1b
+
+    model = llama3_1b()
+    configs = [c for c in CONFIGS if c.primary] if QUICK else CONFIGS
+    import traceback
+
+    results = []
+    primary = None
+    for c in configs:
+        try:
+            r = run_config(model, c)
+        except Exception:  # noqa: BLE001 — one config must not lose the rest
+            traceback.print_exc()
+            if c.primary:
+                raise  # without the primary there is nothing to report
+            continue
+        results.append(r)
+        if c.primary:
+            primary = r
+        else:
+            print(json.dumps(r), flush=True)
+    if not QUICK:
+        try:
+            r = run_disagg_ab(model)
+            results.append(r)
+            print(json.dumps(r), flush=True)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+    assert primary is not None
+    secondaries = [r for r in results if r is not primary]
+    primary = dict(primary)
+    primary["configs"] = secondaries
+    print(json.dumps(primary), flush=True)
 
 
 if __name__ == "__main__":

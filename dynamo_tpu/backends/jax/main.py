@@ -15,6 +15,13 @@ holds the request's KV blocks and returns descriptors; the decode worker
 pulls the blocks over the data plane (`kv_transfer` endpoint — the
 NIXL-equivalent host-staged DCN path), imports them into its cache, and
 continues decoding against the now-local prefix.
+
+Remote prefills route through a store WORK QUEUE, not a direct call
+(reference NATS JetStream queue, `transports/nats.rs:433-600`): decode
+pushes {request, reply_key} onto ``prefill:{namespace}``; prefill workers
+pop only while they hold admission capacity, so ``queue_len`` is the real
+fleet backlog the disagg router's queue-depth condition consults
+(`disagg_router.rs:24-100`).
 """
 
 from __future__ import annotations
@@ -22,7 +29,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 import dataclasses
+import json
 import logging
+import uuid
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
@@ -34,6 +43,11 @@ from dynamo_tpu.runtime import Context, DistributedRuntime
 from dynamo_tpu.runtime.worker import dynamo_worker
 
 log = logging.getLogger("dynamo_tpu.backends.jax")
+
+
+def _prefill_queue(namespace: str) -> str:
+    """Store work-queue name for a namespace's prefill fleet."""
+    return f"prefill:{namespace}"
 
 
 def build_engine(
@@ -168,24 +182,103 @@ async def run_jax_worker(
                 yield out
 
         async def kv_transfer_handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            # v2 streamed transfer: descriptors first (cheap), then page
+            # data in chunks — the engine keeps prefilling while pages
+            # stage out (reference nixl_connect descriptor flow,
+            # disagg_serving.md:88-96).
             rid = request["request_id"]
+            chunk = int(request.get("chunk_blocks", 8))
             try:
-                blocks, _ = await asyncio.to_thread(core.export_held_blocks, rid)
+                descs = core.export_descriptors(rid)
             except KeyError:
                 yield {"error": f"no held blocks for {rid}"}
                 return
-            for blk in blocks:
-                yield blk
+            yield {"version": core.KV_WIRE_VERSION, "blocks": descs}
+            try:
+                for s in range(0, len(descs), chunk):
+                    pages = await asyncio.to_thread(
+                        core.read_held_pages, rid, s, chunk
+                    )
+                    yield {
+                        "version": core.KV_WIRE_VERSION,
+                        "start": s,
+                        "kv": pages,
+                    }
+            finally:
+                core.release_held(rid)
 
         transfer_ep = (
             runtime.namespace(namespace).component(component).endpoint("kv_transfer")
         )
         await transfer_ep.serve(kv_transfer_handler)
         await endpoint.serve(handler)
+
+        # Work-queue consumer: pop a prefill task only while holding
+        # admission capacity, so queue_len reflects work the fleet has
+        # not yet absorbed (reference JetStream queue semantics,
+        # transports/nats.rs:433-600; dequeue loop in the arch doc's
+        # disagg flow, disagg_serving.md:28-66).
+        qname = _prefill_queue(namespace)
+        sem = asyncio.Semaphore(core.engine.max_num_seqs)
+        _inflight: set[asyncio.Task] = set()
+
+        async def _serve_queued(task: dict) -> None:
+            try:
+                req = task["request"]
+                ctx = Context(req.get("request_id") or f"qprefill-{uuid.uuid4().hex[:8]}")
+                last: dict | None = None
+                async for out in engine.generate(req, ctx):
+                    last = out
+                if last is None:
+                    last = {"error": "prefill produced no output"}
+                if last.get("kv_transfer_params"):
+                    last["kv_transfer_params"]["worker_id"] = worker_id
+                await runtime.store.kv_put(task["reply_key"], json.dumps(last).encode())
+            except Exception:
+                log.exception("queued prefill failed")
+                try:
+                    await runtime.store.kv_put(
+                        task["reply_key"],
+                        json.dumps({"error": "remote prefill failed"}).encode(),
+                    )
+                except Exception:  # noqa: BLE001 — store down; caller times out
+                    pass
+            finally:
+                sem.release()
+
+        async def _consume_queue() -> None:
+            while True:
+                await sem.acquire()
+                try:
+                    payload = await runtime.store.queue_pop(qname, timeout=1.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — store closed on shutdown
+                    sem.release()
+                    return
+                if payload is None:
+                    sem.release()
+                    continue
+                try:
+                    task = json.loads(payload)
+                except ValueError:
+                    log.warning("dropping malformed prefill task")
+                    sem.release()
+                    continue
+                # Hold a strong reference: the loop keeps only weak refs
+                # to tasks, and a GC'd task would leak its semaphore slot.
+                t = asyncio.create_task(_serve_queued(task))
+                _inflight.add(t)
+                t.add_done_callback(_inflight.discard)
+
+        consumer = asyncio.create_task(_consume_queue())
         log.info("jax prefill worker %d ready (model %r)", worker_id, model_name)
         if served_event is not None:
             served_event.set()
-        await runtime.wait_for_shutdown()
+        try:
+            await runtime.wait_for_shutdown()
+        finally:
+            consumer.cancel()
         return
 
     if role == "decode":
@@ -198,14 +291,27 @@ async def run_jax_worker(
             runtime.namespace(namespace).component("prefill").endpoint("kv_transfer").client()
         )
 
+        qname = _prefill_queue(namespace)
+
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            if request.get("embed"):
+                # Embeddings never disaggregate: run locally.
+                async for out in engine.generate(request, context):
+                    yield out
+                return
             pre = PreprocessedRequest.from_wire(request)
             pre.request_id = pre.request_id or context.id
             cached = await asyncio.to_thread(core.cached_prefix_tokens, pre.token_ids)
             uncached = len(pre.token_ids) - cached
+            depth = 0
+            if prefill_client.instance_ids():
+                try:
+                    depth = await runtime.store.queue_len(qname)
+                except Exception:  # noqa: BLE001 — store hiccup: stay local
+                    depth = disagg.config.max_prefill_queue_size + 1
             if (
                 prefill_client.instance_ids()
-                and disagg.should_remote_prefill(uncached)
+                and disagg.should_remote_prefill(uncached, depth)
             ):
                 # Track what already reached the client: a mid-stream
                 # failure must resume by token replay (migration.py
@@ -213,7 +319,7 @@ async def run_jax_worker(
                 emitted: list[int] = []
                 try:
                     async for out in _remote_prefill_then_decode(
-                        core, engine, pre, context, prefill_client,
+                        core, engine, pre, context, runtime.store, qname,
                         transfer_client, emitted,
                     ):
                         yield out
@@ -269,39 +375,77 @@ async def run_jax_worker(
 
 async def _remote_prefill_then_decode(
     core, engine, pre: PreprocessedRequest, context: Context,
-    prefill_client, transfer_client, emitted: list[int] | None = None,
+    store, qname: str, transfer_client, emitted: list[int] | None = None,
+    reply_timeout: float = 120.0,
 ) -> AsyncIterator[Any]:
-    """Decode-first disaggregation: remote prefill, block pull, local
-    continuation by token replay (reference handlers.py:113-151).
+    """Decode-first disaggregation: queued remote prefill, block pull,
+    local continuation by token replay (reference handlers.py:113-151;
+    queue flow disagg_serving.md:28-66).
 
     ``emitted`` (if given) collects every token yielded to the caller so a
     mid-stream failure can resume instead of replaying the stream."""
     from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+    from dynamo_tpu.runtime.store.client import StoreClient
 
     prefill_req = dataclasses.replace(
         pre,
         stop=StopConditions(max_tokens=1, ignore_eos=True),
         kv_transfer_params={"do_remote_decode": True},
     )
-    stream = await prefill_client.round_robin(prefill_req.to_wire())
+    reply_key = f"/dynamo/prefill-reply/{pre.request_id}-{uuid.uuid4().hex[:8]}"
+    sub = await store.kv_watch(reply_key, with_initial=False)
     first: dict | None = None
-    async for item in stream:
-        first = item
+    try:
+        await store.queue_push(
+            qname,
+            json.dumps(
+                {"request": prefill_req.to_wire(), "reply_key": reply_key}
+            ).encode(),
+        )
+        ev = await sub.get(timeout=reply_timeout)
+        event = StoreClient.as_watch_event(ev)
+        if event.value is not None:
+            first = json.loads(event.value)
+    finally:
+        await sub.unsubscribe()
+        await store.kv_del(reply_key)
     if first is None:
         raise ConnectionError("prefill worker returned no output")
+    if "error" in first:
+        raise ConnectionError(f"remote prefill failed: {first['error']}")
     out1 = LLMEngineOutput.from_wire(first)
     xfer = out1.kv_transfer_params or {}
     prefill_worker = xfer.get("worker_id")
     rid = xfer.get("request_id")
 
     if prefill_worker is not None and rid is not None:
-        blocks: list[dict] = []
+        descs: list[dict] | None = None
+        imported = total = 0
         bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
-        async for blk in bstream:
-            if "error" not in blk:
-                blocks.append(blk)
-        imported = await asyncio.to_thread(core.import_blocks, blocks)
-        log.debug("imported %d/%d transferred blocks for %s", imported, len(blocks), rid)
+        async for frame in bstream:
+            if "error" in frame:
+                log.warning("kv transfer aborted for %s: %s", rid, frame["error"])
+                break
+            ver = frame.get("version")
+            if ver != 2:
+                raise ConnectionError(
+                    f"unsupported KV transfer wire version {ver!r} "
+                    "(mixed-version prefill/decode pair?)"
+                )
+            if "blocks" in frame:
+                descs = frame["blocks"]
+                continue
+            if descs is None:
+                raise ConnectionError("KV transfer data frame before descriptors")
+            s = frame["start"]
+            batch = [
+                dict(descs[s + j], kv=kv) for j, kv in enumerate(frame["kv"])
+            ]
+            total += len(batch)
+            # Import chunk-by-chunk, concurrent with the engine's own
+            # admission/decode (the step lock is only held per splice).
+            imported += await asyncio.to_thread(core.import_blocks, batch)
+        log.debug("imported %d/%d transferred blocks for %s", imported, total, rid)
 
     token1 = out1.token_ids[0]
     first_chunk = LLMEngineOutput(

@@ -41,6 +41,10 @@ class ModelConfig:
     # over the mesh's model axis (expert parallelism, SURVEY.md §2.6).
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Per-expert capacity headroom for sparse dispatch: capacity =
+    # ceil(N * top_k / E * factor); tokens past it drop for that expert
+    # (Switch/GShard semantics).
+    moe_capacity_factor: float = 2.0
 
     @property
     def is_moe(self) -> bool:
@@ -93,6 +97,11 @@ class EngineConfig:
     # Host KV tier (G2): blocks evicted from HBM stay cached in host RAM
     # up to this many blocks and onboard back on prefix hits. 0 = off.
     host_kv_blocks: int = 0
+    # Disk KV tier (G3): host-pool evictions demote to hash-addressed
+    # files under this directory (requires host_kv_blocks > 0); only
+    # disk-tier eviction truly forgets a block. None = off.
+    disk_kv_dir: str | None = None
+    disk_kv_blocks: int = 4096
     enable_prefix_caching: bool = True
     # Decode batch buckets: compile decode at these widths only.
     decode_buckets: tuple[int, ...] = (8, 16, 32, 64)

@@ -41,11 +41,12 @@ from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksE
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.model import (
     decode_tokens,
+    embed_forward,
     forward_tokens,
     init_cache,
     init_params,
 )
-from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.engine.sampler import LOGPROBS_K, sample, token_logprobs
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
@@ -66,6 +67,8 @@ class Sequence:
     sampling: SamplingOptions
     stop: StopConditions
     seed: int
+    # Requested top-k logprob alternatives; None = logprobs off.
+    logprobs: int | None = None
     # -- device-cache bookkeeping --
     prompt_hashes: list[int] = field(default_factory=list)
     block_ids: list[int] = field(default_factory=list)
@@ -94,9 +97,43 @@ class Sequence:
         return self.prefilled >= self.prompt_len
 
 
+def _check_fuse_tp(params, tp: int) -> None:
+    """The fused wqkv/wgu column layout is tp-dependent; serving params
+    fused for a different tp would produce silently wrong logits
+    (permuted q/k/v and gate/up columns). Fail loudly instead."""
+    from dynamo_tpu.engine.model import params_fuse_tp
+
+    fused = params_fuse_tp(params)
+    if fused != tp:
+        raise ValueError(
+            f"params were fused for tp={fused} but the serving mesh has "
+            f"tp={tp}; reload with load_hf_llama(path, tp={tp}) or "
+            f"init_params(rng, cfg, tp={tp})"
+        )
+
+
+def _lp_entry(token: int, chosen, top_ids, top_lps, k: int) -> dict:
+    """Host-side logprob record for one emitted token: the device returns
+    LOGPROBS_K alternatives; slice to the k the request asked for.
+    ``top`` is [[token_id, logprob], ...] (descending) — NOT a dict: the
+    data plane's msgpack decoder rejects integer map keys."""
+    k = min(k, len(top_ids))
+    return {
+        "token_id": token,
+        "logprob": float(chosen),
+        "top": [[int(top_ids[j]), float(top_lps[j])] for j in range(k)],
+    }
+
+
 def _sample_from_logits(
-    logits, seeds, counters, temperature, top_k, top_p, need_mask: bool = True
+    logits, seeds, counters, temperature, top_k, top_p,
+    need_mask: bool = True, all_greedy: bool = False,
 ):
+    if all_greedy:
+        return sample(
+            logits, jax.random.PRNGKey(0), temperature, top_k, top_p,
+            need_mask=False, all_greedy=True,
+        )
     base = jax.random.PRNGKey(0)
     keys = jax.vmap(
         lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
@@ -107,12 +144,16 @@ def _sample_from_logits(
 def _decode_chain(
     params, cache, tokens, block_tables, positions, active,
     seeds, counters, temperature, top_k, top_p,
-    *, n_steps, need_mask, cfg, engine, mesh=None,
+    *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
+    cfg, engine, mesh=None,
 ):
     """n_steps fused decode+sample iterations in one program: each step
     writes the current token's K/V, attends, samples the next token —
     which feeds the next step on-device. Returns all sampled tokens
-    [n_steps, B]; the host applies stop conditions afterwards."""
+    [n_steps, B]; the host applies stop conditions afterwards. With
+    ``want_logprobs`` (a second compiled variant, chosen per batch like
+    ``need_mask``) each step also emits the chosen-token logprob and
+    LOGPROBS_K alternatives."""
     step = jnp.asarray(active, jnp.int32)
 
     def body(carry, i):
@@ -122,21 +163,23 @@ def _decode_chain(
             cfg, engine, mesh,
         )
         nxt = _sample_from_logits(
-            logits, seeds, counters + i, temperature, top_k, top_p, need_mask
+            logits, seeds, counters + i, temperature, top_k, top_p,
+            need_mask, all_greedy,
         )
-        return (nxt, cache), nxt
+        lp = token_logprobs(logits, nxt) if want_logprobs else None
+        return (nxt, cache), (nxt, lp)
 
-    (_, cache), sampled = jax.lax.scan(
+    (_, cache), (sampled, lps) = jax.lax.scan(
         body, (tokens, cache), jnp.arange(n_steps)
     )
-    return sampled, cache
+    return sampled, lps, cache
 
 
 def _prefill_and_sample(
     params, cache, tokens, positions, write_pages, write_offs,
     kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
     seeds, counters, temperature, top_k, top_p,
-    *, need_mask, cfg, engine, mesh=None,
+    *, need_mask, all_greedy=False, want_logprobs=False, cfg, engine, mesh=None,
 ):
     """One ragged prefill wave + fused first-token sampling: every row of
     the [S, vocab] last-token logits is sampled on-device; the host keeps
@@ -147,9 +190,10 @@ def _prefill_and_sample(
         cfg, engine, mesh,
     )
     toks = _sample_from_logits(
-        logits, seeds, counters, temperature, top_k, top_p, need_mask
+        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
     )
-    return toks, cache
+    lps = token_logprobs(logits, toks) if want_logprobs else None
+    return toks, lps, cache
 
 
 class EngineCore:
@@ -196,6 +240,8 @@ class EngineCore:
                     )
             self._batch_shardings = decode_batch_shardings(mesh)
             tp = int(mesh.shape["tp"])
+            if params is not None:
+                _check_fuse_tp(params, tp)
             if params is None:
                 # Initialize directly into the sharded layout — no
                 # single-device staging (a 70B pytree never fits one chip).
@@ -212,6 +258,8 @@ class EngineCore:
                 out_shardings=cache_sharding(mesh),
             )()
         else:
+            if params is not None:
+                _check_fuse_tp(params, 1)
             self.params = params if params is not None else init_params(
                 jax.random.PRNGKey(seed), model_cfg
             )
@@ -224,14 +272,41 @@ class EngineCore:
             on_removed=on_removed,
         )
         self.host_pool = None
+        self.disk_pool = None
+        self.offload = None
+        if engine_cfg.disk_kv_dir and engine_cfg.host_kv_blocks <= 0:
+            raise ValueError("disk_kv_dir (G3) requires host_kv_blocks > 0 (G2)")
         if engine_cfg.host_kv_blocks > 0:
             from dynamo_tpu.engine.host_cache import HostKvPool
+            from dynamo_tpu.engine.offload import DiskKvPool, OffloadEngine
 
             self.host_pool = HostKvPool(
                 engine_cfg.host_kv_blocks,
                 on_removed=lambda hashes: self.allocator.on_removed(hashes),
             )
+            if engine_cfg.disk_kv_dir:
+                self.disk_pool = DiskKvPool(
+                    engine_cfg.disk_kv_dir,
+                    engine_cfg.disk_kv_blocks,
+                    on_removed=lambda hashes: self.allocator.on_removed(hashes),
+                )
+            self.offload = OffloadEngine(self.host_pool, self.disk_pool)
             self.allocator.on_evict = self._offload_block
+
+        # Page movement programs (offload demotion + disagg transfer).
+        # Slices/gathers are enqueued on the device stream — executions
+        # are in-order, so they read bytes before any later program can
+        # rewrite them — and landed host-side off the step path.
+        self._slice_page = jax.jit(lambda cache, bid: cache[:, bid])
+        self._gather_pages = jax.jit(
+            lambda cache, ids: jnp.moveaxis(cache[:, ids], 1, 0)
+        )
+        self._scatter_pages = jax.jit(
+            lambda cache, ids, pages: cache.at[:, ids].set(
+                jnp.moveaxis(pages, 0, 1)
+            ),
+            donate_argnums=(0,),
+        )
 
         self._inbox: deque[Sequence] = deque()   # thread-safe enqueue
         self.waiting: deque[Sequence] = deque()
@@ -242,16 +317,17 @@ class EngineCore:
         # Serializes step() against cross-thread cache surgery
         # (import/export of disaggregated KV blocks).
         self._step_lock = threading.Lock()
+        self._embed_lock = threading.Lock()
         self._held: dict[str, Sequence] = {}
 
         self._prefill = jax.jit(
             partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
-            static_argnames=("need_mask",),
+            static_argnames=("need_mask", "all_greedy", "want_logprobs"),
             donate_argnums=(1,),
         )
         self._decode = jax.jit(
             partial(_decode_chain, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
-            static_argnames=("n_steps", "need_mask"),
+            static_argnames=("n_steps", "need_mask", "all_greedy", "want_logprobs"),
             donate_argnums=(1,),
         )
 
@@ -271,6 +347,7 @@ class EngineCore:
             sampling=pre.sampling,
             stop=pre.stop,
             seed=seed,
+            logprobs=pre.output.logprobs,
         )
         if not seq.prompt:
             raise ValueError("empty prompt")
@@ -355,28 +432,37 @@ class EngineCore:
             seq.hashed = TokenBlockSequence(seq.prompt[: seq.prefilled], bs)
             self.running.append(seq)
 
-    # -- host KV tier (G2) -------------------------------------------------
+    # -- tiered KV offload (G2 host / G3 disk) ------------------------------
 
     def _offload_block(self, block_id: int, block_hash: int, parent: int | None) -> None:
-        """Device eviction hook: demote the block's combined KV page
-        ``[L, page_size, 2*n_kv, d]`` to host RAM."""
-        page = np.asarray(self.cache[:, block_id])
-        self.host_pool.put(block_hash, parent, page)
+        """Device eviction hook: enqueue an async demotion of the block's
+        combined KV page ``[L, page_size, 2*n_kv, d]``. The slice program
+        is enqueued here (device executions are in-order, so it reads the
+        page before any later step reuses the physical block); the
+        blocking device->host landing happens on the offload worker
+        thread (reference offload.rs runs transfer engines off the
+        critical path the same way)."""
+        page = self._slice_page(self.cache, jnp.int32(block_id))
+        self.offload.submit(block_hash, parent, page)
 
     def _onboard_from_host(
         self, hashes: list[int], cached_ids: list[int], ncached: int, cap: int
     ) -> tuple[list[int], int]:
-        """Extend a device-cached prefix with host-tier hits: promote each
-        consecutive host block back to HBM and pin it."""
-        while ncached < cap and hashes[ncached] in self.host_pool:
+        """Extend a device-cached prefix with offload-tier hits: promote
+        each consecutive host/disk block back to HBM and pin it."""
+        while ncached < cap and self.offload.contains(hashes[ncached]):
             h = hashes[ncached]
+            got = self.offload.fetch(h)
+            if got is None:
+                break  # evicted between contains() and fetch()
+            parent_hash, kv = got
             try:
                 bid = self.allocator.alloc_for_import()
             except OutOfBlocksError:
+                self.offload.reinsert(h, parent_hash, kv)  # undo the pop
                 break
-            blk = self.host_pool.pop(h)
-            self.cache = self.cache.at[:, bid].set(jnp.asarray(blk.kv))
-            self.allocator.register_inactive(bid, h, blk.parent_hash, emit=False)
+            self.cache = self.cache.at[:, bid].set(jnp.asarray(kv))
+            self.allocator.register_inactive(bid, h, parent_hash, emit=False)
             cached_ids.extend(self.allocator.acquire_cached([h]))
             ncached += 1
         return cached_ids, ncached
@@ -467,8 +553,10 @@ class EngineCore:
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s, _ in chosen
         )
+        want_lp = any(s.logprobs is not None for s, _ in chosen)
+        all_greedy = all(s.sampling.temperature == 0.0 for s, _ in chosen)
 
-        toks, self.cache = self._prefill(
+        toks, lps, self.cache = self._prefill(
             self.params,
             self.cache,
             jnp.asarray(tokens),
@@ -485,9 +573,12 @@ class EngineCore:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
-            need_mask=need_mask,
+            need_mask=need_mask and not all_greedy,
+            all_greedy=all_greedy,
+            want_logprobs=want_lp,
         )
         toks = np.asarray(toks)
+        lps = None if lps is None else tuple(np.asarray(a) for a in lps)
 
         out = []
         for i, (seq, chunk) in enumerate(chosen):
@@ -497,7 +588,10 @@ class EngineCore:
             self._commit_completed(seq, completed)
             seq.prefilled += chunk
             seq.processed = seq.prefilled
-            out.append((seq, chunk, int(toks[i]) if seq.prefill_done else None))
+            lp = None
+            if seq.prefill_done and lps is not None and seq.logprobs is not None:
+                lp = _lp_entry(int(toks[i]), lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
+            out.append((seq, chunk, int(toks[i]) if seq.prefill_done else None, lp))
         return out
 
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
@@ -566,7 +660,9 @@ class EngineCore:
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
         )
-        out, self.cache = self._decode(
+        want_lp = any(s.logprobs is not None for s in seqs)
+        all_greedy = all(s.sampling.temperature == 0.0 for s in seqs)
+        out, lps, self.cache = self._decode(
             self.params,
             self.cache,
             self._put_batch(tokens),
@@ -579,9 +675,13 @@ class EngineCore:
             self._put_batch(top_k),
             self._put_batch(top_p),
             n_steps=n_steps,
-            need_mask=need_mask,
+            need_mask=need_mask and not all_greedy,
+            all_greedy=all_greedy,
+            want_logprobs=want_lp,
         )
-        return np.asarray(out)  # [n_steps, B]
+        if lps is not None:
+            lps = tuple(np.asarray(a) for a in lps)
+        return np.asarray(out), lps  # [n_steps, B], lp arrays or None
 
     # -- the iteration -----------------------------------------------------
 
@@ -603,12 +703,12 @@ class EngineCore:
 
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
-            for seq, _chunk, tok in self._run_prefill_wave(prefills):
+            for seq, _chunk, tok, lp in self._run_prefill_wave(prefills):
                 if tok is None:
                     continue  # prompt not finished this wave
                 seq.pending = tok
                 seq.generated += 1
-                outputs.append((seq, self._emit(seq, tok)))
+                outputs.append((seq, self._emit(seq, tok, lp)))
                 if seq.finish is not None:
                     self._finish(seq)
             return outputs
@@ -634,21 +734,63 @@ class EngineCore:
         if not ready:
             return outputs
 
-        chained = self._run_decode(ready, n_steps)  # [n_steps, len(ready)]
+        chained, lps = self._run_decode(ready, n_steps)  # [n_steps, len(ready)]
         for i, seq in enumerate(ready):
-            for j in range(n_steps):
-                completed = seq.hashed.append(seq.pending)
-                if completed is not None:
-                    self._commit_completed(seq, [completed])
-                seq.processed += 1
-                seq.generated += 1
-                new_tok = int(chained[j][i])
-                outputs.append((seq, self._emit(seq, new_tok)))
-                if seq.finish is not None:
-                    self._finish(seq)
-                    break
-                seq.pending = new_tok
+            toks = chained[:, i]
+            k, finish = self._scan_stop(seq, toks)
+            # Cache writes this chain: the old pending token plus the
+            # first k-1 sampled tokens (each step writes the current
+            # token's K/V, then samples the next).
+            written = [seq.pending] + [int(t) for t in toks[: k - 1]]
+            completed = seq.hashed.extend(written)
+            self._commit_completed(seq, completed)
+            seq.processed += k
+            seq.generated += k
+            emitted = [int(t) for t in toks[:k]]
+            lp_entries = None
+            if lps is not None and seq.logprobs is not None:
+                lp_entries = [
+                    _lp_entry(
+                        emitted[j], lps[0][j][i], lps[1][j][i], lps[2][j][i],
+                        seq.logprobs,
+                    )
+                    for j in range(k)
+                ]
+            outputs.append((seq, self._emit_chunk(seq, emitted, lp_entries, finish)))
+            if finish is not None:
+                seq.finish = finish
+                self._finish(seq)
+            else:
+                seq.pending = emitted[-1]
         return outputs
+
+    def _scan_stop(self, seq: Sequence, toks: np.ndarray) -> tuple[int, str | None]:
+        """Vectorized stop scan over a decode chain's sampled tokens:
+        returns (tokens emitted, finish reason or None). Token-level
+        precedence (eos > stop > length) is decided by check_token on the
+        single stopping token — one Python stop-check per CHAIN instead of
+        per token (the per-token host loop measured ~150 us/token,
+        PERF.md)."""
+        stop = seq.stop
+        n = len(toks)
+        k = n
+        watch: list[int] = []
+        if not stop.ignore_eos:
+            watch.extend(self.eos_token_ids)
+        watch.extend(stop.stop_token_ids)
+        if watch:
+            cand = np.isin(toks, np.asarray(watch, toks.dtype))
+            # min_tokens: stop triggers only once the budget floor passes.
+            if stop.min_tokens:
+                gen_after = seq.generated + np.arange(1, n + 1)
+                cand &= gen_after >= stop.min_tokens
+            if cand.any():
+                k = int(np.argmax(cand)) + 1
+        if stop.max_tokens is not None:
+            k = min(k, stop.max_tokens - seq.generated)
+        k = max(1, k)
+        finish = stop.check_token(int(toks[k - 1]), seq.generated + k, self.eos_token_ids)
+        return k, finish
 
     def _chain_length(self, seqs: list[Sequence]) -> int:
         """Fused decode steps this iteration. Always the configured chain
@@ -663,11 +805,44 @@ class EngineCore:
             return n
         return 1 << (n.bit_length() - 1)
 
-    def _emit(self, seq: Sequence, token: int) -> LLMEngineOutput:
+    def _emit_chunk(
+        self,
+        seq: Sequence,
+        tokens: list[int],
+        lp_entries: list[dict] | None,
+        finish: str | None,
+    ) -> LLMEngineOutput:
+        """One streamed chunk for a whole decode chain (stop already
+        decided by _scan_stop — ``tokens`` is exactly what the client
+        gets)."""
+        out = LLMEngineOutput(token_ids=tokens)
+        if lp_entries:
+            out.logprobs = lp_entries
+        if not seq.emitted_first:
+            seq.emitted_first = True
+            out.meta = {
+                "cached_tokens": seq.num_cached_tokens,
+                "iteration": self.iterations,
+            }
+        if finish is not None:
+            out.finish_reason = finish
+            out.prompt_tokens = seq.prompt_len
+            out.completion_tokens = seq.generated
+            if seq.hold_blocks:
+                out.kv_transfer_params = {
+                    "request_id": seq.request_id,
+                    "block_hashes": list(seq.pinned_hashes[: seq.committed_blocks]),
+                    "block_size": self.engine.block_size,
+                }
+        return out
+
+    def _emit(self, seq: Sequence, token: int, lp: dict | None = None) -> LLMEngineOutput:
         """Emit the newest sampled token. ``seq.generated`` already counts
         it, on both the prefill and decode paths."""
         finish = self._check_stop(seq, token)
         out = LLMEngineOutput(token_ids=[token])
+        if lp is not None:
+            out.logprobs = [lp]
         if not seq.emitted_first:
             seq.emitted_first = True
             out.meta = {
@@ -699,40 +874,62 @@ class EngineCore:
             self._release_blocks(seq)
 
     # -- disaggregated KV transfer (export on prefill, import on decode) ---
+    #
+    # v2 protocol (reference NIXL descriptor flow,
+    # nixl_connect/__init__.py:501-629, disagg_serving.md:88-96):
+    # descriptors first (hash chain + layout, no data, cheap and under
+    # the step lock), then page data streamed in chunks — the device
+    # gathers are enqueued and landed WITHOUT the step lock, because held
+    # blocks are pinned and cannot be rewritten by concurrent steps. The
+    # engine keeps decoding while blocks stage out.
 
-    def export_held_blocks(self, request_id: str) -> tuple[list[dict], Any]:
-        """Gather a held prefill's committed blocks off the device.
+    KV_WIRE_VERSION = 2
 
-        Returns (block descriptors, none) and releases the hold. Each
-        descriptor carries the hash chain plus the raw combined KV page
-        bytes [L, block_size, 2*n_kv, d]. The TPU-native analogue of NIXL
-        descriptor export (reference nixl_connect/__init__.py:501).
-        """
+    def export_descriptors(self, request_id: str) -> list[dict]:
+        """Phase 1: descriptor snapshot of a held prefill's committed
+        blocks. The hold stays until :meth:`release_held` (the caller
+        releases after the data phase)."""
         with self._step_lock:
-            seq = self._held.pop(request_id, None)
+            seq = self._held.get(request_id)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
-            blocks: list[dict] = []
+            shape = [
+                self.cfg.num_layers,
+                self.engine.block_size,
+                2 * self.cfg.num_kv_heads,
+                self.cfg.head_dim,
+            ]
+            dtype = np.dtype(self.cfg.jax_dtype).name
+            descs: list[dict] = []
             parent: int | None = None
             for i in range(seq.committed_blocks):
-                bid = seq.block_ids[i]
-                page = np.asarray(self.cache[:, bid])
                 # pinned_hashes tracks every committed block in order —
                 # including generated-token blocks past the prompt, which
                 # prompt_hashes would miss (IndexError at large max_tokens).
                 h = seq.pinned_hashes[i]
-                blocks.append(
-                    {
-                        "hash": h,
-                        "parent": parent,
-                        "kv": page.tobytes(),
-                        "shape": list(page.shape),
-                        "dtype": np.dtype(self.cfg.jax_dtype).name,
-                    }
+                descs.append(
+                    {"hash": h, "parent": parent, "shape": shape, "dtype": dtype}
                 )
                 parent = h
-            self._release_blocks(seq)
-            return blocks, None
+            return descs
+
+    def read_held_pages(self, request_id: str, start: int, count: int) -> list[bytes]:
+        """Phase 2: stage a chunk of a held prefill's pages to host as raw
+        bytes ([L, block_size, 2*n_kv, d] each). The step lock is held
+        only to DISPATCH the gather (concurrent steps donate self.cache,
+        so the handle must not be consumed between read and dispatch);
+        the blocking device->host landing runs unlocked — held blocks are
+        pinned, and device executions are in-order."""
+        with self._step_lock:
+            seq = self._held.get(request_id)
+            if seq is None:
+                raise KeyError(f"no held blocks for request {request_id}")
+            ids = seq.block_ids[start : start + count]
+            if not ids:
+                return []
+            pages_dev = self._gather_pages(self.cache, jnp.asarray(ids, jnp.int32))
+        pages = np.asarray(pages_dev)
+        return [np.ascontiguousarray(p).tobytes() for p in pages]
 
     def cached_prefix_tokens(self, token_ids: list[int]) -> int:
         """Locally cached leading tokens (disagg local-vs-remote decision)."""
@@ -749,25 +946,93 @@ class EngineCore:
     def import_blocks(self, blocks: list[dict]) -> int:
         """Write transferred KV pages into the local cache as inactive
         cached content; a following admission prefix-matches them. Returns
-        blocks actually imported (already-cached hashes are skipped)."""
+        blocks actually imported (already-cached hashes are skipped). One
+        batched scatter per call — the step lock is held only to splice
+        the device write and allocator state, never during host staging
+        (the caller already has the bytes in hand)."""
         import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 
+        staged: list[tuple[int, int | None, np.ndarray]] = []
+        for blk in blocks:
+            dtype = np.dtype(blk["dtype"])
+            page = np.frombuffer(blk["kv"], dtype=dtype).reshape(tuple(blk["shape"]))
+            staged.append((blk["hash"], blk["parent"], page))
+
         with self._step_lock:
-            imported = 0
-            for blk in blocks:
-                h = blk["hash"]
+            ids: list[int] = []
+            pages: list[np.ndarray] = []
+            pending: list[tuple[int, int, int | None]] = []
+            for h, parent, page in staged:
                 if self.allocator.is_cached(h):
                     continue
                 try:
                     bid = self.allocator.alloc_for_import()
                 except OutOfBlocksError:
                     break
-                dtype = np.dtype(blk["dtype"])
-                page = np.frombuffer(blk["kv"], dtype=dtype).reshape(tuple(blk["shape"]))
-                self.cache = self.cache.at[:, bid].set(jnp.asarray(page))
-                self.allocator.register_inactive(bid, h, blk["parent"])
-                imported += 1
-            return imported
+                ids.append(bid)
+                pages.append(page)
+                pending.append((bid, h, parent))
+            if ids:
+                self.cache = self._scatter_pages(
+                    self.cache,
+                    jnp.asarray(ids, jnp.int32),
+                    jnp.asarray(np.stack(pages)),
+                )
+                for bid, h, parent in pending:
+                    self.allocator.register_inactive(bid, h, parent)
+            return len(ids)
+
+    # -- embeddings --------------------------------------------------------
+
+    def embed(self, token_ids: list[int]) -> np.ndarray:
+        """Mean-pooled final-hidden embedding of one prompt ([h] f32).
+
+        Runs on a dedicated scratch paged cache (lazily built, reused,
+        donated) so the serving cache and allocator are untouched; length
+        snaps to the prefill buckets. The /v1/embeddings engine path
+        (reference service_v2.rs:277-336 routes embeddings through its
+        engines the same way)."""
+        T = len(token_ids)
+        if T == 0:
+            raise ValueError("empty input")
+        with self._embed_lock:
+            return self._embed_locked(token_ids, T)
+
+    def _embed_locked(self, token_ids: list[int], T: int) -> np.ndarray:
+        bucket = self._bucket_for(T)
+        bs = self.engine.block_size
+        n_pages = -(-bucket // bs)
+        if getattr(self, "_embed_scratch", None) is None:
+            shape = (
+                self.cfg.num_layers,
+                -(-self.engine.prefill_buckets[-1] // bs) + 1,
+                bs,
+                2 * self.cfg.num_kv_heads,
+                self.cfg.head_dim,
+            )
+            self._embed_scratch = jnp.zeros(shape, self.cfg.jax_dtype)
+            self._embed_fn = jax.jit(
+                partial(embed_forward, cfg=self.cfg, engine=self.engine, mesh=self.mesh),
+                donate_argnums=(1,),
+            )
+        garbage = self._embed_scratch.shape[1] - 1
+        tokens = np.zeros(bucket, np.int32)
+        tokens[:T] = token_ids
+        valid = np.zeros(bucket, bool)
+        valid[:T] = True
+        write_pages = np.full(bucket, garbage, np.int32)
+        write_pages[:T] = np.arange(T) // bs
+        tables = np.full((1, self._embed_scratch.shape[1] - 1), garbage, np.int32)
+        tables[0, :n_pages] = np.arange(n_pages)
+        pooled, self._embed_scratch = self._embed_fn(
+            self.params,
+            self._embed_scratch,
+            jnp.asarray(tokens),
+            jnp.asarray(valid),
+            jnp.asarray(write_pages),
+            jnp.asarray(tables),
+        )
+        return np.asarray(pooled)
 
     # -- observability -----------------------------------------------------
 

@@ -35,6 +35,16 @@ class TpuEngine:
         self._loop_task: asyncio.Task | None = None
 
     async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
+        if request.get("embed"):
+            # Embedding request: one forward, no scheduling (reference
+            # serves /v1/embeddings through its engines the same way).
+            vec = await asyncio.to_thread(self.core.embed, list(request["token_ids"]))
+            yield {
+                "embedding": [float(x) for x in vec.tolist()],
+                "prompt_tokens": len(request["token_ids"]),
+                "finish_reason": "stop",
+            }
+            return
         pre = PreprocessedRequest.from_wire(request)
         pre.request_id = pre.request_id or context.id
         seq = self.core.add_request(pre)

@@ -41,6 +41,10 @@ class HostKvPool:
         self.capacity = capacity_blocks
         self._blocks: OrderedDict[int, _HostBlock] = OrderedDict()  # LRU
         self.on_removed = on_removed or (lambda hashes: None)
+        # When set (G3 disk tier behind this pool), LRU eviction demotes
+        # the block — called with (hash, parent, kv) — instead of
+        # emitting `removed`.
+        self.on_evict_block: Callable[[int, int | None, np.ndarray], None] | None = None
         self.stats = HostPoolStats()
 
     def __contains__(self, block_hash: int) -> bool:
@@ -54,9 +58,12 @@ class HostKvPool:
             self._blocks.move_to_end(block_hash)
             return
         while len(self._blocks) >= self.capacity:
-            h, _ = self._blocks.popitem(last=False)
+            h, old = self._blocks.popitem(last=False)
             self.stats.evictions += 1
-            self.on_removed([h])
+            if self.on_evict_block is not None:
+                self.on_evict_block(h, old.parent_hash, old.kv)
+            else:
+                self.on_removed([h])
         self._blocks[block_hash] = _HostBlock(parent_hash, kv)
         self.stats.offloads += 1
 

@@ -44,6 +44,15 @@ def config_from_hf(path: str | Path) -> ModelConfig:
     )
 
 
+def _fuse_np(arrs: list[np.ndarray], tp: int) -> np.ndarray:
+    """numpy twin of model.fuse_qkv/fuse_gu: concatenate per-shard blocks
+    ``[a0_s | a1_s | ...]`` along the output axis, host-side."""
+    splits = [np.split(a, tp, axis=-1) for a in arrs]
+    return np.concatenate(
+        [blk for s in range(tp) for blk in (sp[s] for sp in splits)], axis=-1
+    )
+
+
 def _read_state_dict(path: Path) -> dict[str, np.ndarray]:
     """All tensors from safetensors shards or torch .bin files, as numpy."""
     tensors: dict[str, np.ndarray] = {}
@@ -76,8 +85,6 @@ def load_hf_llama(path: str | Path, dtype=None, tp: int = 1) -> tuple[ModelConfi
     """
     import jax.numpy as jnp
 
-    from dynamo_tpu.engine.model import fuse_gu, fuse_qkv
-
     path = Path(path)
     cfg = config_from_hf(path)
     dt = dtype or cfg.jax_dtype
@@ -98,20 +105,29 @@ def load_hf_llama(path: str | Path, dtype=None, tp: int = 1) -> tuple[ModelConfi
         "mlp_norm": np.stack(
             [t(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)]
         ),
-        "wqkv": np.asarray(fuse_qkv(
-            stack("self_attn.q_proj"),
-            stack("self_attn.k_proj"),
-            stack("self_attn.v_proj"),
+        # Host-side numpy fuse (same shard-blocked layout as model.fuse_qkv
+        # / fuse_gu): the two largest weight groups must not round-trip
+        # through the device during loading — at 70B scale that double
+        # transfer OOMs a single chip before serving even starts.
+        "wqkv": _fuse_np(
+            [
+                stack("self_attn.q_proj"),
+                stack("self_attn.k_proj"),
+                stack("self_attn.v_proj"),
+            ],
             tp,
-        )),
+        ),
         "wo": stack("self_attn.o_proj"),
-        "wgu": np.asarray(fuse_gu(stack("mlp.gate_proj"), stack("mlp.up_proj"), tp)),
+        "wgu": _fuse_np([stack("mlp.gate_proj"), stack("mlp.up_proj")], tp),
         "w_down": stack("mlp.down_proj"),
     }
     params: dict[str, Any] = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
         "layers": {k: jnp.asarray(v, dt) for k, v in layers.items()},
         "final_norm": jnp.asarray(t("model.norm.weight"), dt),
+        # The fuse layout is tp-dependent; record it so serving can verify
+        # params match the mesh (EngineCore asserts fuse_tp == mesh tp).
+        "fuse_tp": jnp.asarray(tp, jnp.int32),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(t("lm_head.weight").T, dt)

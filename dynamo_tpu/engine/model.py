@@ -126,10 +126,20 @@ def init_params(rng: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
         "embed": dense(keys[0], (v, h), h),
         "layers": layers,
         "final_norm": jnp.ones((h,), dt),
+        # The fused wqkv/wgu column layout depends on tp; carried in the
+        # pytree so serving can assert params match the mesh.
+        "fuse_tp": jnp.asarray(tp, jnp.int32),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(jax.random.fold_in(rng, 99), (h, v), h)
     return params
+
+
+def params_fuse_tp(params: Params) -> int:
+    """The tp the params' fused projections were laid out for (1 for
+    pytrees predating the marker)."""
+    v = params.get("fuse_tp")
+    return 1 if v is None else int(v)
 
 
 def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> jax.Array:
@@ -166,49 +176,124 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _mlp(x, lp, cfg: ModelConfig, tp: int):
+def _mlp(x, lp, cfg: ModelConfig, tp: int, mesh=None):
     if cfg.is_moe:
-        return _moe_mlp(x, lp, cfg)
+        return _moe_mlp(x, lp, cfg, mesh)
     gu = jnp.dot(x, lp["wgu"], preferred_element_type=jnp.float32)
     g, u = split_gu(gu, tp)
     act = (jax.nn.silu(g) * u).astype(x.dtype)
     return jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
 
 
-def _moe_mlp(x, lp, cfg: ModelConfig):
-    """Mixtral-style sparse MoE: softmax over top-k router logits, weighted
-    sum of expert SwiGLUs.
+def _moe_capacity(N: int, cfg: ModelConfig) -> int:
+    """Per-expert token capacity for a dispatch of N tokens (static)."""
+    k, E = cfg.num_experts_per_tok, cfg.num_experts
+    return max(1, min(N, int(-(-N * k * cfg.moe_capacity_factor // E))))
 
-    Dense-dispatch expert parallelism: every device computes its *local*
-    experts (expert axis sharded over the mesh's model axis) for all
-    tokens; the final contraction over the expert axis becomes a psum XLA
-    inserts. No token all-to-all — the right starting point on ICI, and
-    unselected experts contribute exact zeros. (Token-dropping all-to-all
-    dispatch is the later optimization; reference delegates wide-EP to
-    SGLang, SURVEY.md §2.6.)
+
+def _moe_dispatch_local(xf, w_router, w_gate, w_up, w_down, cfg: ModelConfig,
+                        e_offset, E_local: int):
+    """Sparse top-k MoE over a contiguous slice of E_local experts.
+
+    Capacity-bounded gather/scatter dispatch: each local expert computes a
+    dense [C, h] batch of only its assigned tokens, so per-token MLP FLOPs
+    scale with top_k (x capacity padding), not num_experts. Tokens past an
+    expert's capacity are dropped for that expert (standard Switch/GShard
+    semantics; `moe_capacity_factor` sizes the headroom). Runs per device
+    under expert parallelism — ``e_offset`` selects the shard's experts
+    and the caller psums the partial outputs (SURVEY.md §2.6 wide-EP row;
+    the reference delegates this to SGLang's WideEP, dsr1-wideep-h100.md).
+    """
+    N, h = xf.shape
+    k = cfg.num_experts_per_tok
+    C = _moe_capacity(N, cfg)
+
+    router = jnp.dot(xf, w_router, preferred_element_type=jnp.float32)  # [N, E]
+    vals, idx = jax.lax.top_k(router, k)
+    probs = jax.nn.softmax(vals, axis=-1)
+
+    flat_e = idx.reshape(-1) - e_offset                 # [N*k] local expert ids
+    flat_t = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    flat_w = probs.reshape(-1)
+    local = (flat_e >= 0) & (flat_e < E_local)
+
+    # Slot of each entry within its expert's capacity batch, via one-hot
+    # cumsum (O(N*k*E_local) int work — cheap next to the expert matmuls).
+    onehot = (flat_e[:, None] == jnp.arange(E_local)[None, :]) & local[:, None]
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1  # [N*k]
+    keep = local & (pos < C)
+    # Overflow/non-local entries land in a garbage row/slot.
+    e_c = jnp.where(keep, flat_e, E_local).astype(jnp.int32)
+    p_c = jnp.where(keep, pos, C).astype(jnp.int32)
+
+    gathered = jnp.zeros((E_local + 1, C + 1, h), xf.dtype).at[e_c, p_c].set(xf[flat_t])
+    g = gathered[:E_local, :C]                          # [E_local, C, h]
+    gate = jnp.einsum("ech,ehi->eci", g, w_gate, preferred_element_type=jnp.float32)
+    up = jnp.einsum("ech,ehi->eci", g, w_up, preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(xf.dtype)
+    down = jnp.einsum("eci,eih->ech", act, w_down, preferred_element_type=jnp.float32)
+
+    down_pad = jnp.pad(down, ((0, 1), (0, 1), (0, 0)))  # garbage row/slot -> 0
+    entry_out = down_pad[e_c, p_c]                      # [N*k, h] f32
+    w_masked = jnp.where(keep, flat_w, 0.0)
+    out = jnp.zeros((N, h), jnp.float32).at[flat_t].add(w_masked[:, None] * entry_out)
+    return out.astype(xf.dtype)
+
+
+def _moe_mlp(x, lp, cfg: ModelConfig, mesh=None):
+    """Mixtral-style sparse MoE: softmax over top-k router logits, weighted
+    sum of expert SwiGLUs, sparse capacity-bounded dispatch.
+
+    Under expert parallelism (mesh given, experts sharded over the model
+    axis — parallel/sharding.py) each device dispatches to its LOCAL
+    experts only and the partial token outputs psum over 'tp'. Tokens are
+    not all-to-all'ed: activations ride the replicated path while expert
+    weights stay resident per shard — the right trade on ICI at serving
+    batch sizes (weights dominate traffic).
     """
     shape = x.shape
     xf = x.reshape(-1, shape[-1])  # [N, h]
-    N = xf.shape[0]
-    router = jnp.dot(xf, lp["w_router"], preferred_element_type=jnp.float32)  # [N, E]
-    vals, idx = jax.lax.top_k(router, cfg.num_experts_per_tok)
-    probs = jax.nn.softmax(vals, axis=-1)
-    weights = (
-        jnp.zeros_like(router)
-        .at[jnp.arange(N)[:, None], idx]
-        .set(probs)
-    )  # [N, E], zero off the top-k
-    gate = jnp.einsum("nh,ehi->nei", xf, lp["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.einsum("nh,ehi->nei", xf, lp["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
-    down = jnp.einsum("nei,eih->neh", act, lp["w_down"], preferred_element_type=jnp.float32)
-    out = jnp.einsum("ne,neh->nh", weights, down)
-    return out.astype(x.dtype).reshape(shape)
+    E = cfg.num_experts
+
+    if mesh is None:
+        out = _moe_dispatch_local(
+            xf, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            cfg, jnp.int32(0), E,
+        )
+        return out.reshape(shape)
+
+    from jax.sharding import PartitionSpec as P
+
+    tp = int(mesh.shape["tp"])
+    E_local = E // tp
+
+    def local_fn(xr, w_router, w_gate, w_up, w_down):
+        off = jax.lax.axis_index("tp") * E_local
+        out = _moe_dispatch_local(xr, w_router, w_gate, w_up, w_down, cfg, off, E_local)
+        return jax.lax.psum(out, "tp")
+
+    out = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P("tp"), P("tp"), P("tp")),
+        out_specs=P(),
+        check_vma=False,
+    )(xf, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"])
+    return out.reshape(shape)
 
 
 def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+    if cfg.tie_embeddings:
+        # Contract over h with embed kept [V, h]: dot_general reads the
+        # embedding matrix in its stored layout. `embed.T` materialized a
+        # 2x-param-size transposed copy EVERY decode step (measured
+        # +1.6 ms/step at 1B scale on v5e — tools/profile_decode.py).
+        return jax.lax.dot_general(
+            x, params["embed"],
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
 
 
 def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -246,6 +331,33 @@ def forward_tokens(
     batches are all this function — a decode step is S sequences of
     q_len 1 (reference chunked-prefill semantics, vLLM scheduler shape).
     """
+    x, cache = forward_hidden(
+        params, cache, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine, mesh,
+    )
+    last = x[last_rows]  # [S, h]
+    return _logits(last, params, cfg), cache
+
+
+def forward_hidden(
+    params: Params,
+    cache: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    write_pages: jax.Array,
+    write_offs: jax.Array,
+    kv_lens: jax.Array,
+    block_tables: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """The transformer stack up to the final norm: returns (hidden states
+    [T, h], cache). Shared by the logits path (:func:`forward_tokens`)
+    and the embeddings path (reference serves /v1/embeddings through its
+    engines, http/service/service_v2.rs:277-336)."""
     T = tokens.shape[0]
     tp = int(mesh.shape["tp"]) if mesh is not None else 1
     sm_scale = cfg.head_dim ** -0.5
@@ -273,11 +385,44 @@ def forward_tokens(
             )
         attn = attn.reshape(T, cfg.q_size)
         x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = x[last_rows]  # [S, h]
-    return _logits(last, params, cfg), cache
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), cache
+
+
+def embed_forward(
+    params: Params,
+    scratch: jax.Array,      # dedicated scratch paged cache (donated)
+    tokens: jax.Array,       # [T] i32, one sequence
+    valid: jax.Array,        # [T] bool (bucket padding mask)
+    write_pages: jax.Array,  # [T] i32 into the scratch cache
+    block_tables: jax.Array, # [1, scratch_pages] i32
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Causal LLM-as-embedder: one full forward over the prompt, masked
+    mean pooling of the final-norm hidden states. Returns
+    ([h] f32 embedding, scratch).
+
+    Bucket-padded rows write to the garbage page (caller's
+    ``write_pages``) and causal masking keeps valid rows from attending
+    them; pooling masks them out of the mean."""
+    T = tokens.shape[0]
+    positions = jnp.arange(T, dtype=jnp.int32)
+    write_offs = positions % engine.block_size
+    kv_lens = jnp.asarray([T], jnp.int32)
+    cu = jnp.asarray([0, T], jnp.int32)
+    num_seqs = jnp.asarray([1], jnp.int32)
+    x, scratch = forward_hidden(
+        params, scratch, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu, num_seqs, cfg, engine, mesh,
+    )
+    w = valid.astype(jnp.float32)[:, None]
+    pooled = jnp.sum(x.astype(jnp.float32) * w, axis=0) / jnp.maximum(
+        jnp.sum(w), 1.0
+    )
+    return pooled, scratch
 
 
 def decode_tokens(

@@ -1,0 +1,198 @@
+"""Async tiered KV offload pipeline: G1 device -> G2 host RAM -> G3 disk.
+
+The TPU-native analogue of the reference's KVBM offload manager
+(`lib/llm/src/block_manager/offload.rs:1686` — async transfer engines with
+an in-queue of evicted blocks, off the engine's critical path — and
+`storage/disk.rs` for the G3 tier).
+
+Design:
+
+- **Eviction never blocks the engine step.** When G1 evicts, the engine
+  enqueues a jitted page *slice* on the device stream (it reads the page's
+  bytes before any later program can reuse the physical block — TPU
+  executions are in-order) and hands the resulting device array to this
+  module. The device->host landing (`np.asarray`) happens on the offload
+  worker thread.
+- **Tiers chain by demotion.** Host-pool LRU evictions demote to disk
+  (same chained content hashes — G3 files are named by hash); only a
+  disk-tier eviction emits a router `removed` event, because only then has
+  the worker truly forgotten the block.
+- **Onboarding is tier-transparent.** `contains`/`fetch` check in-flight
+  transfers, host RAM, then disk; fetching an in-flight block waits for
+  its landing (rare — a block evicted and re-requested within one step).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from dynamo_tpu.engine.host_cache import HostKvPool, HostPoolStats
+
+log = logging.getLogger("dynamo_tpu.engine.offload")
+
+
+class DiskKvPool:
+    """G3 tier: hash-addressed KV pages on disk with LRU capacity.
+
+    One ``.npy`` file per block, named by the chained content hash, so
+    dedup across sequences falls out of the same hash scheme the
+    allocator and router use (parity: `block_manager/storage/disk.rs`).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        capacity_blocks: int,
+        on_removed: Callable[[list[int]], None] | None = None,
+    ):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.capacity = capacity_blocks
+        self.on_removed = on_removed or (lambda hashes: None)
+        self._index: OrderedDict[int, int | None] = OrderedDict()  # hash -> parent, LRU
+        self._lock = threading.Lock()
+        self.stats = HostPoolStats()
+
+    def _path(self, block_hash: int) -> Path:
+        return self.dir / f"{block_hash & ((1 << 64) - 1):016x}.npy"
+
+    def __contains__(self, block_hash: int) -> bool:
+        with self._lock:
+            return block_hash in self._index
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def put(self, block_hash: int, parent_hash: int | None, kv: np.ndarray) -> None:
+        evicted: list[int] = []
+        with self._lock:
+            if block_hash in self._index:
+                self._index.move_to_end(block_hash)
+                return
+            while len(self._index) >= self.capacity:
+                old, _ = self._index.popitem(last=False)
+                try:
+                    self._path(old).unlink(missing_ok=True)
+                except OSError:
+                    log.warning("disk tier: failed to unlink block %x", old)
+                self.stats.evictions += 1
+                evicted.append(old)
+            np.save(self._path(block_hash), kv)
+            self._index[block_hash] = parent_hash
+            self.stats.offloads += 1
+        if evicted:
+            self.on_removed(evicted)
+
+    def pop(self, block_hash: int) -> tuple[int | None, np.ndarray] | None:
+        with self._lock:
+            if block_hash not in self._index:
+                return None
+            parent = self._index.pop(block_hash)
+            path = self._path(block_hash)
+            try:
+                kv = np.load(path)
+                path.unlink(missing_ok=True)
+            except OSError:
+                log.warning("disk tier: failed to load block %x", block_hash)
+                return None
+            self.stats.onboards += 1
+            return parent, kv
+
+
+class OffloadEngine:
+    """Background transfer worker between the KV tiers.
+
+    ``submit`` is the only engine-thread entry point on the eviction path
+    and does no device synchronization; the worker thread owns every
+    blocking copy (device->host landing, disk IO).
+    """
+
+    def __init__(self, host: HostKvPool, disk: DiskKvPool | None = None):
+        self.host = host
+        self.disk = disk
+        if disk is not None:
+            # Host evictions demote to disk instead of emitting removal.
+            host.on_evict_block = disk.put
+        self._cond = threading.Condition()
+        self._pending: dict[int, int | None] = {}  # hash -> parent (in flight)
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._run, name="kv-offload", daemon=True)
+        self._thread.start()
+
+    # -- eviction side (engine thread, non-blocking) -----------------------
+
+    def submit(self, block_hash: int, parent_hash: int | None, device_page: Any) -> None:
+        with self._cond:
+            self._pending[block_hash] = parent_hash
+        self._q.put((block_hash, parent_hash, device_page))
+
+    # -- worker ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            block_hash, parent, page = item
+            try:
+                arr = np.asarray(page)  # lands the device slice
+            except Exception:  # noqa: BLE001 — engine may have shut down
+                log.exception("offload transfer failed for block %x", block_hash)
+                arr = None
+            with self._cond:
+                try:
+                    if arr is not None and block_hash in self._pending:
+                        self.host.put(block_hash, parent, arr)
+                except Exception:  # noqa: BLE001 — e.g. disk tier ENOSPC
+                    # The block is lost to the offload tiers, but the
+                    # worker must survive: fetch() waiters depend on
+                    # _pending draining.
+                    log.exception("offload landing failed for block %x", block_hash)
+                finally:
+                    self._pending.pop(block_hash, None)
+                    self._cond.notify_all()
+
+    # -- onboarding side ---------------------------------------------------
+
+    def contains(self, block_hash: int) -> bool:
+        with self._cond:
+            if block_hash in self._pending or block_hash in self.host:
+                return True
+        return self.disk is not None and block_hash in self.disk
+
+    def reinsert(self, block_hash: int, parent_hash: int | None, kv: np.ndarray) -> None:
+        """Return a fetched-but-unusable block to the host tier (e.g. the
+        allocator ran out of device blocks mid-onboard). Takes the same
+        lock the worker thread holds for host-pool mutation."""
+        with self._cond:
+            self.host.put(block_hash, parent_hash, kv)
+
+    def fetch(self, block_hash: int) -> tuple[int | None, np.ndarray] | None:
+        """Pop a block for onboarding, whichever tier holds it; waits out
+        an in-flight transfer of the same hash."""
+        with self._cond:
+            while block_hash in self._pending:
+                self._cond.wait(timeout=30)
+            blk = self.host.pop(block_hash)
+            if blk is not None:
+                return blk.parent_hash, blk.kv
+        if self.disk is not None:
+            return self.disk.pop(block_hash)
+        return None
+
+    def flush(self) -> None:
+        """Wait until every submitted transfer has landed (tests/shutdown)."""
+        with self._cond:
+            while self._pending:
+                self._cond.wait(timeout=30)
+
+    def close(self) -> None:
+        self._q.put(None)

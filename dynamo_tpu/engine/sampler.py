@@ -24,6 +24,29 @@ import jax.numpy as jnp
 
 DEFAULT_TOP_CAP = 64
 
+# Top-k alternatives returned when a request asks for logprobs. Static so
+# the logprob program compiles once; per-request k <= this is sliced on
+# the host. 20 covers the OpenAI maxima (completions k<=5, chat
+# top_logprobs<=20) so no request is silently truncated.
+LOGPROBS_K = 20
+
+
+def token_logprobs(
+    logits: jax.Array,   # [B, V] float32 (raw, pre-temperature)
+    tokens: jax.Array,   # [B] int32 — the sampled/chosen tokens
+    k: int = LOGPROBS_K,
+):
+    """Chosen-token logprob plus top-k alternatives under the model's
+    raw distribution (temperature-independent, the convention OpenAI
+    clients expect for analysis; reference threads engine logprobs the
+    same way, lib/llm/src/perf/logprobs.rs). Returns
+    (chosen [B], top_ids [B, k] i32, top_lps [B, k] f32)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    lp = logits - lse
+    chosen = jnp.take_along_axis(lp, tokens[:, None], axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(lp, k)
+    return chosen, top_ids.astype(jnp.int32), top_lps
+
 
 def sample(
     logits: jax.Array,        # [B, V] float32
@@ -33,10 +56,15 @@ def sample(
     top_p: jax.Array,         # [B] float32; >= 1 => disabled
     *,
     need_mask: bool = True,   # static: False skips top-k/top-p entirely
+    all_greedy: bool = False,  # static: every lane temperature==0
     k_cap: int = DEFAULT_TOP_CAP,
 ) -> jax.Array:               # [B] int32
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if all_greedy:
+        # Whole-batch greedy (the common served case at temperature=0):
+        # skip the gumbel draw over [B, V] entirely.
+        return greedy
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 

@@ -26,10 +26,14 @@ async def run_frontend(
     router_config: RouterConfig | None = None,
     ready_event: asyncio.Event | None = None,
     service_out: list | None = None,
+    tls_cert: str | None = None,
+    tls_key: str | None = None,
 ) -> None:
     manager = ModelManager(runtime, router_mode=router_mode, router_config=router_config)
     await manager.start()
-    service = HttpService(manager, host=http_host, port=http_port)
+    service = HttpService(
+        manager, host=http_host, port=http_port, tls_cert=tls_cert, tls_key=tls_key
+    )
     await service.start()
     if service_out is not None:
         service_out.append(service)
@@ -50,6 +54,13 @@ def main() -> None:
         "--router-mode", choices=["kv", "round_robin", "random"], default="kv"
     )
     ap.add_argument("--kv-overlap-weight", type=float, default=1.0)
+    ap.add_argument("--tls-cert-path", default=None, help="serve HTTPS with this cert")
+    ap.add_argument("--tls-key-path", default=None)
+    ap.add_argument(
+        "--kv-replica-sync",
+        action="store_true",
+        help="synchronize router state across frontend replicas",
+    )
     ap.add_argument("--router-temperature", type=float, default=0.0)
     ap.add_argument(
         "--kv-cache-block-size",
@@ -63,6 +74,7 @@ def main() -> None:
         overlap_weight=args.kv_overlap_weight,
         temperature=args.router_temperature,
         block_size=args.kv_cache_block_size,
+        replica_sync=args.kv_replica_sync,
     )
 
     @dynamo_worker()
@@ -73,6 +85,8 @@ def main() -> None:
             http_port=args.http_port,
             router_mode=args.router_mode,
             router_config=config,
+            tls_cert=args.tls_cert_path,
+            tls_key=args.tls_key_path,
         )
 
     entry()

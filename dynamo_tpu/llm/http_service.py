@@ -53,14 +53,20 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8000,
         metrics: MetricsRegistry | None = None,
+        tls_cert: str | None = None,
+        tls_key: str | None = None,
     ):
         self.manager = manager
         self.host = host
         self.port = port
         self.metrics = metrics or MetricsRegistry()
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_post("/v1/embeddings", self.embeddings)
+        self.app.router.add_post("/v1/responses", self.responses)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
@@ -68,13 +74,22 @@ class HttpService:
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
+        ssl_ctx = None
+        if self.tls_cert and self.tls_key:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert, self.tls_key)
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port, ssl_context=ssl_ctx)
         await site.start()
         for addr in self._runner.addresses:  # resolve ephemeral port
             self.port = addr[1]
-        log.info("OpenAI frontend on http://%s:%d", self.host, self.port)
+        log.info(
+            "OpenAI frontend on %s://%s:%d",
+            "https" if ssl_ctx else "http", self.host, self.port,
+        )
 
     async def stop(self) -> None:
         if self._runner:
@@ -135,8 +150,14 @@ class HttpService:
         )
         return web.json_response(out.model_dump())
 
+    def _observe_isl(self, m, n_tokens: int):
+        """Sequence-length metrics feed the SLA planner's observation loop
+        (reference planner_core.py:180 observes these frontend series)."""
+        m.histogram("frontend_input_sequence_tokens").observe(n_tokens)
+        return lambda osl: m.histogram("frontend_output_sequence_tokens").observe(osl)
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
-        def make_stream(served: ServedModel, body, rid: str, headers):
+        def make_stream(served: ServedModel, body, rid: str, headers, m):
             pre = served.preprocessor.preprocess_chat(body)
             pre.request_id = rid
             return served.preprocessor.postprocess_chat_stream(
@@ -145,6 +166,7 @@ class HttpService:
                 request_id=rid,
                 include_usage=bool(body.stream_options and body.stream_options.include_usage)
                 or not body.stream,
+                on_complete=self._observe_isl(m, len(pre.token_ids)),
             )
 
         return await self._handle_llm_request(
@@ -153,11 +175,12 @@ class HttpService:
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
-        def make_stream(served: ServedModel, body, rid: str, headers):
+        def make_stream(served: ServedModel, body, rid: str, headers, m):
             pre = served.preprocessor.preprocess_completion(body)
             pre.request_id = rid
             return served.preprocessor.postprocess_completion(
-                pre, served.generate(pre, headers), request_id=rid, stream=body.stream
+                pre, served.generate(pre, headers), request_id=rid, stream=body.stream,
+                on_complete=self._observe_isl(m, len(pre.token_ids)),
             )
 
         async def aggregate(rid, body, responses):
@@ -170,6 +193,141 @@ class HttpService:
 
         return await self._handle_llm_request(
             request, CompletionRequest, "cmpl", "completions", make_stream, aggregate
+        )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: tokenize, one engine forward per input,
+        mean-pooled hidden state (reference service_v2.rs:277-336)."""
+        from dynamo_tpu.llm.protocols.openai import EmbeddingRequest
+
+        try:
+            body = EmbeddingRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return self._error(400, f"invalid request: {e}")
+        served = self._lookup(body.model)
+        if served is None:
+            return self._error(404, f"model {body.model!r} not found", "model_not_found")
+
+        raw = body.input
+        if isinstance(raw, str):
+            inputs: list = [raw]
+        elif raw and isinstance(raw[0], int):
+            inputs = [raw]  # one pre-tokenized sequence
+        else:
+            inputs = list(raw)
+
+        tok = served.preprocessor.tokenizer
+        data = []
+        total_tokens = 0
+        rid = new_request_id("embd")
+        headers = self._headers_for(request, rid)
+        try:
+            for i, item in enumerate(inputs):
+                token_ids = item if isinstance(item, list) else tok.encode(item)
+                total_tokens += len(token_ids)
+                stream = await served.client.round_robin(
+                    {"embed": True, "token_ids": list(token_ids)}, headers
+                )
+                vec = None
+                async for out in stream:
+                    if "embedding" in out:
+                        vec = out["embedding"]
+                if vec is None:
+                    return self._error(500, "engine returned no embedding", "internal_error")
+                data.append({"object": "embedding", "index": i, "embedding": vec})
+        except Exception as e:  # noqa: BLE001
+            log.exception("embeddings request %s failed", rid)
+            return self._error(500, str(e), "internal_error")
+        return web.json_response(
+            {
+                "object": "list",
+                "data": data,
+                "model": body.model,
+                "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
+            }
+        )
+
+    async def responses(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/responses (non-streaming): accepts string or
+        message-list input, runs the chat pipeline, answers in Responses
+        format (reference service_v2.rs:277-336)."""
+        try:
+            body_raw = await request.json()
+        except json.JSONDecodeError as e:
+            return self._error(400, f"invalid request: {e}")
+        model = body_raw.get("model")
+        raw_input = body_raw.get("input")
+        if not model or raw_input is None:
+            return self._error(400, "'model' and 'input' are required")
+        if isinstance(raw_input, str):
+            messages = [{"role": "user", "content": raw_input}]
+        else:
+            messages = [
+                {"role": m.get("role", "user"), "content": m.get("content", "")}
+                for m in raw_input
+            ]
+        chat_body = {
+            "model": model,
+            "messages": messages,
+            "stream": False,
+        }
+        if body_raw.get("max_output_tokens") is not None:
+            chat_body["max_tokens"] = body_raw["max_output_tokens"]
+        for k in ("temperature", "top_p"):
+            if body_raw.get(k) is not None:
+                chat_body[k] = body_raw[k]
+        try:
+            body = ChatCompletionRequest.model_validate(chat_body)
+        except ValidationError as e:
+            return self._error(400, f"invalid request: {e}")
+        served = self._lookup(model)
+        if served is None:
+            return self._error(404, f"model {model!r} not found", "model_not_found")
+
+        rid = new_request_id("resp")
+        pre = served.preprocessor.preprocess_chat(body)
+        pre.request_id = rid
+        chunks = served.preprocessor.postprocess_chat_stream(
+            pre,
+            served.generate(pre, self._headers_for(request, rid)),
+            request_id=rid,
+            include_usage=True,
+        )
+        text_parts: list[str] = []
+        usage = None
+        try:
+            async for chunk in chunks:
+                for choice in chunk.choices:
+                    if choice.delta.content:
+                        text_parts.append(choice.delta.content)
+                if chunk.usage:
+                    usage = chunk.usage
+        except Exception as e:  # noqa: BLE001
+            log.exception("responses request %s failed", rid)
+            return self._error(500, str(e), "internal_error")
+        return web.json_response(
+            {
+                "id": rid,
+                "object": "response",
+                "created_at": int(time.time()),
+                "status": "completed",
+                "model": model,
+                "output": [
+                    {
+                        "type": "message",
+                        "role": "assistant",
+                        "status": "completed",
+                        "content": [
+                            {"type": "output_text", "text": "".join(text_parts)}
+                        ],
+                    }
+                ],
+                "usage": {
+                    "input_tokens": usage.prompt_tokens if usage else 0,
+                    "output_tokens": usage.completion_tokens if usage else 0,
+                    "total_tokens": usage.total_tokens if usage else 0,
+                },
+            }
         )
 
     async def _handle_llm_request(
@@ -196,7 +354,7 @@ class HttpService:
         inflight.inc()
         started = time.monotonic()
         try:
-            chunks = make_stream(served, body, rid, self._headers_for(request, rid))
+            chunks = make_stream(served, body, rid, self._headers_for(request, rid), m)
             if body.stream:
                 return await self._stream_sse(request, chunks, started, m)
             return await aggregate(rid, body, chunks)
@@ -261,11 +419,14 @@ class HttpService:
         text_parts: list[str] = []
         finish = None
         usage = None
+        lp_content: list[dict] = []
         created = int(time.time())
         async for chunk in chunks:
             for choice in chunk.choices:
                 if choice.delta.content:
                     text_parts.append(choice.delta.content)
+                if choice.logprobs and choice.logprobs.get("content"):
+                    lp_content.extend(choice.logprobs["content"])
                 if choice.finish_reason:
                     finish = choice.finish_reason
             if chunk.usage:
@@ -278,6 +439,7 @@ class HttpService:
                 ChatChoice(
                     message=ChatMessage(role="assistant", content="".join(text_parts)),
                     finish_reason=finish or "stop",
+                    logprobs={"content": lp_content} if lp_content else None,
                 )
             ],
             usage=usage or Usage(),

@@ -172,6 +172,10 @@ class KvIndexer:
                 self.tree = RadixTree()
         self._task: asyncio.Task | None = None
         self._sub = None
+        # Worker ids seen in events — tree-implementation-agnostic (the
+        # native tree has no workers() enumeration); used by replica-sync
+        # bootstrap dumps.
+        self.known_workers: set[int] = set()
 
     async def start(self) -> None:
         self._sub = await self._store.subscribe(self._subject)
@@ -187,7 +191,9 @@ class KvIndexer:
         assert self._sub is not None
         async for ev in self._sub:
             try:
-                self.tree.apply_event(RouterEvent.from_wire(ev["p"]))
+                event = RouterEvent.from_wire(ev["p"])
+                self.known_workers.add(event.worker_id)
+                self.tree.apply_event(event)
             except Exception:  # noqa: BLE001 — one bad event must not kill routing
                 log.exception("bad kv event")
 
@@ -195,6 +201,7 @@ class KvIndexer:
         return self.tree.find_matches(seq_hashes)
 
     def remove_worker(self, worker_id: int) -> None:
+        self.known_workers.discard(worker_id)
         self.tree.remove_worker(worker_id)
 
 

@@ -48,14 +48,35 @@ class KvRouter:
             )
         else:
             self.indexer = ApproxKvIndexer()
+        self.sync = None
+        if self.config.replica_sync:
+            from dynamo_tpu.llm.kv_router.replica_sync import ReplicaSync
+
+            self.sync = ReplicaSync(store, namespace, component, self)
 
     async def start(self) -> None:
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.start()
+        if self.sync is not None:
+            await self.sync.start()
 
     async def stop(self) -> None:
+        if self.sync is not None:
+            await self.sync.stop()
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.stop()
+
+    # -- replica-sync introspection ---------------------------------------
+
+    def indexer_tree(self):
+        return self.indexer.tree if isinstance(self.indexer, KvIndexer) else None
+
+    def known_workers(self) -> set[int]:
+        return (
+            set(self.indexer.known_workers)
+            if isinstance(self.indexer, KvIndexer)
+            else set()
+        )
 
     def find_best_match(
         self,
@@ -73,15 +94,29 @@ class KvRouter:
         self.active.add_request(
             request_id, result.worker_id, len(token_ids), result.overlap_blocks
         )
+        if self.sync is not None:
+            self.sync.publish_add(
+                request_id, result.worker_id, len(token_ids), result.overlap_blocks
+            )
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision(result.worker_id, seq_hashes)
         return result
 
+    def note_pinned(self, request_id: str, worker_id: int, prompt_tokens: int) -> None:
+        """Bookkeeping for a caller-pinned worker (no selection ran)."""
+        self.active.add_request(request_id, worker_id, prompt_tokens, 0)
+        if self.sync is not None:
+            self.sync.publish_add(request_id, worker_id, prompt_tokens, 0)
+
     def mark_prefill_done(self, request_id: str) -> None:
         self.active.mark_prefill_done(request_id)
+        if self.sync is not None:
+            self.sync.publish_prefill_done(request_id)
 
     def free(self, request_id: str) -> None:
         self.active.free(request_id)
+        if self.sync is not None:
+            self.sync.publish_free(request_id)
 
     def remove_worker(self, worker_id: int) -> list[str]:
         self.indexer.remove_worker(worker_id)
@@ -123,7 +158,7 @@ class KvPushRouter:
             selection = SelectionResult(
                 worker_id=pinned, overlap_blocks=0, required_prefill_tokens=len(token_ids), costs={}
             )
-            self.router.active.add_request(request_id, pinned, len(token_ids), 0)
+            self.router.note_pinned(request_id, pinned, len(token_ids))
         else:
             config = self.router.config
             if "overlap_weight" in overrides or "router_temperature" in overrides:

@@ -38,6 +38,12 @@ class ActiveSequences:
         prompt_tokens: int,
         overlap_blocks: int,
     ) -> None:
+        if request_id in self._seqs:
+            # Duplicate adds happen legitimately (migration retries reuse
+            # the request id; a replica-sync delta can arrive after the
+            # bootstrap snapshot already installed the request) — counting
+            # twice would skew this worker's load permanently.
+            return
         new_prefill = max(0, prompt_tokens - overlap_blocks * self.block_size)
         blocks = math.ceil(prompt_tokens / self.block_size)
         self._seqs[request_id] = _ActiveSeq(
@@ -77,6 +83,30 @@ class ActiveSequences:
         self._worker_decode_blocks[seq.worker_id] = (
             self._worker_decode_blocks.get(seq.worker_id, 0) - seq.decode_blocks
         )
+
+    def add_raw(
+        self, request_id: str, worker_id: int, prefill_tokens: int, decode_blocks: int
+    ) -> None:
+        """Install a replica-sync snapshot entry verbatim (already-derived
+        prefill/block counts, no recomputation)."""
+        if request_id in self._seqs:
+            return
+        self._seqs[request_id] = _ActiveSeq(
+            worker_id=worker_id,
+            prefill_tokens=prefill_tokens,
+            decode_blocks=decode_blocks,
+            started=time.monotonic(),
+        )
+        self._worker_prefill_tokens[worker_id] = (
+            self._worker_prefill_tokens.get(worker_id, 0) + prefill_tokens
+        )
+        self._worker_decode_blocks[worker_id] = (
+            self._worker_decode_blocks.get(worker_id, 0) + decode_blocks
+        )
+
+    def items(self):
+        """(request_id, entry) view for replica-sync snapshots."""
+        return self._seqs.items()
 
     def remove_worker(self, worker_id: int) -> list[str]:
         """Drops all state for a dead worker; returns orphaned request ids

@@ -87,6 +87,48 @@ class OpenAIPreprocessor:
             skip_special_tokens=pre.output.skip_special_tokens,
         )
 
+    # -- logprob formatting ------------------------------------------------
+
+    def _tok_str(self, tid: int) -> str:
+        return self.tokenizer.decode([tid], skip_special_tokens=False)
+
+    def _chat_logprobs(self, entries: list[dict]) -> dict:
+        """Engine logprob records -> OpenAI chat ``choices[].logprobs``
+        (reference protocol shape: protocols/openai, perf/logprobs.rs)."""
+        content = []
+        for e in entries:
+            s = self._tok_str(e["token_id"])
+            content.append(
+                {
+                    "token": s,
+                    "logprob": e["logprob"],
+                    "bytes": list(s.encode()),
+                    "top_logprobs": [
+                        {"token": self._tok_str(t), "logprob": lp}
+                        for t, lp in e.get("top", [])
+                    ],
+                }
+            )
+        return {"content": content}
+
+    def _completion_logprobs(self, entries: list[dict], text_offset: int) -> dict:
+        """OpenAI completions ``logprobs`` block (tokens / token_logprobs /
+        top_logprobs / text_offset)."""
+        tokens, tlps, tops, offs = [], [], [], []
+        for e in entries:
+            s = self._tok_str(e["token_id"])
+            tokens.append(s)
+            tlps.append(e["logprob"])
+            tops.append({self._tok_str(t): lp for t, lp in e.get("top", [])})
+            offs.append(text_offset)
+            text_offset += len(s)
+        return {
+            "tokens": tokens,
+            "token_logprobs": tlps,
+            "top_logprobs": tops,
+            "text_offset": offs,
+        }
+
     # -- response side -----------------------------------------------------
 
     async def postprocess_chat_stream(
@@ -95,6 +137,7 @@ class OpenAIPreprocessor:
         engine_stream: AsyncIterator[LLMEngineOutput],
         request_id: str | None = None,
         include_usage: bool = False,
+        on_complete=None,  # called with completion_tokens at stream end
     ) -> AsyncIterator[ChatCompletionChunk]:
         """Engine chunks → OpenAI chat chunks. Ends the moment a stop
         condition fires, even if the engine keeps streaming."""
@@ -106,12 +149,18 @@ class OpenAIPreprocessor:
         completion_tokens = 0
         cached = 0
 
-        def chunk(delta: ChatDelta, finish_reason: str | None = None) -> ChatCompletionChunk:
+        def chunk(
+            delta: ChatDelta, finish_reason: str | None = None, logprobs: dict | None = None
+        ) -> ChatCompletionChunk:
             return ChatCompletionChunk(
                 id=rid,
                 created=created,
                 model=pre.model,
-                choices=[ChatChunkChoice(index=0, delta=delta, finish_reason=finish_reason)],
+                choices=[
+                    ChatChunkChoice(
+                        index=0, delta=delta, finish_reason=finish_reason, logprobs=logprobs
+                    )
+                ],
             )
 
         async for out in engine_stream:
@@ -121,8 +170,9 @@ class OpenAIPreprocessor:
             completion_tokens += len(out.token_ids)
             cached = out.meta.get("cached_tokens", cached)
             step = decoder.step_many(out.token_ids)
-            if step.text:
-                yield chunk(ChatDelta(content=step.text))
+            lp = self._chat_logprobs(out.logprobs) if out.logprobs else None
+            if step.text or lp:
+                yield chunk(ChatDelta(content=step.text or ""), logprobs=lp)
             finish = step.finish_reason or out.finish_reason
             if step.finish_reason:
                 break
@@ -140,6 +190,8 @@ class OpenAIPreprocessor:
                 total_tokens=len(pre.token_ids) + completion_tokens,
                 prompt_tokens_details={"cached_tokens": cached} if cached else None,
             )
+        if on_complete is not None:
+            on_complete(completion_tokens)
         yield final
 
     async def postprocess_completion(
@@ -148,6 +200,7 @@ class OpenAIPreprocessor:
         engine_stream: AsyncIterator[LLMEngineOutput],
         request_id: str | None = None,
         stream: bool = False,
+        on_complete=None,  # called with completion_tokens at stream end
     ) -> AsyncIterator[CompletionResponse]:
         """Engine chunks → completion responses (stream chunks or one final)."""
         rid = request_id or new_request_id("cmpl")
@@ -156,17 +209,24 @@ class OpenAIPreprocessor:
         pieces: list[str] = []
         finish: str | None = None
         completion_tokens = 0
+        lp_entries: list[dict] = []
+        text_len = 0
 
         async for out in engine_stream:
             completion_tokens += len(out.token_ids)
             step = decoder.step_many(out.token_ids)
-            if step.text:
+            lp = None
+            if out.logprobs:
+                lp_entries.extend(out.logprobs)
+                lp = self._completion_logprobs(out.logprobs, text_len)
+            text_len += len(step.text)
+            if step.text or lp:
                 if stream:
                     yield CompletionResponse(
                         id=rid,
                         created=created,
                         model=pre.model,
-                        choices=[CompletionChoice(text=step.text)],
+                        choices=[CompletionChoice(text=step.text, logprobs=lp)],
                     )
                 else:
                     pieces.append(step.text)
@@ -182,10 +242,22 @@ class OpenAIPreprocessor:
             completion_tokens=completion_tokens,
             total_tokens=len(pre.token_ids) + completion_tokens,
         )
+        if on_complete is not None:
+            on_complete(completion_tokens)
         yield CompletionResponse(
             id=rid,
             created=created,
             model=pre.model,
-            choices=[CompletionChoice(text="" if stream else "".join(pieces), finish_reason=reason)],
+            choices=[
+                CompletionChoice(
+                    text="" if stream else "".join(pieces),
+                    finish_reason=reason,
+                    logprobs=(
+                        self._completion_logprobs(lp_entries, 0)
+                        if lp_entries and not stream
+                        else None
+                    ),
+                )
+            ],
             usage=usage,
         )

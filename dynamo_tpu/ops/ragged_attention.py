@@ -39,11 +39,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
-# Decode-shape tuned Pallas grid (measured on v5e, ctx ~200): 8-page DMA
-# batches, 32-token query blocks. Long-context calls use the kernel's own
-# tuned table instead.
-_DECODE_KV_PAGES_PER_BLOCK = 8
-_DECODE_QUERIES_PER_BLOCK = 32
+# Decode-shape tuned Pallas grid (measured on v5e, round 4: 8-page DMA
+# batches, 8-query blocks — 7.35 ms/step vs 7.99 at q=32 and 8.67 at the
+# kernel's own defaults; tools/profile_decode.py + PERF.md). Long-context
+# calls use the kernel's tuned table instead. Env-overridable for on-chip
+# tuning sweeps; 0 = always use the kernel's defaults.
+import os as _os
+
+_DECODE_KV_PAGES_PER_BLOCK = int(
+    _os.environ.get("DYNAMO_TPU_ATTN_PAGES_PER_BLOCK", 8)
+)
+_DECODE_QUERIES_PER_BLOCK = int(
+    _os.environ.get("DYNAMO_TPU_ATTN_QUERIES_PER_BLOCK", 8)
+)
+# Prefill-shaped calls: bound the query block explicitly — the kernel's
+# own tuned table can pick whole-wave q blocks that blow the scoped-VMEM
+# limit (16 MB on v5e under the axon runtime) at T >= 2048.
+_PREFILL_QUERIES_PER_BLOCK = int(
+    _os.environ.get("DYNAMO_TPU_ATTN_PREFILL_QUERIES_PER_BLOCK", 128)
+)
 
 
 def ragged_paged_attention_ref(
@@ -95,21 +109,36 @@ def ragged_paged_attention_ref(
 def ragged_paged_attention(
     q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale: float
 ) -> jax.Array:
-    """Backend dispatch: Pallas kernel on TPU, jnp reference elsewhere."""
-    if jax.default_backend() == "tpu":
+    """Backend dispatch: Pallas kernel on TPU, jnp reference elsewhere.
+
+    The kernel wants MXU/VPU-aligned shapes (head_dim % 128, page_size %
+    8); models outside that (e.g. the byte-sized test presets) run the
+    XLA reference path even on TPU — the kernel's trace-time asserts are
+    not a serving error."""
+    d = q.shape[-1]
+    page_size = kv_pages.shape[1]
+    if jax.default_backend() == "tpu" and d % 128 == 0 and page_size % 8 == 0:
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention as _kernel,
         )
 
         kw = {}
-        # Short-context decode grids benefit from the measured block sizes;
-        # leave long tables to the kernel's tuned defaults.
-        if page_indices.shape[1] <= 32:
+        # Always pass an explicit grid (env 0 restores kernel defaults):
+        # decode-shaped calls use the measured decode grid; prefill waves
+        # cap the query block — the kernel's own tuned table can pick
+        # whole-wave q blocks that exceed scoped VMEM (16 MB on v5e under
+        # the axon runtime) at large T or long block tables.
+        if _DECODE_KV_PAGES_PER_BLOCK > 0:
+            qb = (
+                _DECODE_QUERIES_PER_BLOCK
+                if q.shape[0] <= 64
+                else min(_PREFILL_QUERIES_PER_BLOCK, q.shape[0])
+            )
             kw = dict(
                 num_kv_pages_per_block=min(
                     _DECODE_KV_PAGES_PER_BLOCK, page_indices.shape[1]
                 ),
-                num_queries_per_block=_DECODE_QUERIES_PER_BLOCK,
+                num_queries_per_block=qb,
             )
         return _kernel(
             q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,

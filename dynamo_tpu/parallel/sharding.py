@@ -82,6 +82,7 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
         "embed": s(None, None),
         "layers": layers,
         "final_norm": s(None),
+        "fuse_tp": s(),
     }
     if not cfg.tie_embeddings:
         shardings["lm_head"] = s(None, "tp")
@@ -110,6 +111,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
     """Place an (unsharded) params pytree onto the mesh."""
+    shardings = param_shardings(cfg, mesh)
+    if "fuse_tp" not in params:  # pytrees predating the layout marker
+        shardings.pop("fuse_tp")
     return jax.tree.map(
-        lambda x, sh: jax.device_put(x, sh), params, param_shardings(cfg, mesh)
+        lambda x, sh: jax.device_put(x, sh), params, shardings
     )

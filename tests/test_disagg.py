@@ -170,3 +170,33 @@ async def test_disagg_decode_reuses_transferred_blocks():
             assert c.prefill_core.iterations == before
             cached = out["usage"].get("prompt_tokens_details", {}).get("cached_tokens", 0)
             assert cached > 0
+
+
+async def test_saturated_prefill_queue_flips_to_local():
+    """Queue-depth safety valve (reference disagg_router.rs:24-100 +
+    JetStream queue): with the prefill fleet's backlog above
+    max_prefill_queue_size, a long prompt prefills LOCALLY."""
+    async with DisaggCluster() as c:
+        decode_rt = c.runtimes[1]
+        real_queue_len = decode_rt.store.queue_len
+
+        async def saturated(name: str) -> int:
+            return 99  # simulate a deep fleet backlog
+
+        decode_rt.store.queue_len = saturated
+        try:
+            async with aiohttp.ClientSession() as s:
+                before = c.prefill_core.iterations
+                out = await _chat(s, c.base_url, LONG_PROMPT, max_tokens=4)
+                assert out["usage"]["completion_tokens"] == 4
+                # Decision flipped: the prefill fleet never saw the request.
+                assert c.prefill_core.iterations == before
+        finally:
+            decode_rt.store.queue_len = real_queue_len
+
+        # Valve reopens with the backlog gone: next long prompt (distinct
+        # content so nothing is locally cached) goes remote again.
+        async with aiohttp.ClientSession() as s:
+            before = c.prefill_core.iterations
+            await _chat(s, c.base_url, LONG_PROMPT + " fresh tail content", max_tokens=4)
+            assert c.prefill_core.iterations > before

@@ -166,3 +166,73 @@ async def test_event_publisher_to_indexer_roundtrip():
                     await asyncio.sleep(0.01)
                 assert indexer.find_matches(h) == {42: 1}
                 await indexer.stop()
+
+
+@pytest.mark.integration
+async def test_two_router_replica_sync_converges():
+    """Two routers serving the same component converge on the same
+    overlap scores (radix bootstrap + shared events) and consistent load
+    counts (active-sequence deltas) — parity: reference
+    ActiveSequencesMultiWorker + dump_tree_as_events
+    (sequence.rs:225, indexer.rs:445)."""
+    import asyncio
+    import dataclasses
+
+    from dynamo_tpu.llm.kv_router.protocols import RouterConfig
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+
+    cfg = RouterConfig(replica_sync=True, block_size=32)
+
+    async def wait_for(cond, n=200):
+        for _ in range(n):
+            if cond():
+                return True
+            await asyncio.sleep(0.01)
+        return cond()
+
+    async with StoreServer() as server:
+        async with await StoreClient.open(server.address) as worker_store:
+            async with await StoreClient.open(server.address) as store_a:
+                async with await StoreClient.open(server.address) as store_b:
+                    ra = KvRouter(store_a, "ns", "backend", dataclasses.replace(cfg))
+                    await ra.start()
+
+                    # Worker 7 stores three blocks; router A routes two
+                    # requests BEFORE router B exists.
+                    pub = KvEventPublisher(worker_store, "ns", "backend", worker_id=7)
+                    tokens = list(range(96))
+                    h = compute_seq_hashes(tokens, 32)
+                    await pub.stored(h, parent_hash=None)
+                    await wait_for(lambda: ra.indexer.find_matches(h).get(7) == 3)
+
+                    r1 = ra.find_best_match("req-1", tokens, [7])
+                    ra.mark_prefill_done("req-1")
+                    ra.find_best_match("req-2", list(range(200, 264)), [7])
+
+                    # Late joiner: bootstrap must deliver radix + load.
+                    rb = KvRouter(store_b, "ns", "backend", dataclasses.replace(cfg))
+                    await rb.start()
+                    assert rb.indexer.find_matches(h) == ra.indexer.find_matches(h)
+                    assert rb.active.decode_blocks(7) == ra.active.decode_blocks(7)
+                    assert rb.active.prefill_tokens(7) == ra.active.prefill_tokens(7)
+                    assert rb.active.active_requests() == 2
+
+                    # Live deltas flow both ways.
+                    rb.find_best_match("req-3", list(range(300, 364)), [7])
+                    assert await wait_for(
+                        lambda: ra.active.decode_blocks(7) == rb.active.decode_blocks(7)
+                    )
+                    ra.free("req-2")
+                    assert await wait_for(
+                        lambda: rb.active.active_requests() == 2
+                    )
+                    assert ra.active.prefill_tokens(7) == rb.active.prefill_tokens(7)
+
+                    # Overlap scoring identical on both replicas.
+                    assert r1.overlap_blocks == 3
+                    sel_a = ra.find_best_match("req-4", tokens, [7])
+                    sel_b = rb.find_best_match("req-5", tokens, [7])
+                    assert sel_a.overlap_blocks == sel_b.overlap_blocks == 3
+
+                    await ra.stop()
+                    await rb.stop()

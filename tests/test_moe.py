@@ -92,3 +92,71 @@ def test_moe_expert_parallel_matches_single_device():
     cd = jax.device_put(init_cache(MOE, eng), cache_sharding(mesh))
     got, _ = prefill_chunk(sp, cd, prompt, 0, blocks, MOE, eng, 32, mesh=mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def _dense_moe_reference(x, lp, cfg):
+    """All-experts dense dispatch (the pre-round-4 implementation), kept
+    as ground truth for the sparse gather/scatter path."""
+    xf = x.reshape(-1, x.shape[-1])
+    N = xf.shape[0]
+    router = jnp.dot(xf, lp["w_router"], preferred_element_type=jnp.float32)
+    vals, idx = jax.lax.top_k(router, cfg.num_experts_per_tok)
+    probs = jax.nn.softmax(vals, axis=-1)
+    weights = jnp.zeros_like(router).at[jnp.arange(N)[:, None], idx].set(probs)
+    gate = jnp.einsum("nh,ehi->nei", xf, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("nh,ehi->nei", xf, lp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    down = jnp.einsum("nei,eih->neh", act, lp["w_down"], preferred_element_type=jnp.float32)
+    return jnp.einsum("ne,neh->nh", weights, down).astype(x.dtype).reshape(x.shape)
+
+
+def test_sparse_dispatch_matches_dense_reference():
+    """With enough capacity, sparse gather/scatter dispatch is exact."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_moe(), moe_capacity_factor=float(tiny_moe().num_experts))
+    rng = jax.random.PRNGKey(7)
+    params = init_params(rng, cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (13, cfg.hidden_size))
+    want = _dense_moe_reference(x, lp, cfg)
+    got = _moe_mlp(x, lp, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dispatch_flops_scale_with_top_k_not_num_experts():
+    """Per-token expert-MLP FLOPs must follow top_k (x capacity factor),
+    not num_experts — the point of sparse dispatch (VERDICT r3 #9)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        tiny_moe(), num_experts=8, num_experts_per_tok=1, moe_capacity_factor=1.0
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jnp.ones((32, cfg.hidden_size))
+
+    def flops(fn):
+        cost = jax.jit(fn).lower(x).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost["flops"])
+
+    sparse = flops(lambda v: _moe_mlp(v, lp, cfg))
+    dense = flops(lambda v: _dense_moe_reference(v, lp, cfg))
+    # Dense computes all 8 experts per token; sparse only top-1 + padding.
+    assert sparse < dense / 3, f"sparse {sparse} not ≪ dense {dense}"
+
+
+def test_capacity_overflow_drops_tokens_not_correctness():
+    """With capacity 1 and every token routed to one expert, outputs stay
+    finite and shaped (dropped tokens contribute zero, GShard semantics)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_moe(), moe_capacity_factor=0.01)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(4), (9, cfg.hidden_size))
+    out = _moe_mlp(x, lp, cfg)
+    assert out.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(out)))

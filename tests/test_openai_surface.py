@@ -1,0 +1,190 @@
+"""OpenAI surface completeness: /v1/embeddings, /v1/responses, TLS.
+
+Parity: reference `lib/llm/src/http/service/service_v2.rs:277-336`
+(embeddings/responses routes, TLS config).
+"""
+
+import asyncio
+import ssl
+import subprocess
+
+import aiohttp
+import pytest
+
+from tests.test_e2e_jax_worker import JaxCluster
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+
+async def test_embeddings_endpoint():
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tinyjax", "input": "hello embedding world"}
+            async with s.post(f"{c.base_url}/v1/embeddings", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["object"] == "list"
+            vec = out["data"][0]["embedding"]
+            assert len(vec) == 64  # tiny model hidden size
+            assert out["usage"]["prompt_tokens"] > 0
+
+            # Deterministic per input; batched inputs index correctly.
+            async with s.post(f"{c.base_url}/v1/embeddings", json=body) as r:
+                again = (await r.json())["data"][0]["embedding"]
+            assert vec == again
+            body2 = {"model": "tinyjax", "input": ["hello embedding world", "different"]}
+            async with s.post(f"{c.base_url}/v1/embeddings", json=body2) as r:
+                assert r.status == 200
+                two = (await r.json())["data"]
+            assert [d["index"] for d in two] == [0, 1]
+            assert two[0]["embedding"] == vec
+            assert two[1]["embedding"] != vec
+
+            # Unknown model -> 404.
+            async with s.post(
+                f"{c.base_url}/v1/embeddings", json={"model": "nope", "input": "x"}
+            ) as r:
+                assert r.status == 404
+
+
+async def test_responses_endpoint_matches_chat():
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            prompt = "say something"
+            async with s.post(
+                f"{c.base_url}/v1/responses",
+                json={
+                    "model": "tinyjax",
+                    "input": prompt,
+                    "max_output_tokens": 8,
+                    "temperature": 0.0,
+                },
+            ) as r:
+                assert r.status == 200, await r.text()
+                resp = await r.json()
+            assert resp["object"] == "response"
+            assert resp["status"] == "completed"
+            text = resp["output"][0]["content"][0]["text"]
+            assert resp["usage"]["output_tokens"] == 8
+
+            async with s.post(
+                f"{c.base_url}/v1/chat/completions",
+                json={
+                    "model": "tinyjax",
+                    "messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 8,
+                    "temperature": 0.0,
+                },
+            ) as r:
+                chat = await r.json()
+            assert text == chat["choices"][0]["message"]["content"]
+
+            # Message-list input works too.
+            async with s.post(
+                f"{c.base_url}/v1/responses",
+                json={
+                    "model": "tinyjax",
+                    "input": [{"role": "user", "content": prompt}],
+                    "max_output_tokens": 4,
+                },
+            ) as r:
+                assert r.status == 200
+            # Missing input -> 400.
+            async with s.post(
+                f"{c.base_url}/v1/responses", json={"model": "tinyjax"}
+            ) as r:
+                assert r.status == 400
+
+
+async def test_tls_serves_https(tmp_path):
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    task = asyncio.create_task(
+        run_frontend(
+            rt, http_host="127.0.0.1", http_port=0, router_mode="round_robin",
+            ready_event=ready, service_out=services,
+            tls_cert=str(cert), tls_key=str(key),
+        )
+    )
+    try:
+        await asyncio.wait_for(ready.wait(), 10)
+        url = f"https://127.0.0.1:{services[0].port}/health"
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url, ssl=ctx) as r:
+                assert r.status == 200
+            # Plain HTTP against the TLS port must fail.
+            with pytest.raises(aiohttp.ClientError):
+                async with s.get(
+                    f"http://127.0.0.1:{services[0].port}/health"
+                ) as r2:
+                    await r2.text()
+    finally:
+        rt.signal_shutdown()
+        task.cancel()
+        try:
+            await rt.shutdown()
+        except Exception:
+            pass
+        await store.stop()
+
+
+async def test_logprobs_over_http():
+    """Logprobs must survive the full data plane (msgpack framing rejects
+    int map keys — the engine's logprob records must stay wire-safe)."""
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.base_url}/v1/chat/completions",
+                json={
+                    "model": "tinyjax",
+                    "messages": [{"role": "user", "content": "logprob please"}],
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                    "logprobs": True,
+                    "top_logprobs": 3,
+                },
+            ) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            content = out["choices"][0]["logprobs"]["content"]
+            assert len(content) == 4
+            for e in content:
+                assert len(e["top_logprobs"]) == 3
+                assert e["logprob"] == e["top_logprobs"][0]["logprob"]
+
+            async with s.post(
+                f"{c.base_url}/v1/completions",
+                json={
+                    "model": "tinyjax",
+                    "prompt": "abcd",
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                    "logprobs": 2,
+                },
+            ) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            lp = out["choices"][0]["logprobs"]
+            assert len(lp["tokens"]) == 4
+            assert len(lp["top_logprobs"][0]) == 2

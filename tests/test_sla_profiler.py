@@ -1,0 +1,130 @@
+"""SLA profiler sweep + planner observation loop.
+
+Parity: reference `benchmarks/profiler/profile_sla.py:52` (offline sweep
+producing the planner's interpolation grids) and
+`planner_core.py:180` observe_metrics (live frontend scrape driving the
+adjustment loop).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.planner.observer import MetricsObserver, parse_prometheus
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    from_profile,
+)
+from dynamo_tpu.planner.planner_core import (
+    Planner,
+    PlannerConfig,
+    RecordingConnector,
+    SlaTargets,
+)
+
+pytestmark = [pytest.mark.integration]
+
+
+def test_profiler_emits_planner_profile(tmp_path):
+    """The sweep runs the REAL engine and emits exactly the dict
+    from_profile() loads — closing the round-3 gap where
+    perf_interpolation had no producer."""
+    out = tmp_path / "profile.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    r = subprocess.run(
+        [sys.executable, "benchmarks/profile_sla.py", "--preset", "tiny",
+         "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    profile = json.loads(out.read_text())
+    assert profile["prefill"]["isl"] == [16.0, 32.0, 64.0]
+    assert len(profile["prefill"]["ttft_s"]) == 3
+    assert all(t > 0 for t in profile["prefill"]["ttft_s"])
+    assert len(profile["decode"]["itl_s"]) == 2
+    assert all(t > 0 for t in profile["decode"]["itl_s"])
+
+    # The planner consumes it directly.
+    pf, dc = from_profile(profile)
+    planner = Planner(pf, dc, RecordingConnector(),
+                      sla=SlaTargets(ttft_s=10.0, itl_s=10.0))
+    from dynamo_tpu.planner.planner_core import Observation
+
+    plan = planner.compute_plan(
+        Observation(request_rate=1.0, mean_isl=32, mean_osl=8)
+    )
+    assert plan.decode_replicas >= 1 and plan.prefill_replicas >= 1
+
+
+def test_parse_prometheus_sums_families():
+    text = (
+        "# HELP x\n"
+        'dynamo_frontend_requests_total{model="a"} 3\n'
+        'dynamo_frontend_requests_total{model="b"} 2\n'
+        "dynamo_frontend_time_to_first_token_seconds_sum 1.5\n"
+        "dynamo_frontend_time_to_first_token_seconds_count 5\n"
+    )
+    t = parse_prometheus(text)
+    assert t["dynamo_frontend_requests_total"] == 5
+    assert t["dynamo_frontend_time_to_first_token_seconds_sum"] == 1.5
+
+
+@pytest.mark.e2e
+async def test_planner_scales_up_under_rising_load():
+    """Soak: live frontend metrics -> MetricsObserver -> Planner; a load
+    ramp must raise the decode-replica recommendation (reference
+    sla_planner adjustment behavior)."""
+    from tests.test_e2e_frontend import Cluster
+
+    async def fire(session, base_url, n, max_tokens=8):
+        async def one(i):
+            body = {
+                "model": "mock",
+                "messages": [{"role": "user", "content": f"load {i} " + "x" * 64}],
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "stream": True,  # TTFT/ITL histograms are per-SSE-stream
+            }
+            async with session.post(
+                f"{base_url}/v1/chat/completions", json=body
+            ) as r:
+                assert r.status == 200
+                async for _ in r.content:
+                    pass
+
+        await asyncio.gather(*[one(i) for i in range(n)])
+
+    # One replica sustains ~1 tok/s within the ITL SLA under this
+    # synthetic profile, so a ramp to many tokens/s demands replicas.
+    planner = Planner(
+        PrefillInterpolator([16, 512], [0.01, 0.05]),
+        DecodeInterpolator([1.0, 8.0], [0.95, 8.0]),
+        RecordingConnector(),
+        sla=SlaTargets(ttft_s=0.5, itl_s=1.0),
+        config=PlannerConfig(predictor="constant"),
+    )
+
+    async with Cluster(num_workers=1) as c:
+        obs = MetricsObserver(c.base_url)
+        await obs.observe()  # baseline scrape
+        async with aiohttp.ClientSession() as s:
+            await fire(s, c.base_url, 1)
+            await asyncio.sleep(0.5)
+            o1 = await obs.observe()
+            plan1 = planner.compute_plan(o1)
+
+            await fire(s, c.base_url, 24)
+            await asyncio.sleep(0.2)
+            o2 = await obs.observe()
+            plan2 = planner.compute_plan(o2)
+
+    assert o2.request_rate > o1.request_rate
+    assert o1.mean_osl == pytest.approx(8, abs=1)
+    assert o1.observed_ttft_s is not None
+    assert plan2.decode_replicas > plan1.decode_replicas, (plan1, plan2)

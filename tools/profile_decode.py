@@ -1,0 +1,197 @@
+"""Decode-step ablation profiler: where does the step time go?
+
+Builds the same fused decode+sample chain EngineCore compiles (bench.py
+shapes: llama3-1b, B=32, ctx ~192) and times variants with individual
+stages disabled. The deltas attribute step time to attention kernel,
+cache scatter, lm-head/logits, sampler, and the matmul weight stream.
+Results feed PERF.md (round-4 perf brief, VERDICT.md #1).
+
+Usage: python tools/profile_decode.py [--batch 32] [--ctx 192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig, llama3_1b
+from dynamo_tpu.engine.model import (
+    _interleave_kv,
+    _logits,
+    init_cache,
+    init_params,
+    rms_norm,
+    rope,
+    split_gu,
+    split_qkv,
+)
+from dynamo_tpu.ops.ragged_attention import ragged_paged_attention
+
+
+def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
+    """One decode step over B lanes with stages toggleable."""
+
+    def fwd(params, cache, tokens, block_tables, positions, active):
+        B = tokens.shape[0]
+        bs = engine.block_size
+        sm_scale = cfg.head_dim ** -0.5
+        page = jnp.take_along_axis(block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+        write_pages = jnp.where(active, page, engine.garbage_block)
+        write_offs = positions % bs
+        kv_lens = jnp.where(active, positions + 1, 1).astype(jnp.int32)
+        cu = jnp.arange(B + 1, dtype=jnp.int32)
+        num_seqs = jnp.array([B], jnp.int32)
+
+        x = params["embed"][tokens]
+        lp_all = params["layers"]
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[l], lp_all)
+            y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            qkv = jnp.dot(y, lp["wqkv"], preferred_element_type=jnp.float32).astype(x.dtype)
+            q, k, v = split_qkv(qkv, cfg)
+            T = q.shape[0]
+            q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
+            k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+            kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
+            if scatter:
+                cache = cache.at[l, write_pages, write_offs].set(kvn)
+            if attn:
+                a = ragged_paged_attention(
+                    q, cache[l], kv_lens, block_tables, cu, num_seqs,
+                    sm_scale=sm_scale,
+                )
+            else:
+                a = q
+            a = a.reshape(T, cfg.q_size)
+            x = x + jnp.dot(a, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+            y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            gu = jnp.dot(y, lp["wgu"], preferred_element_type=jnp.float32)
+            g, u = split_gu(gu)
+            act = (jax.nn.silu(g) * u).astype(x.dtype)
+            x = x + jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        if head:
+            logits = _logits(x, params, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = tokens
+        return nxt, cache
+
+    return fwd
+
+
+def build_chain(cfg, engine, n_steps, **flags):
+    fwd = build_forward(cfg, engine, **flags)
+
+    def chain(params, cache, tokens, block_tables, positions, active):
+        step = jnp.asarray(active, jnp.int32)
+
+        def body(carry, i):
+            toks, cache = carry
+            nxt, cache = fwd(params, cache, toks, block_tables, positions + i * step, active)
+            return (nxt, cache), nxt
+
+        (_, cache), sampled = jax.lax.scan(body, (tokens, cache), jnp.arange(n_steps))
+        return sampled, cache
+
+    return jax.jit(chain, donate_argnums=(1,))
+
+
+def timeit(fn, args, cache, n=5):
+    # compile + warm; sync via device->host transfer (on the axon relay
+    # platform block_until_ready does not flush the execution queue).
+    out, cache = fn(*args[:1], cache, *args[2:])
+    np.asarray(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out, cache = fn(*args[:1], cache, *args[2:])
+        np.asarray(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, cache
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--ctx", type=int, default=192)
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--blocks", type=int, default=512)
+    ap.add_argument("--only", default=None, help="run a single variant, e.g. 'full'")
+    ap.add_argument("--block-size", type=int, default=32)
+    ap.add_argument("--max-model-len", type=int, default=512)
+    args = ap.parse_args()
+
+    cfg = llama3_1b()
+    engine = EngineConfig(
+        num_kv_blocks=args.blocks, block_size=args.block_size,
+        max_num_seqs=args.batch, max_model_len=args.max_model_len,
+        decode_buckets=(args.batch,), decode_chain=args.steps,
+    )
+    B, n_steps = args.batch, args.steps
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(1, cfg.vocab_size, B), jnp.int32)
+    positions = jnp.full((B,), args.ctx, jnp.int32)
+    bs = engine.block_size
+    blocks_per_seq = engine.max_blocks_per_seq
+    tables = np.full((B, blocks_per_seq), engine.garbage_block, np.int32)
+    need = (args.ctx + n_steps) // bs + 1
+    ids = rng.permutation(args.blocks)[: B * need].reshape(B, need)
+    tables[:, :need] = ids
+    tables = jnp.asarray(tables)
+    active = jnp.ones((B,), bool)
+
+    pbytes = cfg.param_bytes()
+    kv_tok = cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2 * 2
+    print(f"# B={B} ctx={args.ctx} steps={n_steps} params={pbytes/1e9:.2f}GB "
+          f"kv/tok={kv_tok} backend={jax.default_backend()}")
+
+    variants = [
+        ("full", dict()),
+        ("no_attn", dict(attn=False)),
+        ("no_scatter", dict(scatter=False)),
+        ("no_head", dict(head=False)),
+        ("no_attn_no_scatter", dict(attn=False, scatter=False)),
+        ("matmuls_only", dict(attn=False, scatter=False, head=False)),
+    ]
+    if args.only:
+        variants = [v for v in variants if v[0] == args.only]
+    results = {}
+    for name, flags in variants:
+        cache = init_cache(cfg, engine)
+        fn = build_chain(cfg, engine, n_steps, **flags)
+        t, cache = timeit(fn, (params, cache, tokens, tables, positions, active), cache)
+        del cache
+        per_step = t / n_steps * 1e3
+        results[name] = per_step
+        print(f"{name:22s} {t*1e3:8.2f} ms/chain   {per_step:7.3f} ms/step")
+
+    if args.only:
+        return
+
+    # single-step (chain of 1) dispatch overhead
+    cache = init_cache(cfg, engine)
+    fn1 = build_chain(cfg, engine, 1)
+    t1, cache = timeit(fn1, (params, cache, tokens, tables, positions, active), cache)
+    del cache
+    print(f"{'single_step_chain1':22s} {t1*1e3:8.2f} ms/chain   {t1*1e3:7.3f} ms/step")
+
+    full = results["full"]
+    print("\n# attributed ms/step:")
+    print(f"  attention kernel : {full - results['no_attn']:.3f}")
+    print(f"  cache scatter    : {full - results['no_scatter']:.3f}")
+    print(f"  lm head + argmax : {full - results['no_head']:.3f}")
+    print(f"  matmul stream    : {results['matmuls_only']:.3f}")
+    hbm = float(__import__("os").environ.get("BENCH_HBM_GBPS", 819))
+    floor = (pbytes + B * (args.ctx + n_steps / 2) * kv_tok) / (hbm * 1e9) * 1e3
+    print(f"  roofline floor   : {floor:.3f}")
+
+
+if __name__ == "__main__":
+    main()
