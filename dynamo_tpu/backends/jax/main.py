@@ -59,8 +59,12 @@ def build_engine(
     on_removed=None,
     tp: int = 1,
     dp: int = 1,
+    quant: str | None = None,
 ):
     """Construct (EngineCore, TpuEngine) for a model preset.
+
+    ``quant='int8'`` serves int8 weight-only-quantized params (the
+    capacity mode that fits llama3-8b on one 16 GB chip).
 
     ``tp``/``dp`` > 1 build a device mesh and shard the engine in-process
     (TP over ICI; the reference's tp plumbing is vllm/args.py:239-258 —
@@ -93,9 +97,21 @@ def build_engine(
             if not buckets:
                 buckets = (dp * max(1, engine_cfg.decode_buckets[-1] // dp),)
             engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
+    params = None
+    if quant == "int8":
+        if mesh is not None:
+            raise ValueError("int8 quantization is single-chip for now")
+        import jax
+
+        from dynamo_tpu.engine.model import init_params_quantized
+
+        params = init_params_quantized(jax.random.PRNGKey(seed), model_cfg)
+    elif quant:
+        raise ValueError(f"unknown quantization {quant!r}")
     core = EngineCore(
         model_cfg,
         engine_cfg,
+        params=params,
         seed=seed,
         eos_token_ids=eos_token_ids,
         on_stored=on_stored,
@@ -120,6 +136,7 @@ async def run_jax_worker(
     core_out: list | None = None,
     tp: int = 1,
     dp: int = 1,
+    quant: str | None = None,
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
@@ -159,6 +176,7 @@ async def run_jax_worker(
         on_removed=on_removed,
         tp=tp,
         dp=dp,
+        quant=quant,
     )
 
     if core_out is not None:
@@ -503,6 +521,8 @@ def main() -> None:
     ap.add_argument("--max-num-seqs", type=int, default=None)
     ap.add_argument("--max-model-len", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quant", default=None, choices=["int8"],
+                    help="int8 weight-only quantization")
     ap.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel degree (shards heads/mlp over the mesh's tp axis)",
@@ -546,6 +566,7 @@ def main() -> None:
             ),
             tp=args.tp,
             dp=args.dp,
+            quant=args.quant,
         )
 
     entry()

@@ -75,6 +75,21 @@ class ModelConfig:
         bytes_per = jnp.dtype(self.jax_dtype).itemsize
         return total * bytes_per
 
+    def quantized_param_bytes(self) -> int:
+        """Footprint with int8 weight-only quantization
+        (model.quantize_params: projections + lm_head at 1 byte,
+        embeddings/norms at the model dtype)."""
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        proj_per_layer = (
+            h * (self.q_size + 2 * self.kv_size) + self.q_size * h + 3 * h * i
+        )
+        bytes_per = jnp.dtype(self.jax_dtype).itemsize
+        int8_bytes = self.num_layers * proj_per_layer
+        bf16_bytes = (v * h + 2 * h * self.num_layers + h) * bytes_per
+        if not self.tie_embeddings:
+            int8_bytes += h * v  # lm_head quantized too
+        return int8_bytes + bf16_bytes
+
 
 @dataclass(frozen=True)
 class EngineConfig:
@@ -111,6 +126,11 @@ class EngineConfig:
     # host afterwards; near the context edge the engine falls back to
     # single steps. 1 = classic per-token stepping.
     decode_chain: int = 8
+
+    # Sequence-parallel long-context prefill: prompts at least this long
+    # (with no cached prefix) run as ONE dense ring-attention pass over
+    # the engine's sp mesh instead of chunked paged waves. 0 = off.
+    ring_prefill_threshold: int = 0
 
     @property
     def max_blocks_per_seq(self) -> int:

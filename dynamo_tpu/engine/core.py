@@ -42,6 +42,7 @@ from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.model import (
     decode_tokens,
     embed_forward,
+    forward_ring_prefill,
     forward_tokens,
     init_cache,
     init_params,
@@ -175,6 +176,24 @@ def _decode_chain(
     return sampled, lps, cache
 
 
+def _ring_prefill_and_sample(
+    params, cache, tokens, write_pages, write_offs, last_row,
+    seeds, counters, temperature, top_k, top_p,
+    *, need_mask, all_greedy=False, want_logprobs=False, cfg, engine, sp_mesh,
+):
+    """One dense sequence-parallel prefill (ring attention over sp) +
+    fused first-token sampling for a single long prompt."""
+    logits, cache = forward_ring_prefill(
+        params, cache, tokens, write_pages, write_offs, last_row,
+        cfg, engine, sp_mesh,
+    )
+    toks = _sample_from_logits(
+        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
+    )
+    lps = token_logprobs(logits, toks) if want_logprobs else None
+    return toks, lps, cache
+
+
 def _prefill_and_sample(
     params, cache, tokens, positions, write_pages, write_offs,
     kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
@@ -207,6 +226,7 @@ class EngineCore:
         on_stored: Callable[[list[int], int | None], None] | None = None,
         on_removed: Callable[[list[int]], None] | None = None,
         mesh: Any = None,
+        sp_mesh: Any = None,
     ):
         """``mesh`` (a jax.sharding.Mesh with axes ("dp", "tp")) turns on
         in-engine model parallelism: params/cache shard per
@@ -325,6 +345,21 @@ class EngineCore:
             static_argnames=("need_mask", "all_greedy", "want_logprobs"),
             donate_argnums=(1,),
         )
+        self.sp_mesh = sp_mesh
+        self._ring = None
+        if sp_mesh is not None:
+            if mesh is not None:
+                raise ValueError("sp_mesh (sequence parallel) and mesh (tp/dp) "
+                                 "are mutually exclusive for now")
+            self._ring = jax.jit(
+                partial(
+                    _ring_prefill_and_sample,
+                    cfg=model_cfg, engine=engine_cfg, sp_mesh=sp_mesh,
+                ),
+                static_argnames=("need_mask", "all_greedy", "want_logprobs"),
+                donate_argnums=(1,),
+            )
+        self._ring_prefills = 0  # observability: ring-path invocations
         self._decode = jax.jit(
             partial(_decode_chain, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
             static_argnames=("n_steps", "need_mask", "all_greedy", "want_logprobs"),
@@ -594,6 +629,74 @@ class EngineCore:
             out.append((seq, chunk, int(toks[i]) if seq.prefill_done else None, lp))
         return out
 
+    def _maybe_ring_prefill(self, prefills: list[Sequence]):
+        """Dispatch one eligible long prompt to the sequence-parallel ring
+        path (dense ring-attention prefill over the sp mesh; the paged
+        cache is written in the same pass, so decode continues normally).
+        Returns emitted (seq, chunk) outputs or None to fall through to
+        the regular ragged wave."""
+        if self._ring is None or self.engine.ring_prefill_threshold <= 0:
+            return None
+        n_sp = int(self.sp_mesh.shape["sp"])
+        for seq in prefills:
+            if seq.prefilled or seq.committed_blocks:
+                continue  # cached prefix / mid-flight: paged waves own it
+            if seq.prompt_len < self.engine.ring_prefill_threshold:
+                continue
+            try:
+                T = self._bucket_for(seq.prompt_len)
+            except ValueError:
+                continue  # longer than the largest bucket: chunked waves
+            if T % n_sp:
+                continue
+            return self._run_ring_prefill(seq, T)
+        return None
+
+    def _run_ring_prefill(self, seq: Sequence, T: int):
+        bs = self.engine.block_size
+        P_len = seq.prompt_len
+        tokens = np.zeros(T, np.int32)
+        tokens[:P_len] = seq.prompt
+        pos = np.arange(T, dtype=np.int32)
+        write_pages = np.full(T, self.engine.garbage_block, np.int32)
+        ids = np.asarray(seq.block_ids, np.int32)
+        write_pages[:P_len] = ids[pos[:P_len] // bs]
+        write_offs = pos % bs
+        want_lp = seq.logprobs is not None
+        all_greedy = seq.sampling.temperature == 0.0
+        need_mask = seq.sampling.top_k > 0 or seq.sampling.top_p < 1.0
+        toks, lps, self.cache = self._ring(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(write_pages),
+            jnp.asarray(write_offs),
+            jnp.asarray(P_len - 1, jnp.int32),
+            jnp.asarray([seq.seed], np.int32),
+            jnp.asarray([seq.generated], np.int32),
+            jnp.asarray([seq.sampling.temperature], np.float32),
+            jnp.asarray([seq.sampling.top_k], np.int32),
+            jnp.asarray([seq.sampling.top_p], np.float32),
+            need_mask=need_mask and not all_greedy,
+            all_greedy=all_greedy,
+            want_logprobs=want_lp,
+        )
+        self._ring_prefills += 1
+        tok = int(np.asarray(toks)[0])
+        completed = seq.hashed.extend(seq.prompt)
+        self._commit_completed(seq, completed)
+        seq.prefilled = seq.processed = P_len
+        seq.pending = tok
+        seq.generated += 1
+        lp = None
+        if want_lp and lps is not None:
+            lps = tuple(np.asarray(a) for a in lps)
+            lp = _lp_entry(tok, lps[0][0], lps[1][0], lps[2][0], seq.logprobs)
+        out = self._emit(seq, tok, lp)
+        if seq.finish is not None:
+            self._finish(seq)
+        return [(seq, out)]
+
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
         """Ensure physical blocks exist for the next ``n_tokens`` decode
         writes (positions processed .. processed+n_tokens-1)."""
@@ -703,6 +806,10 @@ class EngineCore:
 
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
+            ring_out = self._maybe_ring_prefill(prefills)
+            if ring_out is not None:
+                outputs.extend(ring_out)
+                return outputs
             for seq, _chunk, tok, lp in self._run_prefill_wave(prefills):
                 if tok is None:
                     continue  # prompt not finished this wave
@@ -793,16 +900,32 @@ class EngineCore:
         return k, finish
 
     def _chain_length(self, seqs: list[Sequence]) -> int:
-        """Fused decode steps this iteration. Always the configured chain
-        unless the context edge forces fewer (hard limit — no writes past
-        the block table); then snap down to a power of two. Generation
-        budgets do NOT shorten chains: overshoot tokens are discarded by
-        the host stop-check, which costs a little compute but keeps the
-        compiled-program count at ~1 instead of one per tail length."""
+        """Fused decode steps this iteration: the configured chain, capped
+        by the context edge (hard limit — no writes past the block table)
+        and by the batch's LARGEST remaining generation budget (with every
+        lane's budget nearly spent, long chains are pure overshoot — the
+        short-budget tool-call workload). Snapped down to a power of two
+        so the compiled-program count stays O(log chain); per-lane
+        overshoot within a chain is discarded by the host stop-scan."""
         ctx_cap = min(self.engine.max_model_len - s.processed for s in seqs)
-        n = max(1, min(self.engine.decode_chain, ctx_cap))
+        budget_cap = max(
+            (
+                s.stop.max_tokens - s.generated
+                if s.stop.max_tokens is not None
+                else self.engine.decode_chain
+            )
+            for s in seqs
+        )
+        n = max(1, min(self.engine.decode_chain, ctx_cap, budget_cap))
         if n == self.engine.decode_chain:
             return n
+        # Snap to a power of two (bounded compiled-program count). Round
+        # UP when the overshoot is small (<=1/3): a budget of 127 should
+        # run one 128-step chain, not a 64+32+16+... cascade of fixed
+        # per-invocation overheads.
+        up = 1 << (n - 1).bit_length()
+        if up <= min(self.engine.decode_chain, ctx_cap) and up * 3 <= n * 4:
+            return up
         return 1 << (n.bit_length() - 1)
 
     def _emit_chunk(

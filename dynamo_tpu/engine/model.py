@@ -44,6 +44,103 @@ from dynamo_tpu.ops.ragged_attention import (
 Params = dict[str, Any]
 
 
+# -- int8 weight-only quantization ------------------------------------------
+
+def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
+    """Per-output-channel symmetric int8: w ~= w_int8 * scale[out].
+    Weight-only (activations stay bf16) — the capacity play that fits
+    llama3-8b on one 16 GB v5e chip (bf16 params alone are 16.06 GB).
+    The reference serves FP8 checkpoints through its engines; on TPU the
+    analogue is int8 with the convert fused into the matmul by XLA."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"w": q, "scale": scale.astype(jnp.float32)}
+
+
+def _dot(x: jax.Array, w) -> jax.Array:
+    """Matmul against a plain or int8-quantized weight; returns f32."""
+    if isinstance(w, dict):
+        y = jnp.dot(
+            x, w["w"].astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return y * w["scale"].reshape(1, -1)
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+def init_params_quantized(rng: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
+    """Random-init directly into the int8-quantized layout.
+
+    Materializing the full bf16 pytree first (init_params +
+    quantize_params) peaks at the bf16 footprint — for llama3-8b that is
+    16.06 GB, which cannot exist on a 16 GB chip at all. Here every
+    fused projection group is generated directly (random fused == fused
+    random) and quantized per LAYER inside one jitted program, so XLA
+    frees each layer's bf16/f32 transients before the next; the
+    steady-state footprint is the int8 result.
+    """
+    if cfg.is_moe:
+        raise NotImplementedError("int8 init for MoE presets not yet supported")
+    h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    dt = cfg.jax_dtype
+
+    def build(rng):
+        keys = jax.random.split(rng, 8)
+
+        def dense(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
+            ).astype(dt)
+
+        def qdense_stacked(key, shape2d, fan_in):
+            ws, scales = [], []
+            for l in range(L):
+                q = quantize_weight(dense(jax.random.fold_in(key, l), shape2d, fan_in))
+                ws.append(q["w"])
+                scales.append(q["scale"])
+            return {"w": jnp.stack(ws), "scale": jnp.stack(scales)}
+
+        layers: dict[str, Any] = {
+            "attn_norm": jnp.ones((L, h), dt),
+            "mlp_norm": jnp.ones((L, h), dt),
+            # Fused layouts generated directly at the fused shape.
+            "wqkv": qdense_stacked(keys[1], (h, cfg.q_size + 2 * cfg.kv_size), h),
+            "wo": qdense_stacked(keys[4], (cfg.q_size, h), cfg.q_size),
+            "wgu": qdense_stacked(keys[5], (h, 2 * i), h),
+            "w_down": qdense_stacked(keys[7], (i, h), i),
+        }
+        params: Params = {
+            "embed": dense(keys[0], (v, h), h),
+            "layers": layers,
+            "final_norm": jnp.ones((h,), dt),
+            "fuse_tp": jnp.asarray(tp, jnp.int32),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = quantize_weight(
+                dense(jax.random.fold_in(rng, 99), (h, v), h)
+            )
+        return params
+
+    return jax.jit(build)(rng)
+
+
+def quantize_params(params: Params) -> Params:
+    """int8-quantize the layer projection weights (wqkv/wo/wgu/w_down and
+    lm_head); embeddings and norms stay in the model dtype. MoE expert
+    weights stay unquantized (3-D; quantize later if wide-EP needs it)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for k in ("wqkv", "wo", "wgu", "w_down"):
+        if k in layers and not isinstance(layers[k], dict):
+            out_axis_scale = quantize_weight(layers[k])
+            layers[k] = out_axis_scale
+    out["layers"] = layers
+    if "lm_head" in params and not isinstance(params["lm_head"], dict):
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
 # -- fused-projection layout ------------------------------------------------
 
 def fuse_qkv(wq: jax.Array, wk: jax.Array, wv: jax.Array, tp: int = 1) -> jax.Array:
@@ -179,10 +276,10 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 def _mlp(x, lp, cfg: ModelConfig, tp: int, mesh=None):
     if cfg.is_moe:
         return _moe_mlp(x, lp, cfg, mesh)
-    gu = jnp.dot(x, lp["wgu"], preferred_element_type=jnp.float32)
+    gu = _dot(x, lp["wgu"])
     g, u = split_gu(gu, tp)
     act = (jax.nn.silu(g) * u).astype(x.dtype)
-    return jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+    return _dot(act, lp["w_down"]).astype(x.dtype)
 
 
 def _moe_capacity(N: int, cfg: ModelConfig) -> int:
@@ -293,7 +390,7 @@ def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
             (((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-    return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
+    return _dot(x, params["lm_head"])
 
 
 def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -367,7 +464,7 @@ def forward_hidden(
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        qkv = jnp.dot(y, lp["wqkv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
         q, k, v = split_qkv(qkv, cfg, tp)
         q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
         k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
@@ -384,10 +481,61 @@ def forward_hidden(
                 sm_scale=sm_scale,
             )
         attn = attn.reshape(T, cfg.q_size)
-        x = x + jnp.dot(attn, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _dot(attn, lp["wo"]).astype(x.dtype)
         x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
 
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), cache
+
+
+def forward_ring_prefill(
+    params: Params,
+    cache: jax.Array,        # paged cache (donated)
+    tokens: jax.Array,       # [T] i32, ONE prompt, bucket-padded
+    write_pages: jax.Array,  # [T] i32 (garbage page for pad rows)
+    write_offs: jax.Array,   # [T] i32
+    last_row: jax.Array,     # [] i32 — index of the prompt's last token
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    sp_mesh,
+    axis_name: str = "sp",
+) -> tuple[jax.Array, jax.Array]:
+    """Sequence-parallel long-context prefill: ONE long prompt, hidden
+    states computed densely with ring attention over the ``sp`` mesh axis
+    (K/V chunks rotate over ICI via ppermute — ops/ring_attention.py)
+    while each token's K/V is also written into the paged cache, so
+    decode continues on the normal paged path. Returns (last-token logits
+    [1, vocab] f32, cache).
+
+    The reference has no sequence parallelism at all (SURVEY.md §2.6
+    "ABSENT"); this is the TPU-native long-context prefill the project
+    brief calls first-class. Causal masking makes bucket padding safe:
+    pad rows sit AFTER the last real token, so no real row attends them.
+    """
+    from dynamo_tpu.ops.ring_attention import ring_attention
+
+    T = tokens.shape[0]
+    positions = jnp.arange(T, dtype=jnp.int32)
+    x = params["embed"][tokens]  # [T, h]
+    lp_all = params["layers"]
+
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[l], lp_all)
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
+        q, k, v = split_qkv(qkv, cfg)
+        q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
+        k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+        v3 = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
+        cache = cache.at[l, write_pages, write_offs].set(kvn)
+        attn = ring_attention(q, k, v3, mesh=sp_mesh, axis_name=axis_name)
+        attn = attn.reshape(T, cfg.q_size)
+        x = x + _dot(attn, lp["wo"]).astype(x.dtype)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, 1, None)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jax.lax.dynamic_slice_in_dim(x, last_row, 1, axis=0)  # [1, h]
+    return _logits(last, params, cfg), cache
 
 
 def embed_forward(

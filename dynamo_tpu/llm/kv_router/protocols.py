@@ -110,6 +110,10 @@ class RouterConfig:
     temperature: float = 0.0         # 0 = deterministic argmin of cost
     use_kv_events: bool = True       # False → ApproxKvIndexer
     replica_sync: bool = False
+    # Exclude workers whose KV-cache usage is at/above this fraction from
+    # routing while alternatives exist (busy-aware routing; reference
+    # worker_monitor.rs + frontend --busy-threshold). None = off.
+    busy_threshold: float | None = None
     # None → inherit the model card's kv_block_size at model-add time.
     # Must match the worker's KV block size or seq hashes never overlap.
     block_size: int | None = None

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -133,3 +134,33 @@ class MetricsAggregator:
 
     def remove_worker(self, worker_id: int) -> None:
         self.latest.pop(worker_id, None)
+
+    def snapshot(self) -> "ProcessedEndpoints":
+        """Cluster-wide aggregate view for scheduler/planner consumers
+        (reference scoring.rs:93 ProcessedEndpoints)."""
+        workers = dict(self.latest)
+        usages = {w: m.kv.gpu_cache_usage_perc for w, m in workers.items()}
+        slots_total = sum(m.worker.request_total_slots for m in workers.values())
+        slots_active = sum(m.worker.request_active_slots for m in workers.values())
+        waiting = sum(m.worker.num_requests_waiting for m in workers.values())
+        return ProcessedEndpoints(
+            worker_ids=sorted(workers),
+            kv_usage=usages,
+            avg_kv_usage=(sum(usages.values()) / len(usages)) if usages else 0.0,
+            max_kv_usage=max(usages.values(), default=0.0),
+            total_slots=slots_total,
+            active_slots=slots_active,
+            requests_waiting=waiting,
+        )
+
+@dataclass
+class ProcessedEndpoints:
+    """One coherent scrape of the worker fleet's load."""
+
+    worker_ids: list[int] = field(default_factory=list)
+    kv_usage: dict[int, float] = field(default_factory=dict)
+    avg_kv_usage: float = 0.0
+    max_kv_usage: float = 0.0
+    total_slots: int = 0
+    active_slots: int = 0
+    requests_waiting: int = 0

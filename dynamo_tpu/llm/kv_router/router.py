@@ -126,12 +126,18 @@ class KvRouter:
 class KvPushRouter:
     """EndpointClient + KvRouter glued into one `generate` surface."""
 
-    def __init__(self, client: EndpointClient, router: KvRouter):
+    def __init__(self, client: EndpointClient, router: KvRouter, monitor=None):
         self.client = client
         self.router = router
+        # Optional WorkerMonitor (runtime/worker_monitor.py): busy-aware
+        # routing when the config sets a busy_threshold; its aggregator
+        # also feeds ProcessedEndpoints snapshots to observers.
+        self.monitor = monitor
         client.on_instance_removed.append(self._on_worker_gone)
 
     def _on_worker_gone(self, worker_id: int) -> None:
+        if self.monitor is not None:
+            self.monitor.remove_worker(worker_id)
         orphans = self.router.remove_worker(worker_id)
         if orphans:
             log.info("worker %d died with %d in-flight requests", worker_id, len(orphans))
@@ -151,6 +157,8 @@ class KvPushRouter:
             # Migration retries must not re-dial a worker that just failed —
             # its cached prefix makes it the router's top pick otherwise.
             workers = [w for w in workers if w not in exclude] or workers
+        if self.monitor is not None and self.router.config.busy_threshold is not None:
+            workers = self.monitor.eligible(workers)
         if not workers:
             raise NoInstancesError(self.client.endpoint.path)
         pinned = overrides.get("backend_instance_id")

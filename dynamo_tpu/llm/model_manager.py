@@ -20,6 +20,8 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.discovery import ModelEntry, ModelWatcher
 from dynamo_tpu.llm.kv_router.protocols import RouterConfig
+from dynamo_tpu.llm.kv_router.publisher import MetricsAggregator
+from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
 from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
 from dynamo_tpu.llm.migration import Migration
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
@@ -40,6 +42,9 @@ class ServedModel:
     kv_router: KvRouter | None
     push_router: KvPushRouter | None
     migration: Migration
+    # Live fleet load (ForwardPassMetrics per worker; ProcessedEndpoints
+    # snapshots) — busy-aware routing + planner observation source.
+    aggregator: MetricsAggregator | None = None
 
     async def generate(
         self, pre: PreprocessedRequest, headers: dict[str, str] | None = None
@@ -75,6 +80,8 @@ class ModelManager:
             await served.client.stop()
             if served.kv_router:
                 await served.kv_router.stop()
+            if served.aggregator:
+                await served.aggregator.stop()
 
     async def _on_added(self, entry: ModelEntry, mdc: ModelDeploymentCard) -> None:
         endpoint = (
@@ -85,6 +92,7 @@ class ModelManager:
         client = await endpoint.client()
         kv_router = None
         push_router = None
+        aggregator = None
         if self.router_mode == "kv":
             from dataclasses import replace as _replace
 
@@ -97,7 +105,15 @@ class ModelManager:
                 self.runtime.store, entry.namespace, entry.component, config
             )
             await kv_router.start()
-            push_router = KvPushRouter(client, kv_router)
+            monitor = WorkerMonitor(
+                self.runtime.store,
+                entry.namespace,
+                entry.component,
+                busy_threshold=config.busy_threshold or 0.95,
+            )
+            await monitor.start()
+            aggregator = monitor.aggregator
+            push_router = KvPushRouter(client, kv_router, monitor=monitor)
         migration = Migration(
             client=client,
             push_router=push_router,
@@ -112,6 +128,7 @@ class ModelManager:
             kv_router=kv_router,
             push_router=push_router,
             migration=migration,
+            aggregator=aggregator,
         )
         self._model_event.set()
         self._model_event = asyncio.Event()
@@ -123,6 +140,8 @@ class ModelManager:
             await served.client.stop()
             if served.kv_router:
                 await served.kv_router.stop()
+            if served.aggregator:
+                await served.aggregator.stop()
         log.info("model %r removed", name)
 
     def get(self, name: str) -> ServedModel | None:

@@ -1,0 +1,85 @@
+"""Scaling connectors: turn a Plan into actual fleet changes.
+
+The reference scales by patching DynamoGraphDeployment replica counts and
+letting the Kubernetes operator reconcile pods
+(`components/planner/.../kubernetes_connector.py`, `kube.py`). This
+environment has no cluster, so the production-shaped connector here
+manages local worker PROCESSES: spawn to scale up, terminate to scale
+down; dead children are reaped and respawned on the next adjustment. The
+discovery plane reacts exactly as it would under an orchestrator — new
+workers register under store leases, terminated ones vanish on lease
+expiry, and the frontend's watcher prunes them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from typing import Sequence
+
+log = logging.getLogger("dynamo_tpu.planner.connector")
+
+
+class LocalProcessConnector:
+    def __init__(
+        self,
+        store_address: str,
+        worker_argv: dict[str, Sequence[str]],
+        env: dict[str, str] | None = None,
+    ):
+        """``worker_argv`` maps component name ("prefill"/"decode"/...) to
+        the argv that starts ONE worker of that kind, e.g.
+        ``["-m", "dynamo_tpu.backends.mocker", "--model-name", "m"]``
+        (interpreted relative to this interpreter)."""
+        self.store_address = store_address
+        self.worker_argv = {k: list(v) for k, v in worker_argv.items()}
+        self.env = env or {}
+        self._procs: dict[str, list[subprocess.Popen]] = {}
+
+    def _reap(self, component: str) -> list[subprocess.Popen]:
+        procs = self._procs.setdefault(component, [])
+        live = [p for p in procs if p.poll() is None]
+        dead = len(procs) - len(live)
+        if dead:
+            log.warning("%d dead %s worker(s) reaped", dead, component)
+        self._procs[component] = live
+        return live
+
+    def current(self, component: str) -> int:
+        return len(self._reap(component))
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        argv = self.worker_argv.get(component)
+        if argv is None:
+            log.warning("no worker command for component %r", component)
+            return
+        procs = self._reap(component)
+        while len(procs) < replicas:
+            env = dict(os.environ, DYN_STORE_ADDRESS=self.store_address, **self.env)
+            p = subprocess.Popen(
+                [sys.executable, *argv],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            procs.append(p)
+            log.info("scaled up %s -> %d (pid %d)", component, len(procs), p.pid)
+        while len(procs) > replicas:
+            p = procs.pop()
+            p.terminate()
+            log.info("scaled down %s -> %d (pid %d)", component, len(procs), p.pid)
+
+    def shutdown(self) -> None:
+        for procs in self._procs.values():
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        for procs in self._procs.values():
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        self._procs.clear()

@@ -30,7 +30,6 @@ responses ``{"i": id, "ok": bool, "r"/"err": ...}``; server-push events
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 import time
 from collections import defaultdict, deque
@@ -109,7 +108,7 @@ class StoreServer:
         self.port = port
         self._server: asyncio.Server | None = None
         self._rev = 0
-        self._ids = itertools.count(1)
+        self._next_id = 1
         self._kv: dict[str, _KvEntry] = {}
         self._leases: dict[int, _Lease] = {}
         self._subs: dict[int, _Sub] = {}
@@ -130,12 +129,15 @@ class StoreServer:
     async def stop(self) -> None:
         if self._sweeper:
             self._sweeper.cancel()
-        if self._server:
-            self._server.close()
-            await self._server.wait_closed()
+        # Close live connections BEFORE wait_closed(): since 3.12 it waits
+        # for every connection handler, so a connected client (e.g. one
+        # about to exercise reconnect) would hang shutdown forever.
         for conn in list(self._conns.values()):
             conn.closed = True
             conn.writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
 
     async def __aenter__(self) -> "StoreServer":
         await self.start()
@@ -151,7 +153,7 @@ class StoreServer:
     # -- connection handling ----------------------------------------------
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        conn = _Conn(next(self._ids), writer)
+        conn = _Conn(self._new_id(), writer)
         self._conns[conn.conn_id] = conn
         sender = asyncio.create_task(self._send_loop(conn))
         try:
@@ -256,7 +258,7 @@ class StoreServer:
         ]
 
     async def _op_kv_watch(self, conn: _Conn, msg: dict) -> dict:
-        sub_id = next(self._ids)
+        sub_id = self._new_id()
         self._subs[sub_id] = _Sub(sub_id, conn, "watch", msg["k"])
         initial = []
         if msg.get("with_initial", True):
@@ -272,10 +274,29 @@ class StoreServer:
 
     # -- leases ------------------------------------------------------------
 
+    def _new_id(self) -> int:
+        i = self._next_id
+        self._next_id += 1
+        return i
+
     async def _op_lease_grant(self, conn: _Conn, msg: dict) -> dict:
-        lease_id = next(self._ids)
         ttl = float(msg.get("ttl", 10.0))
         conn_bound = bool(msg.get("conn_bound", True))
+        want = msg.get("want")
+        if want:
+            # Reconnect re-attach: adopt an existing lease (connection
+            # blip) or recreate it under the same id (server restart) —
+            # higher layers key worker identity on the lease id, so a
+            # fresh id would orphan every registration that embeds it.
+            lease_id = int(want)
+            self._next_id = max(self._next_id, lease_id + 1)
+            existing = self._leases.get(lease_id)
+            if existing is not None:
+                existing.conn_id = conn.conn_id if conn_bound else 0
+                existing.deadline = time.monotonic() + existing.ttl_s
+                return {"lease": lease_id, "ttl": existing.ttl_s}
+        else:
+            lease_id = self._new_id()
         self._leases[lease_id] = _Lease(
             lease_id=lease_id,
             ttl_s=ttl,
@@ -313,7 +334,7 @@ class StoreServer:
     # -- pub/sub -----------------------------------------------------------
 
     async def _op_sub(self, conn: _Conn, msg: dict) -> dict:
-        sub_id = next(self._ids)
+        sub_id = self._new_id()
         self._subs[sub_id] = _Sub(sub_id, conn, "sub", msg["subject"])
         return {"sub": sub_id}
 

@@ -3,15 +3,21 @@
 Capability parity: reference `lib/runtime/src/utils/worker_monitor.rs:50-89`
 — the frontend watches per-worker ForwardPassMetrics and routes around
 workers whose KV usage exceeds ``busy_threshold`` (busy-aware routing).
+
+Built on :class:`~dynamo_tpu.llm.kv_router.publisher.MetricsAggregator`
+(the one subscription to the load-metrics subject): the aggregator owns
+the latest-metrics view and ProcessedEndpoints snapshots; this monitor is
+the incremental busy-set policy on top of it. One subject subscription,
+one busy implementation.
 """
 
 from __future__ import annotations
 
-import asyncio
 import logging
 from typing import Callable
 
-from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, load_metrics_subject
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.kv_router.publisher import MetricsAggregator
 
 log = logging.getLogger("dynamo_tpu.worker_monitor")
 
@@ -24,48 +30,40 @@ class WorkerMonitor:
         component: str,
         busy_threshold: float = 0.95,
         on_busy_change: Callable[[int, bool], None] | None = None,
+        aggregator: MetricsAggregator | None = None,
     ):
-        self.store = store
-        self.subject = load_metrics_subject(namespace, component)
+        self.aggregator = aggregator or MetricsAggregator(store, namespace, component)
         self.busy_threshold = busy_threshold
         self.on_busy_change = on_busy_change or (lambda w, b: None)
-        self.metrics: dict[int, ForwardPassMetrics] = {}
         self.busy: set[int] = set()
-        self._task: asyncio.Task | None = None
-        self._sub = None
+        self.aggregator.on_update.append(self._on_metrics)
+
+    @property
+    def metrics(self) -> dict[int, ForwardPassMetrics]:
+        return self.aggregator.latest
 
     async def start(self) -> None:
-        self._sub = await self.store.subscribe(self.subject)
-        self._task = asyncio.create_task(self._loop())
+        await self.aggregator.start()
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-        if self._sub:
-            await self._sub.unsubscribe()
+        await self.aggregator.stop()
 
-    async def _loop(self) -> None:
-        assert self._sub is not None
-        async for msg in self._sub:
-            try:
-                fpm = ForwardPassMetrics.from_wire(msg["p"])
-            except Exception:  # noqa: BLE001
-                continue
-            worker_id = fpm.worker_id
-            self.metrics[worker_id] = fpm
-            usage = fpm.kv.gpu_cache_usage_perc
-            was_busy = worker_id in self.busy
-            now_busy = usage >= self.busy_threshold
-            if now_busy != was_busy:
-                (self.busy.add if now_busy else self.busy.discard)(worker_id)
-                log.info("worker %d busy=%s (kv %.0f%%)", worker_id, now_busy, usage * 100)
-                self.on_busy_change(worker_id, now_busy)
+    def _on_metrics(self, fpm: ForwardPassMetrics) -> None:
+        worker_id = fpm.worker_id
+        usage = fpm.kv.gpu_cache_usage_perc
+        was_busy = worker_id in self.busy
+        now_busy = usage >= self.busy_threshold
+        if now_busy != was_busy:
+            (self.busy.add if now_busy else self.busy.discard)(worker_id)
+            log.info("worker %d busy=%s (kv %.0f%%)", worker_id, now_busy, usage * 100)
+            self.on_busy_change(worker_id, now_busy)
 
     def eligible(self, workers: list[int]) -> list[int]:
-        """Filter busy workers out (all-busy falls back to the full set)."""
+        """Filter busy workers out (all-busy falls back to the full set —
+        shedding beats rejecting)."""
         free = [w for w in workers if w not in self.busy]
         return free or workers
 
     def remove_worker(self, worker_id: int) -> None:
-        self.metrics.pop(worker_id, None)
+        self.aggregator.remove_worker(worker_id)
         self.busy.discard(worker_id)

@@ -292,3 +292,27 @@ def test_logprobs_mixed_batch_only_requested_lanes():
             break
     assert len(got["with"]) == 4
     assert got["without"] == []
+
+
+def test_chain_length_respects_generation_budgets():
+    """Short-budget batches must not run full decode chains (tool-call
+    workloads: max_tokens=2 with decode_chain=32 used to burn 30 wasted
+    fused steps per chain)."""
+    core = make_core(decode_chain=32, max_model_len=256)
+    s1 = core.add_request(_req([1, 2, 3], "a", max_tokens=2))
+    s2 = core.add_request(_req([4, 5, 6], "b", max_tokens=3))
+    core.step()  # prefill: each seq now has 1 generated token
+    n = core._chain_length([s1, s2])
+    # Largest remaining budget is 2 -> chain of 2, not 32.
+    assert n == 2
+    # The manual prefill step above already emitted token 1 of each.
+    done, fin = run_to_completion(core, [s1, s2])
+    assert len(done["a"]) == 1 and len(done["b"]) == 2
+    assert fin["a"] == fin["b"] == "length"
+
+
+def test_chain_length_unbounded_budget_keeps_full_chain():
+    core = make_core(decode_chain=8, max_model_len=256)
+    s = core.add_request(_req([1, 2, 3], "a", max_tokens=200, ignore_eos=True))
+    core.step()
+    assert core._chain_length([s]) == 8

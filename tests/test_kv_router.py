@@ -236,3 +236,139 @@ async def test_two_router_replica_sync_converges():
 
                     await ra.stop()
                     await rb.stop()
+
+
+def test_processed_endpoints_snapshot():
+    """MetricsAggregator aggregates the fleet's ForwardPassMetrics into a
+    ProcessedEndpoints view (reference metrics_aggregator.rs +
+    scoring.rs:93)."""
+    from dynamo_tpu.llm.kv_router.protocols import (
+        ForwardPassMetrics,
+        KvStats,
+        WorkerStats,
+    )
+    from dynamo_tpu.llm.kv_router.publisher import MetricsAggregator
+
+    agg = MetricsAggregator.__new__(MetricsAggregator)
+    agg.latest = {}
+    agg.latest[1] = ForwardPassMetrics(
+        worker=WorkerStats(request_active_slots=2, request_total_slots=8,
+                           num_requests_waiting=1),
+        kv=KvStats(kv_active_blocks=90, kv_total_blocks=100,
+                   gpu_cache_usage_perc=0.9),
+        worker_id=1,
+    )
+    agg.latest[2] = ForwardPassMetrics(
+        worker=WorkerStats(request_active_slots=1, request_total_slots=8,
+                           num_requests_waiting=0),
+        kv=KvStats(kv_active_blocks=10, kv_total_blocks=100,
+                   gpu_cache_usage_perc=0.1),
+        worker_id=2,
+    )
+    snap = agg.snapshot()
+    assert snap.worker_ids == [1, 2]
+    assert snap.avg_kv_usage == pytest.approx(0.5)
+    assert snap.max_kv_usage == pytest.approx(0.9)
+    assert snap.total_slots == 16 and snap.active_slots == 3
+    assert snap.requests_waiting == 1
+    # Busy policy lives in WorkerMonitor (single implementation).
+    from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+    mon = WorkerMonitor.__new__(WorkerMonitor)
+    mon.aggregator = agg
+    mon.busy_threshold = 0.85
+    mon.busy = set()
+    mon.on_busy_change = lambda w, b: None
+    for m in agg.latest.values():
+        mon._on_metrics(m)
+    assert mon.busy == {1}
+    assert mon.eligible([1, 2]) == [2]
+    mon.remove_worker(1)
+    assert mon.busy == set()
+
+
+@pytest.mark.integration
+async def test_busy_worker_excluded_from_routing():
+    """Busy-aware routing: a worker above busy_threshold KV usage loses
+    traffic while an alternative exists; all-busy falls back to the full
+    set (reference worker_monitor busy marking)."""
+    import dataclasses
+
+    from dynamo_tpu.llm.kv_router.protocols import (
+        ForwardPassMetrics,
+        KvStats,
+        RouterConfig,
+        WorkerStats,
+    )
+    from dynamo_tpu.llm.kv_router.publisher import MetricsAggregator
+    from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
+
+    def fpm(usage):
+        return ForwardPassMetrics(
+            worker=WorkerStats(0, 8, 0), kv=KvStats(0, 100, usage)
+        )
+
+    class FakeClient:
+        def __init__(self):
+            self.on_instance_removed = []
+            self.sent = []
+
+        def instance_ids(self):
+            return [1, 2]
+
+        async def direct(self, worker_id, payload, headers=None):
+            self.sent.append(worker_id)
+
+            async def stream():
+                yield {"token_ids": [1], "finish_reason": "stop"}
+
+            return stream()
+
+    cfg = RouterConfig(use_kv_events=False, busy_threshold=0.9, block_size=32)
+    router = KvRouter.__new__(KvRouter)
+    from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer
+    from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector
+    from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
+
+    router.config = cfg
+    router.active = ActiveSequences(block_size=32)
+    router.selector = DefaultWorkerSelector()
+    router.indexer = ApproxKvIndexer()
+    router.sync = None
+
+    from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+    mon = WorkerMonitor.__new__(WorkerMonitor)
+    mon.aggregator = MetricsAggregator.__new__(MetricsAggregator)
+    mon.aggregator.latest = {}
+    mon.busy_threshold = 0.9
+    mon.busy = set()
+    mon.on_busy_change = lambda w, b: None
+    for w, usage in ((1, 0.95), (2, 0.2)):
+        m = fpm(usage)
+        m.worker_id = w
+        mon.aggregator.latest[w] = m
+        mon._on_metrics(m)
+    client = FakeClient()
+    push = KvPushRouter(client, router, monitor=mon)
+
+    async def one(rid):
+        async for _ in push.generate({"token_ids": [5] * 40}, rid, [5] * 40):
+            pass
+
+    for i in range(4):
+        await one(f"r{i}")
+    assert set(client.sent) == {2}, "busy worker 1 still got traffic"
+
+    # All busy -> full set again: every request still routes (the
+    # fallback must not raise or starve).
+    m2 = fpm(0.99)
+    m2.worker_id = 2
+    mon.aggregator.latest[2] = m2
+    mon._on_metrics(m2)
+    assert mon.busy == {1, 2}
+    client.sent.clear()
+    for i in range(6):
+        await one(f"s{i}")
+    assert len(client.sent) == 6
+    assert set(client.sent) <= {1, 2}

@@ -108,3 +108,50 @@ async def test_sinusoidal_dryrun_scales_up_and_down():
     trough_idx = int(np.argmin(rates))
     assert decode_counts[peak_idx] > decode_counts[trough_idx]
     assert connector.current("decode") == decode_counts[-1]
+
+
+@pytest.mark.integration
+async def test_local_process_connector_scales_real_workers():
+    """set_replicas spawns/terminates worker processes and the discovery
+    plane follows — the single-host analogue of the reference's
+    KubernetesConnector patching deployment replicas."""
+    import asyncio
+
+    from dynamo_tpu.planner.connector import LocalProcessConnector
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    async with StoreServer() as server:
+        conn = LocalProcessConnector(
+            server.address,
+            worker_argv={
+                "backend": [
+                    "-m", "dynamo_tpu.backends.mocker",
+                    "--model-name", "scaletest", "--speedup-ratio", "100",
+                ]
+            },
+        )
+        rt = await DistributedRuntime.create(server.address)
+        client = await (
+            rt.namespace("dynamo").component("backend").endpoint("generate").client()
+        )
+        try:
+            await conn.set_replicas("backend", 2)
+            for _ in range(300):
+                if len(client.instance_ids()) == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(client.instance_ids()) == 2
+            assert conn.current("backend") == 2
+
+            await conn.set_replicas("backend", 1)
+            for _ in range(300):
+                if len(client.instance_ids()) == 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(client.instance_ids()) == 1
+            assert conn.current("backend") == 1
+        finally:
+            conn.shutdown()
+            await client.stop()
+            await rt.shutdown()

@@ -41,3 +41,48 @@ def test_ring_under_jit():
     got = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh))(q, k, v)
     want = causal_attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_engine_ring_prefill_matches_paged_waves():
+    """Long-context serving: a prompt over ring_prefill_threshold runs as
+    ONE dense sequence-parallel ring-attention pass that also fills the
+    paged cache; greedy output (prefill token + paged decode continuation)
+    must equal the plain engine's exactly. The reference has no sequence
+    parallelism at all (SURVEY.md §2.6)."""
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineCore, tiny_engine, tiny_model
+    from dynamo_tpu.ops.ring_attention import sequence_parallel_mesh
+    from tests.test_engine_core import _req, run_to_completion
+
+    cfg = tiny_model()
+    prompt = list(np.random.RandomState(3).randint(1, 300, size=100))
+
+    base = EngineCore(cfg, tiny_engine(), seed=0)
+    sb = base.add_request(_req(prompt, "ref", max_tokens=8))
+    ref, _ = run_to_completion(base, [sb])
+
+    mesh = sequence_parallel_mesh(8)
+    core = EngineCore(
+        cfg,
+        tiny_engine(ring_prefill_threshold=64),
+        seed=0,
+        sp_mesh=mesh,
+    )
+    s = core.add_request(_req(prompt, "ring", max_tokens=8))
+    got, fin = run_to_completion(core, [s])
+    assert core._ring_prefills == 1, "ring path never ran"
+    assert got["ring"] == ref["ref"], "ring prefill diverged from paged waves"
+    assert fin["ring"] == "length"
+
+    # Short prompts stay on the paged wave path.
+    s2 = core.add_request(_req(list(range(1, 20)), "short", max_tokens=4))
+    run_to_completion(core, [s2])
+    assert core._ring_prefills == 1
+
+    # Prefix-cache reuse across the two paths: repeating the long prompt
+    # hits blocks the ring pass committed.
+    s3 = core.add_request(_req(prompt, "again", max_tokens=8))
+    d3, _ = run_to_completion(core, [s3])
+    assert s3.num_cached_tokens > 0
+    assert d3["again"] == ref["ref"]

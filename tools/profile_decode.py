@@ -21,6 +21,7 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig, llama3_1b
 from dynamo_tpu.engine.model import (
+    _dot,
     _interleave_kv,
     _logits,
     init_cache,
@@ -52,7 +53,7 @@ def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
         for l in range(cfg.num_layers):
             lp = jax.tree.map(lambda a: a[l], lp_all)
             y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            qkv = jnp.dot(y, lp["wqkv"], preferred_element_type=jnp.float32).astype(x.dtype)
+            qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
             q, k, v = split_qkv(qkv, cfg)
             T = q.shape[0]
             q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
@@ -68,12 +69,12 @@ def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
             else:
                 a = q
             a = a.reshape(T, cfg.q_size)
-            x = x + jnp.dot(a, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+            x = x + _dot(a, lp["wo"]).astype(x.dtype)
             y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            gu = jnp.dot(y, lp["wgu"], preferred_element_type=jnp.float32)
+            gu = _dot(y, lp["wgu"])
             g, u = split_gu(gu)
             act = (jax.nn.silu(g) * u).astype(x.dtype)
-            x = x + jnp.dot(act, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+            x = x + _dot(act, lp["w_down"]).astype(x.dtype)
         x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         if head:
             logits = _logits(x, params, cfg)
@@ -125,6 +126,7 @@ def main():
     ap.add_argument("--only", default=None, help="run a single variant, e.g. 'full'")
     ap.add_argument("--block-size", type=int, default=32)
     ap.add_argument("--max-model-len", type=int, default=512)
+    ap.add_argument("--int8", action="store_true", help="int8 weight-only quant")
     args = ap.parse_args()
 
     cfg = llama3_1b()
@@ -135,6 +137,10 @@ def main():
     )
     B, n_steps = args.batch, args.steps
     params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.int8:
+        from dynamo_tpu.engine.model import quantize_params
+
+        params = quantize_params(params)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(1, cfg.vocab_size, B), jnp.int32)
     positions = jnp.full((B,), args.ctx, jnp.int32)
