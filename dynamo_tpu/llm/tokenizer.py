@@ -84,7 +84,12 @@ class HFTokenizer:
 
 
 def load_tokenizer(spec: str) -> Tokenizer:
-    """``"byte"`` → ByteTokenizer; anything else is a local HF path."""
+    """``"byte"`` → ByteTokenizer; ``*.gguf`` → the checkpoint's embedded
+    tokenizer (engine/gguf.py); anything else is a local HF path."""
     if spec == "byte":
         return ByteTokenizer()
+    if spec.endswith(".gguf"):
+        from dynamo_tpu.engine.gguf import GGUFTokenizer, read_gguf
+
+        return GGUFTokenizer.from_gguf(read_gguf(spec))
     return HFTokenizer(spec)
