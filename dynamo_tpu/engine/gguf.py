@@ -34,7 +34,10 @@ _SCALARS = {
 # ggml tensor dtypes we materialize (block-quantized types are index-only).
 GGML_F32, GGML_F16 = 0, 1
 GGML_BF16 = 30
-_GGML_NUMPY = {GGML_F32: np.float32, GGML_F16: np.float16}
+_GGML_NUMPY = {
+    GGML_F32: np.float32, GGML_F16: np.float16,
+    24: np.int8, 25: np.int16, 26: np.int32, 27: np.int64, 28: np.float64,
+}
 
 GGML_TYPE_NAMES = {
     0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
@@ -77,14 +80,18 @@ class GGUFFile:
             dtype = _GGML_NUMPY[info.ggml_type]
         else:
             raise NotImplementedError(
-                f"tensor {name!r} is ggml {info.type_name}; block-quantized "
-                "payloads are not dequantized — use an HF checkpoint or the "
-                "framework's int8 path"
+                f"tensor {name!r} is block-quantized ggml {info.type_name}; "
+                "dequantization is not implemented — use an HF checkpoint or "
+                "the framework's int8 path (model.quantize_params)"
             )
         count = int(np.prod(info.shape)) if info.shape else 1
-        with open(self.path, "rb") as f:
-            f.seek(self.data_start + info.offset)
-            raw = f.read(count * np.dtype(dtype).itemsize)
+        # One lazily-created memmap serves every tensor read (a per-tensor
+        # open/seek/close cycle is needlessly slow on networked storage).
+        if getattr(self, "_mm", None) is None:
+            self._mm = np.memmap(self.path, mode="r", dtype=np.uint8)
+        start = self.data_start + info.offset
+        nbytes = count * np.dtype(dtype).itemsize
+        raw = bytes(self._mm[start : start + nbytes])
         return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
 
 
@@ -112,6 +119,11 @@ def read_gguf(path: str | Path) -> GGUFFile:
         magic, version = struct.unpack("<II", f.read(8))
         if magic != GGUF_MAGIC:
             raise ValueError(f"{path} is not a GGUF file (magic {magic:#x})")
+        if version > 0xFFFF:
+            raise ValueError(
+                f"{path} looks byte-swapped (version field {version:#x}) — "
+                "big-endian GGUF files are not supported"
+            )
         if version < 2:
             raise ValueError(f"GGUF v{version} not supported (need >= 2)")
         n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
@@ -181,12 +193,23 @@ class GGUFTokenizer:
     tokens: list[str]
     bos_id: int | None = None
     eos_id: int | None = None
+    unk_id: int | None = None
     _index: dict[str, int] = field(default_factory=dict)
     _max_token_len: int = 1
 
     @classmethod
     def from_gguf(cls, g: GGUFFile) -> "GGUFTokenizer":
         md = g.metadata
+        model = md.get("tokenizer.ggml.model", "llama")
+        if model not in ("llama", "spm"):
+            # BPE-style vocabularies use different space markers (\u0120)
+            # and no <0xXX> byte fallback — decoding them with
+            # SentencePiece conventions would be silently wrong.
+            raise NotImplementedError(
+                f"GGUF tokenizer model {model!r} is not supported "
+                "(SentencePiece-style 'llama' only); point the model card "
+                "at an HF tokenizer instead"
+            )
         tokens = md.get("tokenizer.ggml.tokens")
         if not tokens:
             raise ValueError("GGUF file carries no tokenizer.ggml.tokens")
@@ -194,6 +217,7 @@ class GGUFTokenizer:
             tokens=list(tokens),
             bos_id=md.get("tokenizer.ggml.bos_token_id"),
             eos_id=md.get("tokenizer.ggml.eos_token_id"),
+            unk_id=md.get("tokenizer.ggml.unknown_token_id"),
             _index={t: i for i, t in enumerate(tokens)},
             _max_token_len=max((len(t) for t in tokens), default=1),
         )
@@ -249,11 +273,20 @@ class GGUFTokenizer:
                     break
             else:
                 # Unknown character: SentencePiece byte fallback — one
-                # <0xXX> token per UTF-8 byte.
+                # <0xXX> token per UTF-8 byte; a vocab without the byte
+                # token falls back to unk rather than silently dropping
+                # the character.
                 for byte in text[i].encode("utf-8"):
                     byte_tok = self._index.get(f"<0x{byte:02X}>")
                     if byte_tok is not None:
                         ids.append(byte_tok)
+                    elif self.unk_id is not None:
+                        ids.append(self.unk_id)
+                    else:
+                        raise ValueError(
+                            f"character {text[i]!r} is not encodable: the "
+                            "vocabulary has no byte-fallback or unk token"
+                        )
                 i += 1
         return ids
 

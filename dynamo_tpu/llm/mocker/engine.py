@@ -97,6 +97,21 @@ class MockTpuEngine:
 
     async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
         """Handler-compatible: wire dict in, wire dicts out."""
+        if request.get("embed"):
+            # Deterministic synthetic embedding (seeded by content) so
+            # /v1/embeddings works against mocker fleets in tests, like
+            # every other surface (reference mocker philosophy).
+            import numpy as _np
+
+            token_ids = list(request["token_ids"])
+            rng = _np.random.RandomState(abs(hash(tuple(token_ids))) % (2**31))
+            vec = rng.randn(64).astype(float)
+            yield {
+                "embedding": [float(x) for x in vec],
+                "prompt_tokens": len(token_ids),
+                "finish_reason": "stop",
+            }
+            return
         pre = PreprocessedRequest.from_wire(request)
         max_tokens = pre.stop.max_tokens or 16
         seq = _Seq(

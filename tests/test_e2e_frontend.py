@@ -203,3 +203,17 @@ async def test_metrics_endpoint_exposes_frontend_series():
                 text = await resp.text()
                 assert "dynamo_frontend_requests_total" in text
                 assert "dynamo_frontend_time_to_first_token_seconds" in text
+
+
+async def test_embeddings_against_mocker_fleet():
+    """/v1/embeddings works on mocker fleets too (deterministic synthetic
+    vectors), keeping the full OpenAI surface exercisable without TPUs."""
+    async with Cluster(num_workers=1) as c:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock", "input": "embed this"}
+            async with s.post(f"{c.base_url}/v1/embeddings", json=body) as r:
+                assert r.status == 200, await r.text()
+                one = (await r.json())["data"][0]["embedding"]
+            async with s.post(f"{c.base_url}/v1/embeddings", json=body) as r:
+                two = (await r.json())["data"][0]["embedding"]
+            assert one == two and len(one) == 64
