@@ -1157,6 +1157,13 @@ class EngineCore:
         )
         return np.asarray(pooled)
 
+    def clear_kv_cache(self) -> int:
+        """Drop every unpinned cached block (admin surface — reference
+        clear_kv_blocks.rs). In-flight sequences keep their pinned
+        blocks; returns blocks cleared."""
+        with self._step_lock:
+            return len(self.allocator.clear_cache())
+
     # -- observability -----------------------------------------------------
 
     def metrics(self) -> ForwardPassMetrics:

@@ -35,6 +35,10 @@ class TpuEngine:
         self._loop_task: asyncio.Task | None = None
 
     async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
+        if request.get("clear_kv_blocks"):
+            cleared = await asyncio.to_thread(self.core.clear_kv_cache)
+            yield {"cleared_blocks": cleared, "finish_reason": "stop"}
+            return
         if request.get("embed"):
             # Embedding request: one forward, no scheduling (reference
             # serves /v1/embeddings through its engines the same way).

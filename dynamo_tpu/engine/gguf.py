@@ -94,6 +94,15 @@ class GGUFFile:
         raw = bytes(self._mm[start : start + nbytes])
         return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
 
+    def close(self) -> None:
+        """Release the checkpoint mapping (call once all tensors are on
+        device — a multi-GB file should not stay mapped for the object's
+        lifetime)."""
+        mm = getattr(self, "_mm", None)
+        if mm is not None:
+            del self._mm
+        self._mm = None
+
 
 def _read_string(f: BinaryIO) -> str:
     (n,) = struct.unpack("<Q", f.read(8))
@@ -273,20 +282,23 @@ class GGUFTokenizer:
                     break
             else:
                 # Unknown character: SentencePiece byte fallback — one
-                # <0xXX> token per UTF-8 byte; a vocab without the byte
-                # token falls back to unk rather than silently dropping
-                # the character.
-                for byte in text[i].encode("utf-8"):
-                    byte_tok = self._index.get(f"<0x{byte:02X}>")
-                    if byte_tok is not None:
-                        ids.append(byte_tok)
-                    elif self.unk_id is not None:
-                        ids.append(self.unk_id)
-                    else:
-                        raise ValueError(
-                            f"character {text[i]!r} is not encodable: the "
-                            "vocabulary has no byte-fallback or unk token"
-                        )
+                # <0xXX> token per UTF-8 byte, all-or-nothing. A vocab
+                # missing any needed byte token emits ONE unk for the
+                # whole character (SentencePiece unknown-piece semantics),
+                # or raises if there is no unk either.
+                byte_toks = [
+                    self._index.get(f"<0x{b:02X}>")
+                    for b in text[i].encode("utf-8")
+                ]
+                if all(t is not None for t in byte_toks):
+                    ids.extend(byte_toks)  # type: ignore[arg-type]
+                elif self.unk_id is not None:
+                    ids.append(self.unk_id)
+                else:
+                    raise ValueError(
+                        f"character {text[i]!r} is not encodable: the "
+                        "vocabulary has no byte-fallback or unk token"
+                    )
                 i += 1
         return ids
 

@@ -68,6 +68,7 @@ class HttpService:
         self.app.router.add_post("/v1/embeddings", self.embeddings)
         self.app.router.add_post("/v1/responses", self.responses)
         self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.prometheus)
@@ -194,6 +195,32 @@ class HttpService:
         return await self._handle_llm_request(
             request, CompletionRequest, "cmpl", "completions", make_stream, aggregate
         )
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin: drop unpinned KV cache blocks on every worker of every
+        served model (reference http/service/clear_kv_blocks.rs). Workers
+        fan out concurrently; a worker that errors OR answers without a
+        count reports -1, so the response always covers the full fleet."""
+
+        async def clear_one(served, wid: int) -> int:
+            try:
+                stream = await served.client.direct(wid, {"clear_kv_blocks": True})
+                async for out in stream:
+                    if "cleared_blocks" in out:
+                        return int(out["cleared_blocks"])
+                return -1  # stream ended without a count: engine too old?
+            except Exception:  # noqa: BLE001 — report the rest anyway
+                log.exception("clear_kv_blocks failed for worker %d", wid)
+                return -1
+
+        results: dict[str, dict[str, int]] = {}
+        for served in self.manager.list_models():
+            wids = served.client.instance_ids()
+            counts = await asyncio.gather(*(clear_one(served, w) for w in wids))
+            results[served.entry.name] = {
+                str(w): c for w, c in zip(wids, counts)
+            }
+        return web.json_response({"cleared": results})
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: tokenize, one engine forward per input,

@@ -97,6 +97,12 @@ class MockTpuEngine:
 
     async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
         """Handler-compatible: wire dict in, wire dicts out."""
+        if request.get("clear_kv_blocks"):
+            # Admin clear: unpinned cache only; the kv manager's
+            # on_removed callback carries the router events.
+            cleared = self.kv.clear_unpinned()
+            yield {"cleared_blocks": len(cleared), "finish_reason": "stop"}
+            return
         if request.get("embed"):
             # Deterministic synthetic embedding (seeded by content) so
             # /v1/embeddings works against mocker fleets in tests, like

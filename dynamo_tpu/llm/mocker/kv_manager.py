@@ -164,6 +164,18 @@ class MockKvManager:
                     self.stats.removed_events += 1
                     self.on_removed([h])
 
+    def clear_unpinned(self) -> list[int]:
+        """Drop only the inactive (unpinned) cache — in-flight sequences
+        keep their blocks; emits `removed` for the router. The admin
+        clear_kv_blocks semantics (same contract as the real engine's
+        allocator.clear_cache)."""
+        hashes = list(self._inactive)
+        self._inactive.clear()
+        if hashes:
+            self.stats.removed_events += len(hashes)
+            self.on_removed(hashes)
+        return hashes
+
     def clear(self) -> list[int]:
         """Drop the whole cache (reset); returns hashes that were cached."""
         hashes = list(self._active) + list(self._inactive)

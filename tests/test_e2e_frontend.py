@@ -217,3 +217,25 @@ async def test_embeddings_against_mocker_fleet():
             async with s.post(f"{c.base_url}/v1/embeddings", json=body) as r:
                 two = (await r.json())["data"][0]["embedding"]
             assert one == two and len(one) == 64
+
+
+async def test_clear_kv_blocks_against_mocker_fleet():
+    """The admin clear endpoint must work on mocker fleets too (in-flight
+    sequences keep their pinned blocks; only the unpinned cache drops)."""
+    async with Cluster(num_workers=2) as c:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "mock",
+                "messages": [{"role": "user", "content": "warm the cache " * 8}],
+                "max_tokens": 4,
+                "temperature": 0.0,
+            }
+            async with s.post(f"{c.base_url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+            async with s.post(f"{c.base_url}/clear_kv_blocks") as r:
+                assert r.status == 200
+                out = await r.json()
+            workers = out["cleared"]["mock"]
+            assert len(workers) == 2
+            assert all(n >= 0 for n in workers.values())
+            assert sum(workers.values()) > 0

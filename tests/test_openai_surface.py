@@ -188,3 +188,34 @@ async def test_logprobs_over_http():
             lp = out["choices"][0]["logprobs"]
             assert len(lp["tokens"]) == 4
             assert len(lp["top_logprobs"][0]) == 2
+
+
+async def test_clear_kv_blocks_endpoint():
+    """Admin endpoint drops cached blocks fleet-wide: a repeated prompt
+    that WOULD have hit the prefix cache re-prefills from scratch
+    (reference http/service/clear_kv_blocks.rs)."""
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            prompt = "cache me if you can " * 4
+            body = {
+                "model": "tinyjax",
+                "messages": [{"role": "user", "content": prompt}],
+                "max_tokens": 4,
+                "temperature": 0.0,
+            }
+            async with s.post(f"{c.base_url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+
+            async with s.post(f"{c.base_url}/clear_kv_blocks") as r:
+                assert r.status == 200
+                out = await r.json()
+            workers = out["cleared"]["tinyjax"]
+            assert workers and all(n >= 0 for n in workers.values())
+            assert sum(workers.values()) > 0, "nothing was cached/cleared"
+
+            async with s.post(f"{c.base_url}/v1/chat/completions", json=body) as r:
+                redo = await r.json()
+            cached = (
+                redo["usage"].get("prompt_tokens_details") or {}
+            ).get("cached_tokens", 0)
+            assert cached == 0, "cache survived clear_kv_blocks"
