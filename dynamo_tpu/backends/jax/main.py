@@ -251,13 +251,21 @@ async def run_jax_worker(
                     last = {"error": "prefill produced no output"}
                 if last.get("kv_transfer_params"):
                     last["kv_transfer_params"]["worker_id"] = worker_id
-                await runtime.store.kv_put(task["reply_key"], json.dumps(last).encode())
+                # Short-TTL non-keepalive lease: if the decode side timed
+                # out and already kv_del'd (or never reads), the reply key
+                # expires instead of living in the store forever.
+                lease = await runtime.store.lease_grant(ttl=60.0, keepalive=False)
+                await runtime.store.kv_put(
+                    task["reply_key"], json.dumps(last).encode(), lease=lease
+                )
             except Exception:
                 log.exception("queued prefill failed")
                 try:
+                    lease = await runtime.store.lease_grant(ttl=60.0, keepalive=False)
                     await runtime.store.kv_put(
                         task["reply_key"],
                         json.dumps({"error": "remote prefill failed"}).encode(),
+                        lease=lease,
                     )
                 except Exception:  # noqa: BLE001 — store down; caller times out
                     pass
@@ -312,8 +320,10 @@ async def run_jax_worker(
         qname = _prefill_queue(namespace)
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
-            if request.get("embed"):
-                # Embeddings never disaggregate: run locally.
+            if request.get("embed") or request.get("clear_kv_blocks"):
+                # Embeddings and admin clears never disaggregate: run
+                # locally (a clear falling into from_wire would KeyError
+                # and report -1 for every decode worker).
                 async for out in engine.generate(request, context):
                     yield out
                 return

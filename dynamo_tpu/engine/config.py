@@ -132,6 +132,11 @@ class EngineConfig:
     # the engine's sp mesh instead of chunked paged waves. 0 = off.
     ring_prefill_threshold: int = 0
 
+    # Disaggregation: a remote-decode prefill's held blocks are released
+    # if no decode worker pulls them within this window (a decode-side
+    # timeout would otherwise pin them forever). 0 = never expire.
+    held_block_ttl_s: float = 180.0
+
     @property
     def max_blocks_per_seq(self) -> int:
         return (self.max_model_len + self.block_size - 1) // self.block_size

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -339,6 +340,10 @@ class EngineCore:
         self._step_lock = threading.Lock()
         self._embed_lock = threading.Lock()
         self._held: dict[str, Sequence] = {}
+        # Hold deadlines (monotonic): a decode-side timeout must not pin
+        # prefill blocks forever. Touched by the transfer endpoints, swept
+        # at the top of each step (before admission needs the blocks).
+        self._held_deadline: dict[str, float] = {}
 
         self._prefill = jax.jit(
             partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
@@ -797,6 +802,7 @@ class EngineCore:
     def _step_locked(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         outputs: list[tuple[Sequence, LLMEngineOutput]] = []
         self.iterations += 1
+        self._sweep_expired_holds()
 
         for seq in [s for s in self.running if s.cancelled]:
             self.running.remove(seq)
@@ -993,8 +999,29 @@ class EngineCore:
             self.running.remove(seq)
         if seq.hold_blocks:
             self._held[seq.request_id] = seq
+            if self.engine.held_block_ttl_s > 0:
+                self._held_deadline[seq.request_id] = (
+                    time.monotonic() + self.engine.held_block_ttl_s
+                )
         else:
             self._release_blocks(seq)
+
+    def _sweep_expired_holds(self) -> None:
+        """Release held prefills whose decode side never came (timeout,
+        crash): without this, abandoned holds pin device blocks until the
+        allocator starves (advisor r4)."""
+        if not self._held_deadline:
+            return
+        now = time.monotonic()
+        for rid in [r for r, d in self._held_deadline.items() if d < now]:
+            self._held_deadline.pop(rid, None)
+            seq = self._held.pop(rid, None)
+            if seq is not None:
+                log.warning(
+                    "releasing expired held blocks for %s (ttl %.0fs)",
+                    rid, self.engine.held_block_ttl_s,
+                )
+                self._release_blocks(seq)
 
     # -- disaggregated KV transfer (export on prefill, import on decode) ---
     #
@@ -1016,6 +1043,7 @@ class EngineCore:
             seq = self._held.get(request_id)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
+            self._touch_hold(request_id)
             shape = [
                 self.cfg.num_layers,
                 self.engine.block_size,
@@ -1047,6 +1075,7 @@ class EngineCore:
             seq = self._held.get(request_id)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
+            self._touch_hold(request_id)
             ids = seq.block_ids[start : start + count]
             if not ids:
                 return []
@@ -1060,8 +1089,17 @@ class EngineCore:
         with self._step_lock:
             return self.allocator.match_prefix(hashes) * self.engine.block_size
 
+    def _touch_hold(self, request_id: str) -> None:
+        """Refresh a hold's expiry — an in-flight transfer must not lose
+        its blocks between chunks."""
+        if self.engine.held_block_ttl_s > 0 and request_id in self._held_deadline:
+            self._held_deadline[request_id] = (
+                time.monotonic() + self.engine.held_block_ttl_s
+            )
+
     def release_held(self, request_id: str) -> None:
         with self._step_lock:
+            self._held_deadline.pop(request_id, None)
             seq = self._held.pop(request_id, None)
             if seq is not None:
                 self._release_blocks(seq)

@@ -200,11 +200,16 @@ class HttpService:
         """Admin: drop unpinned KV cache blocks on every worker of every
         served model (reference http/service/clear_kv_blocks.rs). Workers
         fan out concurrently; a worker that errors OR answers without a
-        count reports -1, so the response always covers the full fleet."""
+        count reports -1, so the response always covers the full fleet.
 
-        async def clear_one(served, wid: int) -> int:
+        Disaggregated deployments: prefill workers never register a served
+        model, so they are reached through their component ("prefill" by
+        convention) in each served namespace and reported under a
+        ``prefill:{namespace}`` key."""
+
+        async def clear_one(client, wid: int) -> int:
             try:
-                stream = await served.client.direct(wid, {"clear_kv_blocks": True})
+                stream = await client.direct(wid, {"clear_kv_blocks": True})
                 async for out in stream:
                     if "cleared_blocks" in out:
                         return int(out["cleared_blocks"])
@@ -214,12 +219,54 @@ class HttpService:
                 return -1
 
         results: dict[str, dict[str, int]] = {}
+        namespaces: set[str] = set()
         for served in self.manager.list_models():
+            namespaces.add(served.entry.namespace)
             wids = served.client.instance_ids()
-            counts = await asyncio.gather(*(clear_one(served, w) for w in wids))
+            counts = await asyncio.gather(
+                *(clear_one(served.client, w) for w in wids)
+            )
             results[served.entry.name] = {
                 str(w): c for w, c in zip(wids, counts)
             }
+        async def clear_prefill_ns(ns: str) -> tuple[str, dict | None]:
+            client = None
+            try:
+                client = await (
+                    self.manager.runtime.namespace(ns)
+                    .component("prefill")
+                    .endpoint("generate")
+                    .client()
+                )
+                # The instance watch populates asynchronously; give the
+                # initial events a moment (aggregated deployments simply
+                # time out with no prefill fleet).
+                try:
+                    await client.wait_for_instances(1, timeout=1.0)
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+                wids = client.instance_ids()
+                if not wids:
+                    return ns, None  # aggregated deploy: no prefill fleet
+                counts = await asyncio.gather(
+                    *(clear_one(client, w) for w in wids)
+                )
+                return ns, {str(w): c for w, c in zip(wids, counts)}
+            except Exception:  # noqa: BLE001 — must stay visible, not a 200
+                log.exception("prefill clear sweep failed in namespace %r", ns)
+                return ns, {"error": -1}
+            finally:
+                if client is not None:
+                    try:
+                        await client.stop()
+                    except Exception:  # noqa: BLE001 — keep partial results
+                        log.warning("prefill clear client teardown failed")
+
+        for ns, counts in await asyncio.gather(
+            *(clear_prefill_ns(ns) for ns in sorted(namespaces))
+        ):
+            if counts is not None:
+                results[f"prefill:{ns}"] = counts
         return web.json_response({"cleared": results})
 
     async def embeddings(self, request: web.Request) -> web.Response:

@@ -191,11 +191,17 @@ class KvIndexer:
         assert self._sub is not None
         async for ev in self._sub:
             try:
-                event = RouterEvent.from_wire(ev["p"])
-                self.known_workers.add(event.worker_id)
-                self.tree.apply_event(event)
+                self.apply(RouterEvent.from_wire(ev["p"]))
             except Exception:  # noqa: BLE001 — one bad event must not kill routing
                 log.exception("bad kv event")
+
+    def apply(self, event: RouterEvent) -> None:
+        """The single way a RouterEvent enters this indexer — live stream
+        and replica bootstrap both come through here, so the worker is
+        always recorded (bootstrap-only radix state must still be served
+        to the next late joiner)."""
+        self.known_workers.add(event.worker_id)
+        self.tree.apply_event(event)
 
     def find_matches(self, seq_hashes: list[int]) -> dict[int, int]:
         return self.tree.find_matches(seq_hashes)

@@ -179,10 +179,8 @@ class ReplicaSync:
             except (asyncio.TimeoutError, TimeoutError):
                 return  # first replica up: nothing to inherit
             snap = msgpack.unpackb(msg["p"], raw=False)
-            tree = self.router.indexer_tree()
-            if tree is not None:
-                for raw in snap.get("radix", []):
-                    tree.apply_event(RouterEvent.from_wire(raw))
+            for raw in snap.get("radix", []):
+                self.router.apply_radix_event(RouterEvent.from_wire(raw))
             for e in snap.get("active", []):
                 self.router.active.add_raw(e["rid"], e["w"], e["pf"], e["db"])
             log.info(

@@ -78,6 +78,12 @@ class KvRouter:
             else set()
         )
 
+    def apply_radix_event(self, event) -> None:
+        """Feed a bootstrap radix event through the indexer's one apply
+        path (KvIndexer.apply); a no-op for the approx indexer."""
+        if isinstance(self.indexer, KvIndexer):
+            self.indexer.apply(event)
+
     def find_best_match(
         self,
         request_id: str,

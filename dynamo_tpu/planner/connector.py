@@ -37,8 +37,12 @@ class LocalProcessConnector:
         self.worker_argv = {k: list(v) for k, v in worker_argv.items()}
         self.env = env or {}
         self._procs: dict[str, list[subprocess.Popen]] = {}
+        # Scaled-down children pending exit: poll()ed on every reap so they
+        # never linger as POSIX zombies for the planner's lifetime.
+        self._terminated: list[subprocess.Popen] = []
 
     def _reap(self, component: str) -> list[subprocess.Popen]:
+        self._terminated = [p for p in self._terminated if p.poll() is None]
         procs = self._procs.setdefault(component, [])
         live = [p for p in procs if p.poll() is None]
         dead = len(procs) - len(live)
@@ -69,6 +73,7 @@ class LocalProcessConnector:
         while len(procs) > replicas:
             p = procs.pop()
             p.terminate()
+            self._terminated.append(p)
             log.info("scaled down %s -> %d (pid %d)", component, len(procs), p.pid)
 
     def shutdown(self) -> None:
@@ -76,10 +81,11 @@ class LocalProcessConnector:
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
-        for procs in self._procs.values():
-            for p in procs:
-                try:
-                    p.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    p.kill()
+        for p in [p for procs in self._procs.values() for p in procs] + self._terminated:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
         self._procs.clear()
+        self._terminated.clear()

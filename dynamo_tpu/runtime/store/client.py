@@ -86,6 +86,9 @@ class StoreClient:
         self._sub_meta: dict[int, tuple[str, dict]] = {}   # sub_id -> (op, params)
         self._lease_meta: dict[int, tuple[float, bool]] = {}  # id -> (ttl, keepalive)
         self._leased_kv: dict[str, tuple[bytes, int]] = {}    # key -> (value, lease)
+        # One-shot leases, never replayed; id -> local expiry (pruned on
+        # each grant so the map stays bounded).
+        self._ephemeral_leases: dict[int, float] = {}
         self.on_reconnect: list = []  # async callbacks, fired after replay
         self._reconnect_task: asyncio.Task | None = None
 
@@ -219,7 +222,14 @@ class StoreClient:
                         self._keepalive_loop(lease_id, ttl)
                     )
             for key, (value, lease) in list(self._leased_kv.items()):
-                await self._request("kv_put", k=key, v=value, lease=lease)
+                try:
+                    await self._request("kv_put", k=key, v=value, lease=lease)
+                except StoreError:
+                    # The lease no longer exists (e.g. an expired ephemeral
+                    # lease recorded before its id was pruned): drop the
+                    # entry instead of refailing the whole rebuild forever.
+                    log.warning("dropping leased key %r (lease %d gone)", key, lease)
+                    self._leased_kv.pop(key, None)
             log.info(
                 "store session rebuilt (%d leases, %d registrations, %d subs)",
                 len(self._lease_meta), len(self._leased_kv), len(self._sub_meta),
@@ -254,7 +264,7 @@ class StoreClient:
         self, key: str, value: bytes, lease: int = 0, create_only: bool = False
     ) -> int:
         r = await self._request("kv_put", k=key, v=value, lease=lease, create_only=create_only)
-        if lease:
+        if lease and lease not in self._ephemeral_leases:
             # Lease-bound registrations evaporate on a store restart;
             # remember them so the reconnect replay can restore them.
             self._leased_kv[key] = (value, lease)
@@ -294,13 +304,25 @@ class StoreClient:
     # -- leases ------------------------------------------------------------
 
     async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> int:
+        """``keepalive=False`` grants an EPHEMERAL lease: it expires after
+        ``ttl`` (deleting its keys) and is deliberately NOT replayed on
+        store reconnect — the one-shot reply-key pattern, where replay
+        would resurrect a key the consumer already deleted."""
         r = await self._request("lease_grant", ttl=ttl)
         lease_id = r["lease"]
-        self._lease_meta[lease_id] = (ttl, keepalive)
         if keepalive:
+            self._lease_meta[lease_id] = (ttl, keepalive)
             self._keepalive_tasks[lease_id] = asyncio.create_task(
                 self._keepalive_loop(lease_id, ttl)
             )
+        else:
+            import time as _time
+
+            now = _time.monotonic()
+            self._ephemeral_leases = {
+                lid: exp for lid, exp in self._ephemeral_leases.items() if exp > now
+            }
+            self._ephemeral_leases[lease_id] = now + ttl
         return lease_id
 
     async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:

@@ -200,3 +200,32 @@ async def test_saturated_prefill_queue_flips_to_local():
             before = c.prefill_core.iterations
             await _chat(s, c.base_url, LONG_PROMPT + " fresh tail content", max_tokens=4)
             assert c.prefill_core.iterations > before
+
+
+async def test_clear_kv_blocks_reaches_disagg_fleet():
+    """/clear_kv_blocks must cover BOTH sides of a disaggregated
+    deployment: the decode worker's engine (not a -1 from a KeyError in
+    from_wire) and the prefill fleet, which never registers a served
+    model (advisor r4 medium; reference clear_kv_blocks.rs)."""
+    async with DisaggCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Populate caches on both sides.
+            await _chat(s, c.base_url, LONG_PROMPT, max_tokens=4)
+            assert len(c.prefill_core.allocator._by_hash) > 0
+            assert len(c.decode_core.allocator._by_hash) > 0
+
+            async with s.post(f"{c.base_url}/clear_kv_blocks") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            cleared = body["cleared"]
+            # Decode fleet: real counts, not -1.
+            decode_counts = list(cleared["tinyjax"].values())
+            assert decode_counts and all(n >= 0 for n in decode_counts)
+            assert sum(decode_counts) > 0
+            # Prefill fleet reported under its namespace key.
+            prefill_counts = list(cleared["prefill:dynamo"].values())
+            assert prefill_counts and all(n >= 0 for n in prefill_counts)
+            assert sum(prefill_counts) > 0
+            # Caches actually dropped on both engines.
+            assert len(c.prefill_core.allocator._by_hash) == 0
+            assert len(c.decode_core.allocator._by_hash) == 0

@@ -316,3 +316,32 @@ def test_chain_length_unbounded_budget_keeps_full_chain():
     s = core.add_request(_req([1, 2, 3], "a", max_tokens=200, ignore_eos=True))
     core.step()
     assert core._chain_length([s]) == 8
+
+
+def test_expired_held_blocks_are_released():
+    """A remote-decode prefill whose decode side never pulls (timeout,
+    crash) must not pin its blocks forever: the hold expires after
+    held_block_ttl_s and the next step releases it (advisor r4)."""
+    import time
+
+    core = EngineCore(CFG, tiny_engine(held_block_ttl_s=0.15), seed=0)
+    pre = _req(list(range(1, 20)), "held", max_tokens=1)
+    pre.kv_transfer_params = {"do_remote_decode": True}
+    seq = core.add_request(pre)
+    run_to_completion(core, [seq])
+    assert "held" in core._held
+    held_blocks = core.allocator.used_blocks
+    assert held_blocks > 0
+
+    # Within the TTL the hold survives steps, and a transfer touch
+    # refreshes the deadline.
+    core.step()
+    assert "held" in core._held
+    core.export_descriptors("held")
+
+    time.sleep(0.2)
+    core.step()  # sweep runs at the top of the step
+    assert "held" not in core._held
+    assert core._held_deadline == {}
+    # Blocks are back in the reusable pool (inactive cached content).
+    assert core.allocator.used_blocks == len(core.allocator._inactive)

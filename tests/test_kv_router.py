@@ -238,6 +238,53 @@ async def test_two_router_replica_sync_converges():
                     await rb.stop()
 
 
+async def test_second_generation_bootstrap_keeps_radix():
+    """A replica whose radix knowledge came ONLY from bootstrap must still
+    serve a full dump to the next late joiner: bootstrap events must feed
+    known_workers exactly like live events (advisor r4)."""
+    import asyncio
+    import dataclasses
+
+    from dynamo_tpu.llm.kv_router.protocols import RouterConfig
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+
+    cfg = RouterConfig(replica_sync=True, block_size=32)
+
+    async def wait_for(cond, n=200):
+        for _ in range(n):
+            if cond():
+                return True
+            await asyncio.sleep(0.01)
+        return cond()
+
+    async with StoreServer() as server:
+        async with await StoreClient.open(server.address) as worker_store:
+            async with await StoreClient.open(server.address) as store_a:
+                async with await StoreClient.open(server.address) as store_b:
+                    async with await StoreClient.open(server.address) as store_c:
+                        ra = KvRouter(store_a, "ns", "backend", dataclasses.replace(cfg))
+                        await ra.start()
+                        pub = KvEventPublisher(worker_store, "ns", "backend", worker_id=9)
+                        tokens = list(range(64))
+                        h = compute_seq_hashes(tokens, 32)
+                        await pub.stored(h, parent_hash=None)
+                        await wait_for(lambda: ra.indexer.find_matches(h).get(9) == 2)
+
+                        # Generation 2: learns the radix only via bootstrap.
+                        rb = KvRouter(store_b, "ns", "backend", dataclasses.replace(cfg))
+                        await rb.start()
+                        assert rb.indexer.find_matches(h).get(9) == 2
+                        assert 9 in rb.known_workers()
+                        await ra.stop()  # original replica gone
+
+                        # Generation 3: only rb can answer the bootstrap.
+                        rc = KvRouter(store_c, "ns", "backend", dataclasses.replace(cfg))
+                        await rc.start()
+                        assert rc.indexer.find_matches(h).get(9) == 2
+                        await rb.stop()
+                        await rc.stop()
+
+
 def test_processed_endpoints_snapshot():
     """MetricsAggregator aggregates the fleet's ForwardPassMetrics into a
     ProcessedEndpoints view (reference metrics_aggregator.rs +
