@@ -196,11 +196,20 @@ def run_config(cfg_model, c: Config) -> dict:
 
 def run_disagg_ab(model) -> dict:
     """Aggregated-vs-disaggregated A/B sharing the one chip: a prefill
-    core and a decode core move KV via the v2 descriptor transfer
-    (EngineCore.export_descriptors / read_held_pages / import_blocks),
-    mirroring the P/D worker flow in backends/jax/main.py. Reports TTFT
-    and 8-token completion latency for a 2048-token prompt
-    (BASELINE.md disagg A/B; reference architecture.md:75)."""
+    core and a decode core move KV via the v2 descriptor transfer,
+    mirroring the P/D worker flow in backends/jax/main.py. Reports TTFT,
+    total-latency ratio (median AND best of N reps), a per-phase
+    breakdown (prefill/export/wire/import/decode), and the device-direct
+    transfer variant (import_blocks_direct — the within-slice ICI path).
+
+    STEADY-STATE by construction: every device program in the timed
+    windows (both prefill buckets, the decode chain, the transfer
+    gather/scatter at full transfer width) is compiled and warmed with a
+    DISTINCT prompt before timing starts — jit compiles are excluded and
+    each rep uses fresh prompt content so no rep rides the prefix cache.
+    (BASELINE.md disagg A/B; reference architecture.md:75 says disagg
+    should be FASTER — parity on one shared chip is the honest target,
+    since both sides of this A/B contend for the same MXU.)"""
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.llm.protocols.common import (
@@ -210,13 +219,19 @@ def run_disagg_ab(model) -> dict:
     )
 
     ISL, OSL = 2048, 8
+    REPS = 3
+    # The small prefill bucket keeps the decode core's 1-token
+    # continuation prefill (64 cached blocks + 1 token) off the full
+    # 2048-token program.
     kw = dict(
         num_kv_blocks=768, block_size=32, max_num_seqs=8, max_model_len=4096,
-        prefill_buckets=(2048,), prefill_batch=8, decode_buckets=(8,),
+        prefill_buckets=(128, 2048), prefill_batch=8, decode_buckets=(8,),
         decode_chain=8,
     )
     rng = np.random.RandomState(0)
-    prompt = rng.randint(1, model.vocab_size, size=ISL).tolist()
+
+    def fresh_prompt():
+        return rng.randint(1, model.vocab_size, size=ISL).tolist()
 
     def req(tokens, rid, n_out, hold=False):
         return PreprocessedRequest(
@@ -237,46 +252,169 @@ def run_disagg_ab(model) -> dict:
                     toks.extend(out.token_ids)
         return toks, first_t, time.perf_counter() - t0
 
-    # Aggregated baseline.
+    # Aggregated baseline core (warm both buckets + the decode chain).
     agg = EngineCore(model, EngineConfig(**kw), seed=0)
-    warm = agg.add_request(req(prompt[:64], "w", 8))
+    warm = agg.add_request(req(fresh_prompt()[:64], "w", 8))
     run_until_done(agg, warm)
-    seq = agg.add_request(req(prompt, "agg", OSL))
-    agg_toks, agg_ttft, agg_total = run_until_done(agg, seq)
-    del agg
+    w2 = agg.add_request(req(fresh_prompt(), "w2", 8))
+    run_until_done(agg, w2)
 
-    # Disaggregated: prefill core holds blocks; decode core imports them
-    # and continues (prefix-cached, so its "prefill" is one token).
+    # Disagg cores. Warm the full transfer path on a distinct prompt:
+    # held 2048-token prefill, descriptor export, the chunked gathers and
+    # import scatters at the exact widths the timed reps replay, and the
+    # device-direct copy program.
+    CHUNK = 16
     p_core = EngineCore(model, EngineConfig(**kw), seed=0)
     d_core = EngineCore(model, EngineConfig(**kw), seed=0, params=p_core.params)
     for core in (p_core, d_core):
-        w = core.add_request(req(prompt[:64], "w", 8))
+        w = core.add_request(req(fresh_prompt()[:64], "w", 8))
         run_until_done(core, w)
+    pw = p_core.add_request(req(fresh_prompt(), "wxfer", 1, hold=True))
+    run_until_done(p_core, pw)
+    descs = p_core.export_descriptors("wxfer")
+    for s in range(0, len(descs), CHUNK):
+        pages = p_core.read_held_pages("wxfer", s, CHUNK)
+        d_core.import_blocks(
+            [dict(descs[s + j], kv=kv) for j, kv in enumerate(pages)]
+        )
+    p_core.release_held("wxfer")
+    pw2 = p_core.add_request(req(fresh_prompt(), "wdirect", 1, hold=True))
+    run_until_done(p_core, pw2)
+    d_core.import_blocks_direct(p_core, "wdirect")
+    p_core.release_held("wdirect")
 
-    t0 = time.perf_counter()
-    pseq = p_core.add_request(req(prompt, "pf", 1, hold=True))
-    tok1, ttft_d, _ = run_until_done(p_core, pseq)
-    descs = p_core.export_descriptors("pf")
-    blocks = []
-    for s in range(0, len(descs), 8):
-        pages = p_core.read_held_pages("pf", s, 8)
-        blocks.extend(dict(descs[s + j], kv=kv) for j, kv in enumerate(pages))
-    p_core.release_held("pf")
-    d_core.import_blocks(blocks)
-    dseq = d_core.add_request(req(prompt + tok1, "dec", OSL - 1))
-    d_toks, _, _ = run_until_done(d_core, dseq)
-    disagg_total = time.perf_counter() - t0
-    assert tok1 + d_toks == agg_toks, "disagg output diverged from aggregated"
-    del p_core, d_core
+    def wire_transfer(rid: str, descs: list[dict]) -> int:
+        """Pipelined host-staged transfer: a producer thread stages
+        chunks out of the prefill cache while the main thread imports
+        the previous chunk into the decode cache (the worker flow's
+        stream, backends/jax/main.py kv_transfer, runs the same
+        producer/consumer shape across the data plane). Returns bytes
+        moved one way."""
+        import queue as _queue
+        import threading as _threading
 
+        q: _queue.Queue = _queue.Queue(maxsize=2)
+        failure: list[BaseException] = []
+
+        def producer():
+            try:
+                for s in range(0, len(descs), CHUNK):
+                    q.put((s, p_core.read_held_pages(rid, s, CHUNK)))
+            except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+                failure.append(e)
+            finally:
+                q.put(None)
+
+        t = _threading.Thread(target=producer, daemon=True)
+        t.start()
+        moved = 0
+        while (item := q.get()) is not None:
+            s, pages = item
+            moved += sum(len(p) for p in pages)
+            d_core.import_blocks(
+                [dict(descs[s + j], kv=kv) for j, kv in enumerate(pages)]
+            )
+        t.join()
+        if failure:
+            # A truncated transfer must not masquerade as a fast one.
+            raise failure[0]
+        # Land the uploads now so the phase attribution is honest (the
+        # scatter's device work is otherwise lazily paid by decode).
+        import jax as _jax
+
+        _jax.block_until_ready(d_core.cache)
+        return moved
+
+    wire_ratios, direct_ratios, phase_rows = [], [], []
+    ttft_aggs, ttft_disaggs = [], []
+    wire_bytes = wire_secs = 0.0
+    for rep in range(REPS):
+        # Device-direct path FIRST (this is the primary: the within-slice
+        # ICI analogue of NIXL's device-to-device RDMA — the reference
+        # transfer never stages through host memory either).
+        prompt = fresh_prompt()
+        seq = agg.add_request(req(prompt, f"agg{rep}", OSL))
+        agg_toks, agg_ttft, agg_total = run_until_done(agg, seq)
+        ttft_aggs.append(agg_ttft)
+
+        t0 = time.perf_counter()
+        rid = f"pfd{rep}"
+        pseq = p_core.add_request(req(prompt, rid, 1, hold=True))
+        tok1, ttft_d, _ = run_until_done(p_core, pseq)
+        d_core.import_blocks_direct(p_core, rid)
+        p_core.release_held(rid)
+        dseq = d_core.add_request(req(prompt + tok1, f"decd{rep}", OSL - 1))
+        d_toks, _, _ = run_until_done(d_core, dseq)
+        direct_total = time.perf_counter() - t0
+        assert tok1 + d_toks == agg_toks, "disagg output diverged from aggregated"
+        direct_ratios.append(direct_total / agg_total)
+        ttft_disaggs.append(ttft_d)
+
+        # Host-staged wire path (the cross-host DCN flow; fresh prompt so
+        # it cannot ride the direct rep's cache).
+        prompt2 = fresh_prompt()
+        seq = agg.add_request(req(prompt2, f"agg2{rep}", OSL))
+        agg_toks2, _, agg_total2 = run_until_done(agg, seq)
+        t0 = time.perf_counter()
+        rid = f"pf{rep}"
+        pseq = p_core.add_request(req(prompt2, rid, 1, hold=True))
+        tok1, _, _ = run_until_done(p_core, pseq)
+        t1 = time.perf_counter()
+        descs = p_core.export_descriptors(rid)
+        t2 = time.perf_counter()
+        moved = wire_transfer(rid, descs)
+        p_core.release_held(rid)
+        t3 = time.perf_counter()
+        dseq = d_core.add_request(req(prompt2 + tok1, f"dec{rep}", OSL - 1))
+        d_toks, _, _ = run_until_done(d_core, dseq)
+        t4 = time.perf_counter()
+        assert tok1 + d_toks == agg_toks2, "wire disagg diverged from aggregated"
+        wire_ratios.append((t4 - t0) / agg_total2)
+        wire_bytes += moved
+        wire_secs += t3 - t2
+        phase_rows.append({
+            "prefill": t1 - t0, "export": t2 - t1, "transfer": t3 - t2,
+            "decode": t4 - t3,
+        })
+
+    assert d_core.transfer_stats["dropped_blocks"] == 0, (
+        "transfer dropped blocks: %s" % d_core.transfer_stats
+    )
+    del p_core, d_core, agg
+
+    wire_ratios.sort()
+    direct_ratios.sort()
+    med = direct_ratios[len(direct_ratios) // 2]
+    med_phases = {
+        k: round(
+            sorted(r[k] for r in phase_rows)[len(phase_rows) // 2] * 1e3, 1
+        )
+        for k in phase_rows[0]
+    }
+    ttft_agg = sorted(ttft_aggs)[len(ttft_aggs) // 2]
+    ttft_d = sorted(ttft_disaggs)[len(ttft_disaggs) // 2]
     return {
         "metric": f"{model.name} disagg-vs-agg total latency ratio ({ISL}/{OSL})",
-        "value": round(disagg_total / agg_total, 3),
-        "unit": "x (1.0 = parity)",
-        "vs_baseline": round(agg_total / disagg_total, 4),
-        "ttft_agg_ms": round(agg_ttft * 1e3, 1),
+        "value": round(med, 3),
+        "unit": "x (1.0 = parity; median of %d steady-state reps, "
+                "device-direct transfer)" % REPS,
+        "vs_baseline": round(1.0 / med, 4),
+        "direct_ratio_best": round(direct_ratios[0], 3),
+        "wire_ratio_median": round(wire_ratios[len(wire_ratios) // 2], 3),
+        "wire_phases_ms": med_phases,
+        "wire_mb_per_s": round(wire_bytes / max(wire_secs, 1e-9) / 1e6, 1),
+        "ttft_agg_ms": round(ttft_agg * 1e3, 1),
         "ttft_disagg_ms": round(ttft_d * 1e3, 1),
-        "ttft_ratio": round(ttft_d / agg_ttft, 3),
+        "ttft_ratio": round(ttft_d / ttft_agg, 3),
+        "note": (
+            "steady-state: prefill/decode/transfer programs warmed on "
+            "distinct prompts before timing (compiles excluded). Primary = "
+            "device-direct (one-program cache-to-cache copy; the NIXL "
+            "device-to-device analogue for co-located P/D). wire_* = the "
+            "host-staged DCN path, pipelined producer/consumer; through "
+            "this harness's relay tunnel host<->device moves at "
+            "wire_mb_per_s, which bounds it far below any real deployment"
+        ),
     }
 
 

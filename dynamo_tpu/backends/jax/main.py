@@ -59,6 +59,7 @@ def build_engine(
     on_removed=None,
     tp: int = 1,
     dp: int = 1,
+    sp: int = 1,
     quant: str | None = None,
 ):
     """Construct (EngineCore, TpuEngine) for a model preset.
@@ -69,6 +70,12 @@ def build_engine(
     ``tp``/``dp`` > 1 build a device mesh and shard the engine in-process
     (TP over ICI; the reference's tp plumbing is vllm/args.py:239-258 —
     here the partitioning is first-party, SURVEY.md §2.6).
+
+    ``sp`` > 1 builds a sequence-parallel mesh instead: long prompts (at
+    or past ``ring_prefill_threshold``) prefill as one dense
+    ring-attention pass over the sp axis (long-context serving — the
+    reference has no equivalent, SURVEY.md §5). Mutually exclusive with
+    tp/dp for now.
 
     Imported lazily so the CLI can print --help without touching jax.
     """
@@ -87,6 +94,22 @@ def build_engine(
     else:
         engine_cfg = EngineConfig(**overrides) if overrides else EngineConfig()
     mesh = None
+    sp_mesh = None
+    if sp > 1:
+        if tp * dp > 1:
+            raise ValueError("--sp is mutually exclusive with --tp/--dp for now")
+        from dynamo_tpu.ops.ring_attention import sequence_parallel_mesh
+
+        sp_mesh = sequence_parallel_mesh(sp)
+        if engine_cfg.ring_prefill_threshold <= 0:
+            # --sp without an explicit threshold: route every prompt that
+            # fills at least half the largest bucket through the ring.
+            engine_cfg = dataclasses.replace(
+                engine_cfg,
+                ring_prefill_threshold=max(
+                    engine_cfg.block_size, engine_cfg.prefill_buckets[-1] // 2
+                ),
+            )
     if tp * dp > 1:
         from dynamo_tpu.parallel.sharding import make_mesh
 
@@ -117,6 +140,7 @@ def build_engine(
         on_stored=on_stored,
         on_removed=on_removed,
         mesh=mesh,
+        sp_mesh=sp_mesh,
     )
     return core, TpuEngine(core)
 
@@ -136,6 +160,7 @@ async def run_jax_worker(
     core_out: list | None = None,
     tp: int = 1,
     dp: int = 1,
+    sp: int = 1,
     quant: str | None = None,
 ) -> None:
     if component is None:
@@ -176,6 +201,7 @@ async def run_jax_worker(
         on_removed=on_removed,
         tp=tp,
         dp=dp,
+        sp=sp,
         quant=quant,
     )
 
@@ -205,7 +231,10 @@ async def run_jax_worker(
             # stage out (reference nixl_connect descriptor flow,
             # disagg_serving.md:88-96).
             rid = request["request_id"]
-            chunk = int(request.get("chunk_blocks", 8))
+            # 32-block chunks balance device-invocation count (each chunk
+            # is one gather at a fixed dispatch cost) against streaming
+            # overlap with the consumer's imports.
+            chunk = int(request.get("chunk_blocks", 32))
             try:
                 descs = core.export_descriptors(rid)
             except KeyError:
@@ -448,7 +477,7 @@ async def _remote_prefill_then_decode(
 
     if prefill_worker is not None and rid is not None:
         descs: list[dict] | None = None
-        imported = total = 0
+        imported = total = dropped = 0
         bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
         async for frame in bstream:
             if "error" in frame:
@@ -472,8 +501,16 @@ async def _remote_prefill_then_decode(
             total += len(batch)
             # Import chunk-by-chunk, concurrent with the engine's own
             # admission/decode (the step lock is only held per splice).
-            imported += await asyncio.to_thread(core.import_blocks, batch)
-        log.debug("imported %d/%d transferred blocks for %s", imported, total, rid)
+            res = await asyncio.to_thread(core.import_blocks, batch)
+            imported += res.imported
+            dropped += res.dropped
+        if dropped > 0:
+            log.warning(
+                "KV transfer for %s: %d/%d blocks dropped (allocator full); "
+                "the local prefill will recompute them", rid, dropped, total,
+            )
+        else:
+            log.debug("imported %d/%d transferred blocks for %s", imported, total, rid)
 
     token1 = out1.token_ids[0]
     first_chunk = LLMEngineOutput(
@@ -541,6 +578,16 @@ def main() -> None:
         "--dp", type=int, default=1,
         help="in-engine data-parallel degree (decode batch splits over dp)",
     )
+    ap.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel degree: long prompts prefill as one dense "
+             "ring-attention pass over an sp-device mesh (exclusive with tp/dp)",
+    )
+    ap.add_argument(
+        "--ring-prefill-threshold", type=int, default=None,
+        help="prompts at least this long take the ring-prefill path "
+             "(default with --sp: half the largest prefill bucket)",
+    )
     ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
     ap.add_argument(
         "--max-local-prefill-length", type=int, default=50,
@@ -555,6 +602,7 @@ def main() -> None:
             "block_size": args.block_size,
             "max_num_seqs": args.max_num_seqs,
             "max_model_len": args.max_model_len,
+            "ring_prefill_threshold": args.ring_prefill_threshold,
         }.items()
         if v is not None
     }
@@ -576,6 +624,7 @@ def main() -> None:
             ),
             tp=args.tp,
             dp=args.dp,
+            sp=args.sp,
             quant=args.quant,
         )
 

@@ -232,22 +232,29 @@ class HttpService:
         async def clear_prefill_ns(ns: str) -> tuple[str, dict | None]:
             client = None
             try:
-                client = await (
+                endpoint = (
                     self.manager.runtime.namespace(ns)
                     .component("prefill")
                     .endpoint("generate")
-                    .client()
                 )
-                # The instance watch populates asynchronously; give the
-                # initial events a moment (aggregated deployments simply
-                # time out with no prefill fleet).
+                # Cheap existence probe first: aggregated deployments have
+                # no prefill instances registered, and must not pay a
+                # client + watch + wait per admin call.
+                registered = await self.manager.runtime.store.kv_get_prefix(
+                    endpoint.instance_prefix
+                )
+                if not registered:
+                    return ns, None
+                client = await endpoint.client()
+                # The instance watch populates asynchronously; the probe
+                # above guarantees instances exist, so this is brief.
                 try:
-                    await client.wait_for_instances(1, timeout=1.0)
+                    await client.wait_for_instances(1, timeout=5.0)
                 except (asyncio.TimeoutError, TimeoutError):
                     pass
                 wids = client.instance_ids()
                 if not wids:
-                    return ns, None  # aggregated deploy: no prefill fleet
+                    return ns, None
                 counts = await asyncio.gather(
                     *(clear_one(client, w) for w in wids)
                 )

@@ -87,6 +87,9 @@ class ForwardPassMetrics:
     worker: WorkerStats = field(default_factory=WorkerStats)
     kv: KvStats = field(default_factory=KvStats)
     spec_decode: dict[str, Any] | None = None
+    # Disagg KV transfer accounting (imported/skipped/dropped block
+    # counts; see EngineCore.transfer_stats). None = engine predates it.
+    transfer: dict[str, int] | None = None
 
     def to_wire(self) -> bytes:
         return msgpack.packb(asdict(self))
@@ -99,6 +102,7 @@ class ForwardPassMetrics:
             worker=WorkerStats(**d["worker"]),
             kv=KvStats(**d["kv"]),
             spec_decode=d.get("spec_decode"),
+            transfer=d.get("transfer"),
         )
 
 

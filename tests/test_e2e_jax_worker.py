@@ -26,14 +26,19 @@ class JaxCluster:
         router_mode: str = "kv",
         tp: int = 1,
         dp: int = 1,
+        sp: int = 1,
+        ring_prefill_threshold: int | None = None,
     ):
         self.num_workers = num_workers
         self.router_mode = router_mode
         self.tp = tp
         self.dp = dp
+        self.sp = sp
+        self.ring_prefill_threshold = ring_prefill_threshold
         self.store = StoreServer()
         self.runtimes: list[DistributedRuntime] = []
         self.tasks: list[asyncio.Task] = []
+        self.cores: list = []
         self.base_url = ""
 
     async def __aenter__(self) -> "JaxCluster":
@@ -50,8 +55,15 @@ class JaxCluster:
                         preset="tiny",
                         seed=0,
                         served_event=served,
+                        core_out=self.cores,
                         tp=self.tp,
                         dp=self.dp,
+                        sp=self.sp,
+                        engine_overrides=(
+                            {"ring_prefill_threshold": self.ring_prefill_threshold}
+                            if self.ring_prefill_threshold is not None
+                            else None
+                        ),
                     )
                 )
             )
@@ -153,3 +165,32 @@ async def test_jax_worker_concurrent_streams():
             results = await asyncio.gather(*[one(i) for i in range(8)])
             for out in results:
                 assert out["usage"]["completion_tokens"] == 4
+
+
+async def test_jax_worker_sequence_parallel_serving_e2e():
+    """A deployed worker can enable ring prefill (--sp) without touching
+    test code: HTTP -> router -> EngineCore with a sequence-parallel mesh,
+    long prompt takes the dense ring-attention path, output greedy-
+    identical to the unsharded engine (VERDICT r5 #4: sequence-parallel
+    serving must be reachable from the service, not just tests)."""
+    # Long enough to clear the ring threshold once chat-templated; the
+    # tiny engine's largest bucket is 128 so it must stay under that.
+    long_content = "long context please " * 4  # 80 chars
+
+    async with JaxCluster(sp=2, ring_prefill_threshold=96) as c:
+        async with aiohttp.ClientSession() as s:
+            out = await _chat(s, c.base_url, long_content, max_tokens=6)
+            assert out["usage"]["completion_tokens"] == 6
+            sp_text = out["choices"][0]["message"]["content"]
+        assert c.cores[0]._ring_prefills > 0, (
+            "long prompt never took the ring-prefill path"
+        )
+        # Short prompts stay on the paged ragged waves.
+        async with aiohttp.ClientSession() as s:
+            await _chat(s, c.base_url, "hi", max_tokens=4)
+        assert c.cores[0]._ring_prefills == 1
+
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            out = await _chat(s, c.base_url, long_content, max_tokens=6)
+            assert out["choices"][0]["message"]["content"] == sp_text

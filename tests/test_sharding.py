@@ -173,3 +173,94 @@ def test_decode_batch_shardings_cover_operands():
     mesh = make_mesh(dp=4, tp=2)
     sh = decode_batch_shardings(mesh)
     assert set(sh) == {"tokens", "block_tables", "positions", "active"}
+
+
+def test_cross_tp_kv_transfer_matches_aggregated():
+    """P<->D mesh mismatch: a tp=2 prefill core's held blocks imported by
+    a tp=1 decode core (and the reverse direction's staging) must decode
+    to exactly the aggregated output. The staged page is layout-complete
+    ([L, bs, 2kv, d] gathered across shards), so the consumer's own cache
+    sharding performs the relayout — the reference needs a CUDA transpose
+    kernel for this (disagg_serving.md:96-98)."""
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    def req(tokens, rid, n, hold=False):
+        return PreprocessedRequest(
+            model="t", token_ids=list(tokens), request_id=rid,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            kv_transfer_params={"do_remote_decode": True} if hold else None,
+        )
+
+    def run(core, seq):
+        toks = []
+        for _ in range(200):
+            for s, out in core.step():
+                if s is seq:
+                    toks.extend(out.token_ids)
+            if seq.finish is not None:
+                return toks
+        raise AssertionError("never finished")
+
+    prompt = list(np.random.RandomState(7).randint(1, 500, size=40))
+
+    # Aggregated single-device ground truth (same seed = same model).
+    agg = EngineCore(CFG, ENG, seed=0)
+    want = run(agg, agg.add_request(req(prompt, "agg", 6)))
+
+    # tp=2 prefill core -> tp=1 decode core over the wire protocol.
+    p_core = EngineCore(CFG, ENG, seed=0, mesh=make_mesh(dp=1, tp=2))
+    d_core = EngineCore(CFG, ENG, seed=0)
+    tok1 = run(p_core, p_core.add_request(req(prompt, "pf", 1, hold=True)))
+    descs = p_core.export_descriptors("pf")
+    assert descs[0]["layout"]["tp"] == 2
+    pages = p_core.read_held_pages("pf", 0, len(descs))
+    n = d_core.import_blocks([dict(d, kv=kv) for d, kv in zip(descs, pages)]).imported
+    p_core.release_held("pf")
+    assert n == len(descs) > 0
+    seq = d_core.add_request(req(prompt + tok1, "dec", 5))
+    got = run(d_core, seq)
+    assert tok1 + got == want
+    assert seq.num_cached_tokens > 0  # rode the imported, relayouted prefix
+
+
+def test_import_rejects_block_size_mismatch():
+    """block_size mismatches cannot be relayouted (disjoint hash domains)
+    and must fail loudly, not corrupt."""
+    import dataclasses
+
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    prompt = list(np.random.RandomState(7).randint(1, 500, size=40))
+    p_core = EngineCore(CFG, ENG, seed=0)
+    pre = PreprocessedRequest(
+        model="t", token_ids=prompt, request_id="pf",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=1, ignore_eos=True),
+        kv_transfer_params={"do_remote_decode": True},
+    )
+    seq = p_core.add_request(pre)
+    for _ in range(100):
+        p_core.step()
+        if seq.finish is not None:
+            break
+    descs = p_core.export_descriptors("pf")
+    pages = p_core.read_held_pages("pf", 0, len(descs))
+    p_core.release_held("pf")
+
+    d_core = EngineCore(
+        CFG, dataclasses.replace(ENG, block_size=16, prefill_buckets=(32, 64, 128)),
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="block_size"):
+        d_core.import_blocks([dict(d, kv=kv) for d, kv in zip(descs, pages)])
