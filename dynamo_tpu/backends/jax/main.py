@@ -50,6 +50,29 @@ def _prefill_queue(namespace: str) -> str:
     return f"prefill:{namespace}"
 
 
+def _eos_for(tokenizer: str) -> tuple[int, ...]:
+    if tokenizer == "byte":
+        from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+        return (ByteTokenizer.EOS,)
+    return ()
+
+
+def _model_card(model_name: str, tokenizer: str, core) -> ModelDeploymentCard:
+    return ModelDeploymentCard(
+        name=model_name,
+        tokenizer=tokenizer,
+        model_type="chat",
+        context_length=core.engine.max_model_len,
+        kv_block_size=core.engine.block_size,
+        runtime_config=ModelRuntimeConfig(
+            total_kv_blocks=core.engine.num_kv_blocks,
+            max_num_seqs=core.engine.max_num_seqs,
+            max_num_batched_tokens=core.engine.prefill_buckets[-1],
+        ),
+    )
+
+
 def build_engine(
     preset: str,
     engine_overrides: dict[str, Any] | None = None,
@@ -61,8 +84,13 @@ def build_engine(
     dp: int = 1,
     sp: int = 1,
     quant: str | None = None,
+    core_cls=None,
+    core_kwargs: dict[str, Any] | None = None,
 ):
     """Construct (EngineCore, TpuEngine) for a model preset.
+
+    ``core_cls`` substitutes the engine-core class (multihost LeaderCore
+    journals intake for follower replay).
 
     ``quant='int8'`` serves int8 weight-only-quantized params (the
     capacity mode that fits llama3-8b on one 16 GB chip).
@@ -131,7 +159,7 @@ def build_engine(
         params = init_params_quantized(jax.random.PRNGKey(seed), model_cfg)
     elif quant:
         raise ValueError(f"unknown quantization {quant!r}")
-    core = EngineCore(
+    core = (core_cls or EngineCore)(
         model_cfg,
         engine_cfg,
         params=params,
@@ -141,6 +169,7 @@ def build_engine(
         on_removed=on_removed,
         mesh=mesh,
         sp_mesh=sp_mesh,
+        **(core_kwargs or {}),
     )
     return core, TpuEngine(core)
 
@@ -162,9 +191,30 @@ async def run_jax_worker(
     dp: int = 1,
     sp: int = 1,
     quant: str | None = None,
+    nnodes: int = 1,
+    node_rank: int = 0,
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
+    if nnodes > 1:
+        # Multi-host lockstep (backends/jax/multihost.py): the caller has
+        # already joined the jax.distributed runtime; here the engine is
+        # built over the GLOBAL mesh and the host-side schedulers are
+        # kept identical via step-record replication.
+        if role != "aggregated":
+            raise ValueError("multi-host serving supports role=aggregated only")
+        if sp > 1:
+            raise ValueError(
+                "--sp (ring prefill) is not supported under --nnodes yet"
+            )
+        if (engine_overrides or {}).get("held_block_ttl_s", 0) != 0:
+            raise ValueError("held_block_ttl_s must be 0 under multi-host")
+        engine_overrides = dict(engine_overrides or {}, held_block_ttl_s=0)
+        return await _run_multihost(
+            runtime, model_name, preset, namespace, component,
+            engine_overrides, tokenizer, seed, served_event, core_out,
+            tp, dp, quant, nnodes, node_rank,
+        )
     worker_id = runtime.primary_lease_id
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
     loop = asyncio.get_running_loop()
@@ -181,11 +231,7 @@ async def run_jax_worker(
             lambda: loop.create_task(kv_pub.removed(hashes))
         )
 
-    eos: tuple[int, ...] = ()
-    if tokenizer == "byte":
-        from dynamo_tpu.llm.tokenizer import ByteTokenizer
-
-        eos = (ByteTokenizer.EOS,)
+    eos = _eos_for(tokenizer)
 
     # Build (and compile) off the event loop: on real TPU hardware the
     # first jit takes tens of seconds, and blocking the loop that long
@@ -406,21 +452,7 @@ async def run_jax_worker(
                 yield out
 
     await endpoint.serve(handler)
-    await register_llm(
-        endpoint,
-        ModelDeploymentCard(
-            name=model_name,
-            tokenizer=tokenizer,
-            model_type="chat",
-            context_length=core.engine.max_model_len,
-            kv_block_size=core.engine.block_size,
-            runtime_config=ModelRuntimeConfig(
-                total_kv_blocks=core.engine.num_kv_blocks,
-                max_num_seqs=core.engine.max_num_seqs,
-                max_num_batched_tokens=core.engine.prefill_buckets[-1],
-            ),
-        ),
-    )
+    await register_llm(endpoint, _model_card(model_name, tokenizer, core))
     log.info(
         "jax %s worker %d serving model %r (preset %s, %d kv blocks)",
         role, worker_id, model_name, preset, core.engine.num_kv_blocks,
@@ -428,6 +460,140 @@ async def run_jax_worker(
     if served_event is not None:
         served_event.set()
     await runtime.wait_for_shutdown()
+
+
+async def _run_multihost(
+    runtime: DistributedRuntime,
+    model_name: str,
+    preset: str,
+    namespace: str,
+    component: str,
+    engine_overrides: dict[str, Any] | None,
+    tokenizer: str,
+    seed: int,
+    served_event: asyncio.Event | None,
+    core_out: list | None,
+    tp: int,
+    dp: int,
+    quant: str | None,
+    nnodes: int,
+    node_rank: int,
+) -> None:
+    """Leader (rank 0) serves; followers replay its step records so every
+    process issues identical programs over the global mesh."""
+    from dynamo_tpu.backends.jax.multihost import (
+        LeaderCore,
+        barrier_name,
+        run_follower,
+        steps_subject,
+    )
+    from dynamo_tpu.runtime.barrier import LeaderBarrier
+
+    import msgpack
+
+    eos = _eos_for(tokenizer)
+    loop = asyncio.get_running_loop()
+    subject = steps_subject(namespace, component)
+    worker_id = runtime.primary_lease_id
+
+    if node_rank == 0:
+        def _publish_failed(task: asyncio.Task) -> None:
+            if task.cancelled() or task.exception() is None:
+                return
+            # A lost record desynchronizes every follower; there is no
+            # recovering mid-flight — fail the deployment loudly.
+            log.error(
+                "step-record publish failed; followers will lose lockstep",
+                exc_info=task.exception(),
+            )
+            runtime.signal_shutdown()
+
+        def publish(record: dict) -> None:
+            payload = msgpack.packb(record, use_bin_type=True)
+
+            def _send() -> None:
+                t = loop.create_task(runtime.store.publish(subject, payload))
+                t.add_done_callback(_publish_failed)
+
+            loop.call_soon_threadsafe(_send)
+
+        # KV events fire only on the leader (the router's view of the
+        # fleet is the leader's cache — followers mirror it exactly).
+        kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
+
+        def on_stored(hashes: list[int], parent: int | None) -> None:
+            loop.call_soon_threadsafe(
+                lambda: loop.create_task(kv_pub.stored(hashes, parent))
+            )
+
+        def on_removed(hashes: list[int]) -> None:
+            loop.call_soon_threadsafe(
+                lambda: loop.create_task(kv_pub.removed(hashes))
+            )
+
+        core, engine = await asyncio.to_thread(
+            build_engine, preset, engine_overrides, seed=seed,
+            eos_token_ids=eos, on_stored=on_stored, on_removed=on_removed,
+            tp=tp, dp=dp, quant=quant,
+            core_cls=LeaderCore, core_kwargs={"publish": publish},
+        )
+        if core_out is not None:
+            core_out.append(core)
+        # No step record may fire before every follower subscribes.
+        await LeaderBarrier(
+            runtime.store, barrier_name(namespace, component), nnodes - 1
+        ).sync({"model": model_name}, timeout=120.0)
+
+        metrics_pub = WorkerMetricsPublisher(
+            runtime.store, namespace, component, worker_id,
+            engine.metrics, interval_s=0.5,
+        )
+        await metrics_pub.start()
+        endpoint = (
+            runtime.namespace(namespace).component(component).endpoint("generate")
+        )
+
+        async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            async for out in engine.generate(request, context):
+                yield out
+
+        await endpoint.serve(handler)
+        await register_llm(endpoint, _model_card(model_name, tokenizer, core))
+        log.info(
+            "multihost leader %d serving %r over %d nodes (preset %s)",
+            worker_id, model_name, nnodes, preset,
+        )
+        if served_event is not None:
+            served_event.set()
+        await runtime.wait_for_shutdown()
+        return
+
+    core, _engine = await asyncio.to_thread(
+        build_engine, preset, engine_overrides, seed=seed,
+        eos_token_ids=eos, tp=tp, dp=dp, quant=quant,
+    )
+    if core_out is not None:
+        core_out.append(core)
+    ready = asyncio.Event()
+    follower = asyncio.create_task(
+        run_follower(runtime, core, namespace, component, nnodes, ready_event=ready)
+    )
+    await ready.wait()
+    if served_event is not None:
+        served_event.set()
+    shutdown = asyncio.create_task(runtime.wait_for_shutdown())
+    try:
+        # A follower that stops stepping deadlocks the whole pod's
+        # collectives — surface its death instead of idling silently.
+        done, _ = await asyncio.wait(
+            {follower, shutdown}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if follower in done and follower.exception() is not None:
+            log.error("multihost follower failed", exc_info=follower.exception())
+            raise follower.exception()
+    finally:
+        follower.cancel()
+        shutdown.cancel()
 
 
 async def _remote_prefill_then_decode(
@@ -589,6 +755,16 @@ def main() -> None:
              "(default with --sp: half the largest prefill bucket)",
     )
     ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
+    # Multi-host (reference parity: sglang multinode flags dist-init-addr/
+    # nnodes/node-rank, multinode-examples.md:10). Rank 0 serves; other
+    # ranks follow in lockstep over the global mesh.
+    ap.add_argument("--dist-init-addr", default=None,
+                    help="jax.distributed coordinator host:port (multi-host)")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node-rank", type=int, default=0)
+    ap.add_argument("--local-cpu-devices", type=int, default=None,
+                    help="validation mode: force the CPU platform with N "
+                         "virtual devices per process (cluster-free multi-host)")
     ap.add_argument(
         "--max-local-prefill-length", type=int, default=50,
         help="decode role: prefills longer than this go to the prefill fleet",
@@ -606,6 +782,18 @@ def main() -> None:
         }.items()
         if v is not None
     }
+
+    if args.nnodes > 1:
+        if not args.dist_init_addr:
+            ap.error("--nnodes > 1 requires --dist-init-addr")
+        from dynamo_tpu.parallel.multihost import init_multihost
+
+        # Must precede every other jax touch (build_engine imports jax
+        # lazily, so doing it here is early enough).
+        init_multihost(
+            args.dist_init_addr, args.nnodes, args.node_rank,
+            local_cpu_devices=args.local_cpu_devices,
+        )
 
     @dynamo_worker()
     async def entry(runtime: DistributedRuntime) -> None:
@@ -626,6 +814,8 @@ def main() -> None:
             dp=args.dp,
             sp=args.sp,
             quant=args.quant,
+            nnodes=args.nnodes,
+            node_rank=args.node_rank,
         )
 
     entry()

@@ -1,0 +1,202 @@
+"""Leader/follower step replication for multi-host serving.
+
+JAX multi-controller SPMD requires every process to issue the SAME
+sequence of jitted programs. The engine's scheduler is deterministic
+given an identical op stream — sampling happens on-device (identical on
+all hosts), seeds derive from the request counter, and stop-scans read
+replicated outputs — so lockstep reduces to replicating the *intake*:
+
+- the leader (node-rank 0) serves the normal worker endpoints; before
+  each ``step()`` it publishes the ops applied since the previous step
+  (requests added, cancels observed, cache clears) on a store subject;
+- followers (node-rank > 0) replay each record — apply ops, call
+  ``step()``, discard outputs — issuing the same programs in the same
+  order. Gloo/ICI collectives provide the actual synchronization: a
+  leader step blocks until every follower reaches it.
+
+A store-backed barrier (runtime/barrier.py) gates startup so no follower
+misses the first record. Reference parity: multi-node serving via
+``dist-init-addr / nnodes / node-rank`` engine flags
+(`components/backends/sglang/docs/multinode-examples.md:10`) — the
+reference delegates lockstep to NCCL/MPI inside the engine; here it is
+first-party.
+
+Out of scope while multi-host (guarded loudly): embeddings (a second
+program family whose relative order vs steps is not replicated), disagg
+block import/export, and wall-clock hold expiry (time-based state would
+desynchronize the schedulers; ``held_block_ttl_s`` is forced to 0).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+log = logging.getLogger("dynamo_tpu.backends.jax.multihost")
+
+
+def steps_subject(namespace: str, component: str) -> str:
+    return f"mh_steps:{namespace}:{component}"
+
+
+def barrier_name(namespace: str, component: str) -> str:
+    return f"mh_start:{namespace}:{component}"
+
+
+class LeaderCore(EngineCore):
+    """EngineCore that journals intake and publishes one record per step.
+
+    Lockstep invariant: the scheduler may only observe state changes that
+    the step record journals. Three mechanisms enforce it:
+
+    - **Staged intake.** ``_enqueue`` diverts validated sequences to a
+      staging deque instead of the scheduler inbox; the step snapshot
+      (atomically, under ``_mh_mutex``) journals them and moves them to
+      the real inbox. An add landing mid-step therefore cannot be
+      admitted before its record exists.
+    - **Deferred cancels.** ``cancel_request`` marks a pending flag; the
+      snapshot promotes it to ``seq.cancelled`` + a journal op. The
+      scheduler reads ``cancelled`` live, so the flag must not flip
+      between snapshot and execution.
+    - **Journal-then-validate adds.** The add op is journaled BEFORE
+      ``add_request`` validation: a rejected request (which already
+      consumed a request-counter tick — seeds derive from it) replays on
+      followers as the same rejection, keeping counters aligned.
+
+    ``publish(record)`` must be thread-safe (step() runs in a worker
+    thread); the worker wires it to the event loop with
+    ``call_soon_threadsafe`` — FIFO, so records arrive in order."""
+
+    def __init__(self, *args, publish=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        import collections
+        import threading
+
+        self._mh_publish = publish
+        self._mh_mutex = threading.Lock()
+        self._mh_ops: list[dict] = []
+        self._mh_stage: collections.deque = collections.deque()
+        self._mh_iter = 0
+        self._mh_known: dict[str, Any] = {}  # rid -> seq (cancel tracking)
+
+    def add_request(self, pre: PreprocessedRequest):
+        with self._mh_mutex:
+            self._mh_ops.append({"op": "add", "req": pre.to_wire()})
+            seq = super().add_request(pre)  # on raise the op stays: the
+            # follower replays the identical rejection (counter parity)
+            self._mh_known[seq.request_id] = seq
+            return seq
+
+    def _enqueue(self, seq) -> None:
+        # Caller (add_request) holds _mh_mutex.
+        self._mh_stage.append(seq)
+
+    def has_work(self) -> bool:
+        # Staged intake must wake the engine loop (it reaches the real
+        # inbox only at the next step's snapshot).
+        return bool(self._mh_stage) or super().has_work()
+
+    def cancel_request(self, seq) -> None:
+        seq.mh_cancel_pending = True  # promoted at the next snapshot
+
+    def clear_kv_cache(self) -> int:
+        # Journal + execute atomically against the snapshot (both take
+        # _mh_mutex) and against steps (_step_lock).
+        with self._step_lock:
+            with self._mh_mutex:
+                self._mh_ops.append({"op": "clear"})
+            return len(self.allocator.clear_cache())
+
+    def embed(self, token_ids):
+        raise RuntimeError(
+            "embeddings are not supported on a multi-host engine yet "
+            "(their program order cannot be replicated to followers)"
+        )
+
+    def step(self):
+        with self._step_lock:
+            with self._mh_mutex:
+                ops = self._mh_ops
+                self._mh_ops = []
+                while self._mh_stage:
+                    self._inbox.append(self._mh_stage.popleft())
+                done = []
+                for rid, seq in self._mh_known.items():
+                    if getattr(seq, "mh_cancel_pending", False) and not seq.cancelled:
+                        seq.cancelled = True
+                        ops.append({"op": "cancel", "rid": rid})
+                        done.append(rid)
+                    elif seq.finish is not None and rid not in self._held:
+                        done.append(rid)  # finished: no cancel can matter
+                for rid in done:
+                    self._mh_known.pop(rid, None)
+                record = {"iter": self._mh_iter, "ops": ops}
+                self._mh_iter += 1
+            if self._mh_publish is not None:
+                self._mh_publish(record)
+            return self._step_locked()
+
+
+async def run_follower(
+    runtime,
+    core: EngineCore,
+    namespace: str,
+    component: str,
+    num_processes: int,
+    ready_event: asyncio.Event | None = None,
+) -> None:
+    """Follower loop: replay the leader's step records forever.
+
+    Subscribes BEFORE checking into the startup barrier, so record 0
+    cannot be missed; the leader waits on the same barrier before its
+    first step."""
+    from dynamo_tpu.runtime.barrier import WorkerBarrier
+
+    sub = await runtime.store.subscribe(steps_subject(namespace, component))
+    # Lease-bound check-in: a dead follower's key vanishes with its
+    # lease, so a fleet restart cannot satisfy the new leader's barrier
+    # with the previous run's stale check-ins.
+    await WorkerBarrier(
+        runtime.store,
+        barrier_name(namespace, component),
+        worker_id=str(runtime.primary_lease_id),
+    ).sync(timeout=120.0, lease=runtime.primary_lease_id)
+    if ready_event is not None:
+        ready_event.set()
+    log.info("multihost follower ready (%s/%s)", namespace, component)
+    import msgpack
+
+    expected = 0
+    async for msg in sub:
+        record = msgpack.unpackb(msg["p"], raw=False)
+        if record["iter"] != expected:
+            raise RuntimeError(
+                f"step record gap: expected iter {expected}, got "
+                f"{record['iter']} — follower lost lockstep, aborting"
+            )
+        expected += 1
+        for op in record["ops"]:
+            kind = op["op"]
+            if kind == "add":
+                try:
+                    core.add_request(PreprocessedRequest.from_wire(op["req"]))
+                except ValueError:
+                    # The leader journaled this add BEFORE validating and
+                    # rejected it the same way; replaying the rejection
+                    # keeps the request counters (seed derivation)
+                    # aligned.
+                    pass
+            elif kind == "cancel":
+                for seq in (*core.running, *core.waiting, *core._inbox):
+                    if seq.request_id == op["rid"]:
+                        seq.cancelled = True
+            elif kind == "clear":
+                core.clear_kv_cache()
+        # The step issues the same jitted programs as the leader's; the
+        # collective inside blocks until all hosts arrive (that IS the
+        # synchronization).
+        await asyncio.to_thread(core.step)

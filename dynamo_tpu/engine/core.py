@@ -50,6 +50,7 @@ from dynamo_tpu.engine.model import (
 )
 from dynamo_tpu.engine.sampler import LOGPROBS_K, sample, token_logprobs
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.parallel.multihost import fetch_replicated
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -187,7 +188,23 @@ def _decode_chain(
     (_, cache), (sampled, lps) = jax.lax.scan(
         body, (tokens, cache), jnp.arange(n_steps)
     )
-    return sampled, lps, cache
+    return _replicate_out(sampled, mesh), _replicate_out(lps, mesh), cache
+
+
+def _replicate_out(x, mesh):
+    """Pin small host-bound outputs (sampled tokens, logprobs) to a
+    replicated layout: under dp the batch inputs are dp-sharded and GSPMD
+    would propagate that to the outputs, which a multi-host leader could
+    not fetch (each host would hold only its lanes). The all-gather this
+    inserts is a few KB."""
+    if x is None or mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(a, rep), x
+    )
 
 
 def _ring_prefill_and_sample(
@@ -226,7 +243,7 @@ def _prefill_and_sample(
         logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
     )
     lps = token_logprobs(logits, toks) if want_logprobs else None
-    return toks, lps, cache
+    return _replicate_out(toks, mesh), _replicate_out(lps, mesh), cache
 
 
 class EngineCore:
@@ -438,8 +455,19 @@ class EngineCore:
             )
         if (pre.kv_transfer_params or {}).get("do_remote_decode"):
             seq.hold_blocks = True
-        self._inbox.append(seq)
+        self._enqueue(seq)
         return seq
+
+    def _enqueue(self, seq: Sequence) -> None:
+        """Hand a validated sequence to the scheduler (overridden by the
+        multihost LeaderCore to stage intake until it is journaled)."""
+        self._inbox.append(seq)
+
+    def cancel_request(self, seq: Sequence) -> None:
+        """Cancel hook (overridden by the multihost LeaderCore: cancels
+        must become visible to the scheduler only once journaled, or
+        leader and followers would diverge)."""
+        seq.cancelled = True
 
     # -- scheduling --------------------------------------------------------
 
@@ -647,8 +675,8 @@ class EngineCore:
             all_greedy=all_greedy,
             want_logprobs=want_lp,
         )
-        toks = np.asarray(toks)
-        lps = None if lps is None else tuple(np.asarray(a) for a in lps)
+        toks = fetch_replicated(toks)
+        lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
 
         out = []
         for i, (seq, chunk) in enumerate(chosen):
@@ -722,7 +750,7 @@ class EngineCore:
                 "ring prefill active: %d-token prompt over sp=%d",
                 P_len, int(self.sp_mesh.shape["sp"]),
             )
-        tok = int(np.asarray(toks)[0])
+        tok = int(fetch_replicated(toks)[0])
         completed = seq.hashed.extend(seq.prompt)
         self._commit_completed(seq, completed)
         seq.prefilled = seq.processed = P_len
@@ -730,7 +758,7 @@ class EngineCore:
         seq.generated += 1
         lp = None
         if want_lp and lps is not None:
-            lps = tuple(np.asarray(a) for a in lps)
+            lps = tuple(fetch_replicated(a) for a in lps)
             lp = _lp_entry(tok, lps[0][0], lps[1][0], lps[2][0], seq.logprobs)
         out = self._emit(seq, tok, lp)
         if seq.finish is not None:
@@ -823,8 +851,8 @@ class EngineCore:
             want_logprobs=want_lp,
         )
         if lps is not None:
-            lps = tuple(np.asarray(a) for a in lps)
-        return np.asarray(out), lps  # [n_steps, B], lp arrays or None
+            lps = tuple(fetch_replicated(a) for a in lps)
+        return fetch_replicated(out), lps  # [n_steps, B], lp arrays or None
 
     # -- the iteration -----------------------------------------------------
 
@@ -1133,7 +1161,7 @@ class EngineCore:
             if not ids:
                 return []
             pages_dev = self._gather_pages(self.cache, jnp.asarray(ids, jnp.int32))
-        pages = np.asarray(pages_dev)
+        pages = fetch_replicated(pages_dev)
         return [np.ascontiguousarray(p).tobytes() for p in pages]
 
     def cached_prefix_tokens(self, token_ids: list[int]) -> int:
@@ -1346,7 +1374,7 @@ class EngineCore:
             jnp.asarray(write_pages),
             jnp.asarray(tables),
         )
-        return np.asarray(pooled)
+        return fetch_replicated(pooled)
 
     def clear_kv_cache(self) -> int:
         """Drop every unpinned cached block (admin surface — reference

@@ -64,10 +64,10 @@ class TpuEngine:
                     return
                 yield item
                 if context.is_stopped:
-                    seq.cancelled = True
+                    self.core.cancel_request(seq)
                     return
         finally:
-            seq.cancelled = True
+            self.core.cancel_request(seq)
             self._queues.pop(seq.request_id, None)
             self._seqs.pop(seq.request_id, None)
 

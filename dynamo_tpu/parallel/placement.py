@@ -1,0 +1,114 @@
+"""Memory-placement planning: does a (model, engine, mesh) fit the pod?
+
+The north-star deployment (BASELINE.md) is Llama-3-70B disaggregated P/D
+on a v5e-64 (16 hosts x 4 chips, 16 GB HBM each). This module is the
+planning math a topology is checked against BEFORE burning a pod on an
+OOM: per-chip parameter bytes under the TP sharding
+(`parallel/sharding.py` — projections split over tp, embeddings/norms
+replicated, dp replicas each hold a full copy), per-chip KV-cache bytes
+(the combined [L, pages, bs, 2kv, d] cache splits its head axis over
+tp), plus a headroom fraction for activations and XLA scratch.
+
+Shape source of truth: ``jax.eval_shape`` over ``model.init_params`` /
+``model.init_cache`` with the very PartitionSpecs the engine serves under
+(`param_partition_specs`) — the plan counts exactly the arrays the engine
+allocates, not a hand formula that can drift from the code.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+
+# v5e: 16 GiB HBM per chip.
+V5E_HBM_BYTES = 16 * 1024**3
+
+
+@dataclass
+class MemoryPlan:
+    param_bytes_per_chip: int
+    cache_bytes_per_chip: int
+    headroom_frac: float
+
+    @property
+    def total_per_chip(self) -> int:
+        return math.ceil(
+            (self.param_bytes_per_chip + self.cache_bytes_per_chip)
+            * (1.0 + self.headroom_frac)
+        )
+
+    def fits(self, hbm_bytes: int = V5E_HBM_BYTES) -> bool:
+        return self.total_per_chip <= hbm_bytes
+
+    def describe(self, hbm_bytes: int = V5E_HBM_BYTES) -> str:
+        gib = 1024**3
+        return (
+            f"params {self.param_bytes_per_chip / gib:.2f} GiB/chip + "
+            f"kv {self.cache_bytes_per_chip / gib:.2f} GiB/chip "
+            f"(+{self.headroom_frac:.0%} headroom) = "
+            f"{self.total_per_chip / gib:.2f} / {hbm_bytes / gib:.0f} GiB"
+        )
+
+
+def memory_plan(
+    model: ModelConfig,
+    engine: EngineConfig,
+    tp: int,
+    dp: int = 1,
+    quant: str | None = None,
+    headroom_frac: float = 0.15,
+) -> MemoryPlan:
+    """Per-chip memory plan for serving ``model`` on a dp x tp mesh.
+
+    Parameter shapes come from ``jax.eval_shape`` of the real init (no
+    device memory is touched); each leaf's per-chip share divides by the
+    product of mesh axes its PartitionSpec names. ``quant='int8'`` maps
+    each projection leaf to 1 byte/element + one float32 scale per
+    output column (matching model.quantize_params). dp never divides —
+    every dp replica holds full params and its own cache.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from dynamo_tpu.engine.model import init_cache, init_params
+    from dynamo_tpu.parallel.sharding import param_partition_specs
+
+    params_shape = jax.eval_shape(
+        lambda k: init_params(k, model, tp), jax.random.PRNGKey(0)
+    )
+    specs = param_partition_specs(model, tp)
+    is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+    spec_of = {
+        path: spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=is_spec
+        )[0]
+    }
+
+    param_bytes = 0
+    for path, sd in jax.tree_util.tree_flatten_with_path(params_shape)[0]:
+        spec = spec_of[path]
+        on_tp = any(name == "tp" for name in spec)
+        div = tp if on_tp else 1
+        n = math.prod(sd.shape) if sd.shape else 1
+        if quant == "int8" and sd.ndim >= 2 and on_tp:
+            # Quantized set = the projections — exactly the tp-annotated
+            # matrices (quantize_params leaves embeddings/norms at the
+            # model dtype).
+            param_bytes += math.ceil(n / div)  # 1 byte / element
+            param_bytes += math.ceil(sd.shape[-1] / div) * 4  # f32 scales
+        else:
+            param_bytes += math.ceil(n / div) * sd.dtype.itemsize
+
+    cache_shape = jax.eval_shape(lambda: init_cache(model, engine))
+    cache_n = math.prod(cache_shape.shape)
+    # cache [L, pages, bs, 2kv, d]: combined-head axis over tp.
+    cache_bytes = math.ceil(cache_n / tp) * cache_shape.dtype.itemsize
+
+    return MemoryPlan(
+        param_bytes_per_chip=param_bytes,
+        cache_bytes_per_chip=cache_bytes,
+        headroom_frac=headroom_frac,
+    )

@@ -44,9 +44,10 @@ def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
     return Mesh(grid, ("dp", "tp"))
 
 
-def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
-    """NamedSharding pytree matching `model.init_params` structure."""
-    tp = mesh.shape["tp"]
+def param_partition_specs(cfg: ModelConfig, tp: int) -> dict[str, Any]:
+    """PartitionSpec pytree matching `model.init_params` structure
+    (mesh-free: also used for memory planning of pods larger than the
+    local machine, parallel/placement.py)."""
     for what, n in (
         ("num_kv_heads", cfg.num_kv_heads),
         ("num_heads", cfg.num_heads),
@@ -55,14 +56,11 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
         if n % tp:
             raise ValueError(f"tp={tp} must divide {what}={n}")
 
-    def s(*spec):
-        return NamedSharding(mesh, P(*spec))
-
     layers = {
-        "attn_norm": s(None, None),
-        "mlp_norm": s(None, None),
-        "wqkv": s(None, None, "tp"),
-        "wo": s(None, "tp", None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "wqkv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
     }
     if cfg.is_moe:
         # Expert parallelism: the expert axis shards over the model axis;
@@ -71,22 +69,31 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
             raise ValueError(
                 f"tp={tp} must divide num_experts={cfg.num_experts}"
             )
-        layers["w_router"] = s(None, None, None)
-        layers["w_gate"] = s(None, "tp", None, None)
-        layers["w_up"] = s(None, "tp", None, None)
-        layers["w_down"] = s(None, "tp", None, None)
+        layers["w_router"] = P(None, None, None)
+        layers["w_gate"] = P(None, "tp", None, None)
+        layers["w_up"] = P(None, "tp", None, None)
+        layers["w_down"] = P(None, "tp", None, None)
     else:
-        layers["wgu"] = s(None, None, "tp")
-        layers["w_down"] = s(None, "tp", None)
-    shardings = {
-        "embed": s(None, None),
+        layers["wgu"] = P(None, None, "tp")
+        layers["w_down"] = P(None, "tp", None)
+    specs = {
+        "embed": P(None, None),
         "layers": layers,
-        "final_norm": s(None),
-        "fuse_tp": s(),
+        "final_norm": P(None),
+        "fuse_tp": P(),
     }
     if not cfg.tie_embeddings:
-        shardings["lm_head"] = s(None, "tp")
-    return shardings
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    """NamedSharding pytree matching `model.init_params` structure."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_partition_specs(cfg, mesh.shape["tp"]),
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:

@@ -49,8 +49,12 @@ class WorkerBarrier:
         self.barrier_id = barrier_id
         self.worker_id = worker_id
 
-    async def sync(self, timeout: float = 60.0) -> dict:
-        """Wait for the leader's data, then check in; returns the data."""
+    async def sync(self, timeout: float = 60.0, lease: int = 0) -> dict:
+        """Wait for the leader's data, then check in; returns the data.
+
+        ``lease`` binds the check-in key to the caller's lease so a dead
+        worker's check-in disappears instead of satisfying a later run's
+        barrier."""
 
         async def _wait() -> dict:
             while True:
@@ -61,6 +65,6 @@ class WorkerBarrier:
 
         data = await asyncio.wait_for(_wait(), timeout)
         await self.store.kv_put(
-            _worker_key(self.barrier_id, self.worker_id), b"1"
+            _worker_key(self.barrier_id, self.worker_id), b"1", lease=lease
         )
         return data

@@ -1,0 +1,67 @@
+"""Child process for multi-host tests: joins a 2-process CPU "pod",
+builds the EngineCore over the GLOBAL dp=2 x tp=4 mesh, runs a scripted
+greedy workload, and writes its emitted tokens to a file.
+
+Run: python tests/mh_child.py <coordinator> <rank> <out_path>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, rank, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ.pop("XLA_FLAGS", None)  # the pod size comes from init_multihost
+    from dynamo_tpu.parallel.multihost import init_multihost
+
+    init_multihost(coordinator, num_processes=2, process_id=rank,
+                   local_cpu_devices=4)
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.parallel.sharding import make_mesh
+
+    cfg = ModelConfig(
+        name="dryrun", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16,
+        dtype="float32", tie_embeddings=True,
+    )
+    eng = EngineConfig(
+        num_kv_blocks=32, block_size=8, max_num_seqs=8, max_model_len=128,
+        prefill_buckets=(32, 64, 128), decode_buckets=(4, 8),
+    )
+    core = EngineCore(cfg, eng, seed=0, mesh=make_mesh(dp=2, tp=4))
+    seqs = [
+        core.add_request(
+            PreprocessedRequest(
+                model="t", token_ids=list(range(3 + i, 40 + i)),
+                request_id=f"r{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=5),
+            )
+        )
+        for i in range(3)
+    ]
+    done = {s.request_id: [] for s in seqs}
+    fins = 0
+    for _ in range(200):
+        for seq, out in core.step():
+            done[seq.request_id].extend(out.token_ids)
+            if out.finish_reason:
+                fins += 1
+        if fins == 3:
+            break
+    with open(out_path, "w") as f:
+        json.dump(done, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Multi-host engine: one global mesh over multiple processes.
+
+The cluster-free validation the driver cannot do in-process: REAL
+``jax.distributed`` with 2 CPU processes x 4 virtual devices forming one
+dp=2 x tp=4 mesh (gloo collectives), with output parity against the
+single-process engine — plus the leader/follower step-replication e2e
+through the frontend. Reference parity: multi-node serving flags
+``dist-init-addr / nnodes / node-rank``
+(`components/backends/sglang/docs/multinode-examples.md:10`).
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(argv, **env_over):
+    env = dict(os.environ, **env_over)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, *argv], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def test_two_process_mesh_matches_single_device(tmp_path):
+    """2 processes x 4 CPU devices -> one dp=2 x tp=4 mesh; greedy tokens
+    must equal the single-device engine's (VERDICT r5 #2 done-bar)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [tmp_path / "r0.json", tmp_path / "r1.json"]
+    procs = [
+        _spawn(["tests/mh_child.py", coord, str(rank), str(outs[rank])])
+        for rank in range(2)
+    ]
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out.decode()[-3000:]
+
+    got0 = json.loads(outs[0].read_text())
+    got1 = json.loads(outs[1].read_text())
+    assert got0 == got1, "ranks diverged"
+
+    # Single-device reference (same seed = same model; this process has
+    # its own 8-device CPU platform from conftest, mesh=None).
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    cfg = ModelConfig(
+        name="dryrun", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16,
+        dtype="float32", tie_embeddings=True,
+    )
+    eng = EngineConfig(
+        num_kv_blocks=32, block_size=8, max_num_seqs=8, max_model_len=128,
+        prefill_buckets=(32, 64, 128), decode_buckets=(4, 8),
+    )
+    core = EngineCore(cfg, eng, seed=0)
+    seqs = [
+        core.add_request(
+            PreprocessedRequest(
+                model="t", token_ids=list(range(3 + i, 40 + i)),
+                request_id=f"r{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=5),
+            )
+        )
+        for i in range(3)
+    ]
+    want = {s.request_id: [] for s in seqs}
+    fins = 0
+    for _ in range(200):
+        for seq, out in core.step():
+            want[seq.request_id].extend(out.token_ids)
+            if out.finish_reason:
+                fins += 1
+        if fins == 3:
+            break
+    assert got0 == want, "multi-process mesh diverged from single device"
+
+
+async def test_leader_follower_serving_e2e():
+    """Full multi-host serving: a 2-process dp=2 x tp=2 pod (leader
+    serves, follower replays step records over the store) behind the real
+    frontend, output parity with a single-host worker."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    async def chat(session, base_url, content, max_tokens=6):
+        body = {
+            "model": "mh", "messages": [{"role": "user", "content": content}],
+            "max_tokens": max_tokens, "temperature": 0.0,
+        }
+        async with session.post(
+            f"{base_url}/v1/chat/completions", json=body
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+    store = StoreServer()
+    await store.start()
+    coord = f"127.0.0.1:{_free_port()}"
+    workers = []
+    try:
+        for rank in range(2):
+            workers.append(
+                _spawn(
+                    [
+                        "-m", "dynamo_tpu.backends.jax",
+                        "--model-name", "mh", "--preset", "tiny",
+                        "--tp", "2", "--dp", "2",
+                        "--nnodes", "2", "--node-rank", str(rank),
+                        "--dist-init-addr", coord,
+                        "--local-cpu-devices", "2",
+                    ],
+                    DYN_STORE_ADDRESS=store.address,
+                )
+            )
+
+        front_rt = await DistributedRuntime.create(store.address)
+        ready = asyncio.Event()
+        services: list = []
+        front = asyncio.create_task(
+            run_frontend(
+                front_rt, http_host="127.0.0.1", http_port=0,
+                router_mode="round_robin", ready_event=ready,
+                service_out=services,
+            )
+        )
+        await asyncio.wait_for(ready.wait(), 15)
+        base = f"http://127.0.0.1:{services[0].port}"
+        async with aiohttp.ClientSession() as s:
+            for _ in range(600):
+                async with s.get(f"{base}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        break
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("multihost model never appeared")
+
+            out = await chat(s, base, "hello multihost")
+            assert out["usage"]["completion_tokens"] == 6
+            mh_text = out["choices"][0]["message"]["content"]
+            # A second request proves lockstep survives (a desynced
+            # follower deadlocks the leader's collectives instead).
+            out2 = await chat(s, base, "hello multihost")
+            assert out2["choices"][0]["message"]["content"] == mh_text
+
+        front_rt.signal_shutdown()
+        front.cancel()
+        await front_rt.shutdown()
+    finally:
+        for p in workers:
+            p.terminate()
+        for p in workers:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        await store.stop()
+
+    # Parity with a single-host worker cluster (same seed).
+    from tests.test_e2e_jax_worker import JaxCluster, _chat as jx_chat
+
+    async with JaxCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            ref = await jx_chat(s, c.base_url, "hello multihost", max_tokens=6)
+            assert ref["choices"][0]["message"]["content"] == mh_text
+
+
+def test_llama3_70b_v5e64_memory_plan():
+    """The 70B north star is PLACEABLE: llama3-70b int8 on a v5e-64
+    (16 hosts x 4 chips) as tp=8 x dp=8 — tp caps at num_kv_heads=8
+    under the GQA sharding (parallel/sharding.py) — fits 16 GiB/chip
+    with a serving KV pool, and the bf16 variant does NOT fit at tp=8
+    (sanity that the plan actually constrains). BASELINE.md north star;
+    placement math in parallel/placement.py from jax.eval_shape of the
+    real init."""
+    from dynamo_tpu.engine.config import EngineConfig, PRESETS
+    from dynamo_tpu.parallel.placement import V5E_HBM_BYTES, memory_plan
+
+    model = PRESETS["llama3-70b"]()
+    # Serving pool: 2048 blocks x 32 tokens = 64k tokens of KV per replica.
+    eng = EngineConfig(num_kv_blocks=1536, block_size=32, max_num_seqs=64,
+                      max_model_len=8192)
+
+    plan = memory_plan(model, eng, tp=8, dp=8, quant="int8")
+    print("70b-int8 tp=8 x dp=8:", plan.describe())
+    assert plan.fits(V5E_HBM_BYTES), plan.describe()
+    # Params must dominate sanely: ~70 GB int8 / 8 chips + replicated
+    # bf16 embeddings ~ 11 GiB.
+    assert 8 * 1024**3 < plan.param_bytes_per_chip < 13 * 1024**3
+
+    # bf16 70B at tp=8 (one host) must NOT fit — ~17.6 GiB of params/chip.
+    bad = memory_plan(model, eng, tp=8, dp=8)
+    assert not bad.fits(V5E_HBM_BYTES), bad.describe()
+
+    # 8B int8 single chip (the shipping config) still fits.
+    plan8 = memory_plan(
+        PRESETS["llama3-8b"](),
+        EngineConfig(num_kv_blocks=256, block_size=32, max_num_seqs=16,
+                     max_model_len=4096),
+        tp=1, quant="int8",
+    )
+    print("8b-int8 tp=1:", plan8.describe())
+    assert plan8.fits(V5E_HBM_BYTES), plan8.describe()
