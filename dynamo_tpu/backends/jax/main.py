@@ -50,6 +50,90 @@ def _prefill_queue(namespace: str) -> str:
     return f"prefill:{namespace}"
 
 
+async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None:
+    """Peer block server: stream the longest locally-held prefix of the
+    requested hash chain (device tier or offload tiers) as raw pages.
+    Cross-worker offload-tier visibility — reference KVBM-distributed
+    leader/worker (block_manager/distributed/leader.rs:64)."""
+
+    async def kv_fetch_handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        hashes = list(request.get("hashes") or [])
+        chunk = int(request.get("chunk_blocks", 32))
+        sent = 0
+        for s in range(0, len(hashes), chunk):
+            pages = await asyncio.to_thread(
+                core.read_cached_pages, hashes[s : s + chunk]
+            )
+            if pages:
+                yield {"version": 2, "start": sent, "kv": pages}
+                sent += len(pages)
+            if len(pages) < min(chunk, len(hashes) - s):
+                break  # hash chains are prefixes: first miss ends it
+        yield {"version": 2, "done": sent}
+
+    ep = runtime.namespace(namespace).component(component).endpoint("kv_fetch")
+    await ep.serve(kv_fetch_handler)
+
+
+async def _pull_peer_prefix(
+    core, fetch_client, hint: dict, token_ids: list[int]
+) -> int:
+    """Pull a better-overlapping peer's cached prefix into the local
+    cache before prefilling (the router attached ``peer_prefix`` because
+    routing could not land on that peer — busy, excluded, sampled away).
+    Best-effort: any failure falls back to local recompute."""
+    import numpy as np
+
+    from dynamo_tpu.tokens import compute_seq_hashes
+
+    bs = core.engine.block_size
+    hashes = compute_seq_hashes(token_ids, bs)
+    cached = await asyncio.to_thread(core.cached_prefix_tokens, token_ids)
+    start = cached // bs
+    want = hashes[start:]
+    if not want:
+        return 0
+    shape = [
+        core.cfg.num_layers, bs, 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
+    ]
+    dtype = np.dtype(core.cfg.jax_dtype).name
+    imported = 0
+    try:
+        # Hard deadline: a stalled peer must degrade to local recompute,
+        # never hang the user's request.
+        async with asyncio.timeout(30.0):
+            stream = await fetch_client.direct(
+                hint["worker_id"], {"hashes": want}
+            )
+            async for frame in stream:
+                if "kv" not in frame:
+                    continue
+                s = frame["start"]
+                blocks = []
+                for j, kv in enumerate(frame["kv"]):
+                    gi = start + s + j
+                    blocks.append({
+                        "hash": hashes[gi],
+                        "parent": hashes[gi - 1] if gi > 0 else None,
+                        "shape": shape,
+                        "dtype": dtype,
+                        "kv": kv,
+                    })
+                res = await asyncio.to_thread(core.import_blocks, blocks)
+                imported += res.imported
+    except Exception:  # noqa: BLE001 — recompute is always correct
+        log.warning(
+            "peer prefix pull from worker %s failed; recomputing locally",
+            hint.get("worker_id"), exc_info=True,
+        )
+    if imported:
+        log.debug(
+            "pulled %d prefix blocks from peer worker %s",
+            imported, hint.get("worker_id"),
+        )
+    return imported
+
+
 def _eos_for(tokenizer: str) -> tuple[int, ...]:
     if tokenizer == "byte":
         from dynamo_tpu.llm.tokenizer import ByteTokenizer
@@ -84,6 +168,7 @@ def build_engine(
     dp: int = 1,
     sp: int = 1,
     quant: str | None = None,
+    moe_dispatch: str | None = None,
     core_cls=None,
     core_kwargs: dict[str, Any] | None = None,
 ):
@@ -116,6 +201,10 @@ def build_engine(
     )
 
     model_cfg = PRESETS[preset]()
+    if moe_dispatch is not None:
+        if not model_cfg.is_moe:
+            raise ValueError(f"--moe-dispatch set but preset {preset!r} is dense")
+        model_cfg = dataclasses.replace(model_cfg, moe_dispatch=moe_dispatch)
     overrides = dict(engine_overrides or {})
     if preset in ("tiny", "tiny-moe"):
         engine_cfg = tiny_engine(**overrides)
@@ -191,6 +280,7 @@ async def run_jax_worker(
     dp: int = 1,
     sp: int = 1,
     quant: str | None = None,
+    moe_dispatch: str | None = None,
     nnodes: int = 1,
     node_rank: int = 0,
 ) -> None:
@@ -249,6 +339,7 @@ async def run_jax_worker(
         dp=dp,
         sp=sp,
         quant=quant,
+        moe_dispatch=moe_dispatch,
     )
 
     if core_out is not None:
@@ -391,6 +482,10 @@ async def run_jax_worker(
         transfer_client = await (
             runtime.namespace(namespace).component("prefill").endpoint("kv_transfer").client()
         )
+        await _serve_kv_fetch(runtime, namespace, component, core)
+        fetch_client = await (
+            runtime.namespace(namespace).component(component).endpoint("kv_fetch").client()
+        )
 
         qname = _prefill_queue(namespace)
 
@@ -404,6 +499,9 @@ async def run_jax_worker(
                 return
             pre = PreprocessedRequest.from_wire(request)
             pre.request_id = pre.request_id or context.id
+            hint = (pre.kv_transfer_params or {}).get("peer_prefix")
+            if hint and hint.get("worker_id") != worker_id:
+                await _pull_peer_prefix(core, fetch_client, hint, list(pre.token_ids))
             cached = await asyncio.to_thread(core.cached_prefix_tokens, pre.token_ids)
             uncached = len(pre.token_ids) - cached
             depth = 0
@@ -446,8 +544,21 @@ async def run_jax_worker(
                 yield out
 
     else:
+        await _serve_kv_fetch(runtime, namespace, component, core)
+        fetch_client = await (
+            runtime.namespace(namespace).component(component).endpoint("kv_fetch").client()
+        )
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            hint = (request.get("kv_transfer_params") or {}).get("peer_prefix")
+            if (
+                hint
+                and hint.get("worker_id") != worker_id
+                and request.get("token_ids")
+            ):
+                await _pull_peer_prefix(
+                    core, fetch_client, hint, list(request["token_ids"])
+                )
             async for out in engine.generate(request, context):
                 yield out
 
@@ -736,6 +847,10 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
+    ap.add_argument("--moe-dispatch", default=None,
+                    choices=["replicated", "alltoall"],
+                    help="EP dispatch mode for MoE presets (alltoall = "
+                         "wide-EP token all-to-all)")
     ap.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel degree (shards heads/mlp over the mesh's tp axis)",
@@ -794,6 +909,10 @@ def main() -> None:
             args.dist_init_addr, args.nnodes, args.node_rank,
             local_cpu_devices=args.local_cpu_devices,
         )
+    elif args.local_cpu_devices:
+        from dynamo_tpu.parallel.multihost import force_cpu_devices
+
+        force_cpu_devices(args.local_cpu_devices)
 
     @dynamo_worker()
     async def entry(runtime: DistributedRuntime) -> None:
@@ -814,6 +933,7 @@ def main() -> None:
             dp=args.dp,
             sp=args.sp,
             quant=args.quant,
+            moe_dispatch=args.moe_dispatch,
             nnodes=args.nnodes,
             node_rank=args.node_rank,
         )

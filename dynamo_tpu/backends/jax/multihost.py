@@ -126,12 +126,16 @@ class LeaderCore(EngineCore):
                     self._inbox.append(self._mh_stage.popleft())
                 done = []
                 for rid, seq in self._mh_known.items():
-                    if getattr(seq, "mh_cancel_pending", False) and not seq.cancelled:
+                    # Finish wins: TpuEngine sets the cancel flag in its
+                    # finally for every completed stream, and a journaled
+                    # cancel for a finished request would just make every
+                    # follower scan for a sequence that no longer exists.
+                    if seq.finish is not None and rid not in self._held:
+                        done.append(rid)
+                    elif getattr(seq, "mh_cancel_pending", False) and not seq.cancelled:
                         seq.cancelled = True
                         ops.append({"op": "cancel", "rid": rid})
                         done.append(rid)
-                    elif seq.finish is not None and rid not in self._held:
-                        done.append(rid)  # finished: no cancel can matter
                 for rid in done:
                     self._mh_known.pop(rid, None)
                 record = {"iter": self._mh_iter, "ops": ops}

@@ -45,6 +45,13 @@ class ModelConfig:
     # ceil(N * top_k / E * factor); tokens past it drop for that expert
     # (Switch/GShard semantics).
     moe_capacity_factor: float = 2.0
+    # EP dispatch mode under a mesh: "replicated" computes every token on
+    # every expert shard and psums (the right trade at serving batch —
+    # weights dominate ICI traffic); "alltoall" shards tokens over the
+    # model axis and all-to-alls them to their expert shards (wide-EP:
+    # the mode for many-host expert fleets, SURVEY.md §2.6 /
+    # dsr1-wideep-h100.md:8).
+    moe_dispatch: str = "replicated"
 
     @property
     def is_moe(self) -> bool:

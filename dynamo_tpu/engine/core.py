@@ -1164,6 +1164,57 @@ class EngineCore:
         pages = fetch_replicated(pages_dev)
         return [np.ascontiguousarray(p).tobytes() for p in pages]
 
+    def read_cached_pages(self, hashes: list[int]) -> list[bytes]:
+        """Non-destructive read of the longest locally-held prefix of a
+        hash chain, for PEER serving (cross-worker offload-tier
+        visibility: another worker pulls this worker's cached prefix
+        instead of recomputing it — reference KVBM-distributed
+        leader/worker, block_manager/distributed/leader.rs:64).
+
+        Device-resident blocks are pinned under ONE step-lock
+        acquisition and gathered in ONE program (the kv_transfer path's
+        batching); offload-tier blocks read from host RAM / disk with no
+        device involvement. Stops at the first hash held nowhere."""
+        where: list[tuple[str, int]] = []  # ("dev", block_idx) | ("off", hash)
+        dev_hashes: list[int] = []
+        pages_dev = None
+        with self._step_lock:
+            dev_ids: list[int] = []
+            for h in hashes:
+                if self.allocator.is_cached(h):
+                    got = self.allocator.acquire_cached([h])  # pins
+                    if got:
+                        where.append(("dev", len(dev_ids)))
+                        dev_ids.append(got[0])
+                        dev_hashes.append(h)
+                        continue
+                if self.offload is not None and self.offload.contains(h):
+                    where.append(("off", h))
+                    continue
+                break
+            if dev_ids:
+                # Pad the gather to the requested chunk width so XLA
+                # compiles one program per chunk size, not per prefix
+                # length (duplicate indices are benign reads).
+                padded = dev_ids + [dev_ids[0]] * (len(hashes) - len(dev_ids))
+                pages_dev = self._gather_pages(
+                    self.cache, jnp.asarray(padded, jnp.int32)
+                )
+        dev_pages = fetch_replicated(pages_dev) if pages_dev is not None else None
+        out: list[bytes] = []
+        for kind, ref in where:
+            if kind == "dev":
+                out.append(np.ascontiguousarray(dev_pages[ref]).tobytes())
+            else:
+                kv = self.offload.peek(ref)
+                if kv is None:
+                    break  # evicted between contains() and peek()
+                out.append(np.ascontiguousarray(kv).tobytes())
+        if dev_hashes:
+            with self._step_lock:
+                self.allocator.release(dev_hashes)
+        return out
+
     def cached_prefix_tokens(self, token_ids: list[int]) -> int:
         """Locally cached leading tokens (disagg local-vs-remote decision)."""
         hashes = compute_seq_hashes(token_ids, self.engine.block_size)

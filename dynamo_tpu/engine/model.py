@@ -337,16 +337,100 @@ def _moe_dispatch_local(xf, w_router, w_gate, w_up, w_down, cfg: ModelConfig,
     return out.astype(xf.dtype)
 
 
+def _moe_dispatch_a2a(xl, w_router, w_gate, w_up, w_down, cfg: ModelConfig,
+                      tp: int, E_local: int):
+    """Token all-to-all EP dispatch over one shard's token slice ``xl``
+    ([n, h]); runs inside shard_map over 'tp'.
+
+    Wide-EP dataflow (SURVEY.md §2.6; the reference deploys it via
+    SGLang's WideEP, dsr1-wideep-h100.md:8): each shard routes its OWN
+    tokens, packs per-destination send buffers (capacity-bounded), and
+    one ``all_to_all`` delivers every token to the shard holding its
+    chosen expert; after the expert SwiGLUs a second ``all_to_all``
+    returns the outputs for the weighted combine at the source. Per-chip
+    activation traffic is O(N/tp * k) instead of the replicated path's
+    O(N) broadcast compute — the winning trade once E and the host count
+    grow past what weight-resident replication can carry.
+
+    Drop semantics differ from the replicated path: capacity binds
+    per (source, destination) pair here vs per expert there, so the two
+    modes are bit-identical only while nothing overflows (generous
+    ``moe_capacity_factor``); under saturation both drop, differently.
+    """
+    n, h = xl.shape
+    k = cfg.num_experts_per_tok
+    # Per-destination send capacity from this shard.
+    Cs = max(1, min(n * k, int(-(-n * k * cfg.moe_capacity_factor // tp))))
+
+    router = jnp.dot(xl, w_router, preferred_element_type=jnp.float32)  # [n, E]
+    vals, idx = jax.lax.top_k(router, k)
+    probs = jax.nn.softmax(vals, axis=-1)
+
+    flat_e = idx.reshape(-1)                                # [n*k] global ids
+    flat_t = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    flat_w = probs.reshape(-1)
+    dest = flat_e // E_local                                # [n*k] dest shard
+
+    onehot = dest[:, None] == jnp.arange(tp)[None, :]
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    keep = pos < Cs
+    d_c = jnp.where(keep, dest, tp).astype(jnp.int32)
+    p_c = jnp.where(keep, pos, Cs).astype(jnp.int32)
+
+    send_x = jnp.zeros((tp + 1, Cs + 1, h), xl.dtype).at[d_c, p_c].set(xl[flat_t])
+    send_e = jnp.full((tp + 1, Cs + 1), -1, jnp.int32).at[d_c, p_c].set(
+        (flat_e % E_local).astype(jnp.int32)
+    )
+    recv_x = jax.lax.all_to_all(send_x[:tp, :Cs], "tp", 0, 0, tiled=True)
+    recv_e = jax.lax.all_to_all(send_e[:tp, :Cs], "tp", 0, 0, tiled=True)
+
+    # Local expert compute over everything received ([M, h], M = tp*Cs).
+    # No second capacity bound: the buffers are already source-bounded.
+    M = tp * Cs
+    r_x = recv_x.reshape(M, h)
+    r_e = recv_e.reshape(M)
+    valid = r_e >= 0
+    onehot2 = (r_e[:, None] == jnp.arange(E_local)[None, :]) & valid[:, None]
+    pos2 = jnp.sum(jnp.cumsum(onehot2, axis=0) * onehot2, axis=1) - 1
+    e_c2 = jnp.where(valid, r_e, E_local).astype(jnp.int32)
+    p_c2 = jnp.where(valid, pos2, M).astype(jnp.int32)
+
+    gathered = jnp.zeros((E_local + 1, M + 1, h), xl.dtype).at[e_c2, p_c2].set(r_x)
+    g = gathered[:E_local, :M]
+    gate = jnp.einsum("ech,ehi->eci", g, w_gate, preferred_element_type=jnp.float32)
+    up = jnp.einsum("ech,ehi->eci", g, w_up, preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(xl.dtype)
+    down = jnp.einsum("eci,eih->ech", act, w_down, preferred_element_type=jnp.float32)
+
+    down_pad = jnp.pad(down, ((0, 1), (0, 1), (0, 0)))
+    out_entries = down_pad[e_c2, p_c2].astype(xl.dtype)     # [M, h]
+    back = jax.lax.all_to_all(
+        out_entries.reshape(tp, Cs, h), "tp", 0, 0, tiled=True
+    )
+    back_pad = jnp.pad(back, ((0, 1), (0, 1), (0, 0)))
+    entry_vals = back_pad[d_c, p_c]                         # [n*k, h]
+    w_masked = jnp.where(keep, flat_w, 0.0)
+    out = jnp.zeros((n, h), jnp.float32).at[flat_t].add(
+        w_masked[:, None] * entry_vals.astype(jnp.float32)
+    )
+    return out.astype(xl.dtype)
+
+
 def _moe_mlp(x, lp, cfg: ModelConfig, mesh=None):
     """Mixtral-style sparse MoE: softmax over top-k router logits, weighted
     sum of expert SwiGLUs, sparse capacity-bounded dispatch.
 
     Under expert parallelism (mesh given, experts sharded over the model
-    axis — parallel/sharding.py) each device dispatches to its LOCAL
-    experts only and the partial token outputs psum over 'tp'. Tokens are
-    not all-to-all'ed: activations ride the replicated path while expert
-    weights stay resident per shard — the right trade on ICI at serving
-    batch sizes (weights dominate traffic).
+    axis — parallel/sharding.py), two dispatch modes
+    (``cfg.moe_dispatch``):
+
+    - ``"replicated"`` (default): every device sees all tokens, computes
+      its LOCAL experts' contributions, psums over 'tp'. Activations ride
+      replicated while expert weights stay resident per shard — the right
+      trade on ICI at serving batch sizes (weights dominate traffic).
+    - ``"alltoall"``: tokens shard over 'tp' and travel to their experts
+      (``_moe_dispatch_a2a``) — the wide-EP mode for expert fleets too
+      large to make every shard compute every token.
     """
     shape = x.shape
     xf = x.reshape(-1, shape[-1])  # [N, h]
@@ -363,6 +447,25 @@ def _moe_mlp(x, lp, cfg: ModelConfig, mesh=None):
 
     tp = int(mesh.shape["tp"])
     E_local = E // tp
+
+    if cfg.moe_dispatch == "alltoall":
+        N = xf.shape[0]
+        pad = (-N) % tp  # token axis must split evenly over 'tp'
+        xp = jnp.pad(xf, ((0, pad), (0, 0)))
+
+        def a2a_fn(xr, w_router, w_gate, w_up, w_down):
+            return _moe_dispatch_a2a(
+                xr, w_router, w_gate, w_up, w_down, cfg, tp, E_local
+            )
+
+        out = jax.shard_map(
+            a2a_fn,
+            mesh=mesh,
+            in_specs=(P("tp"), P(), P("tp"), P("tp"), P("tp")),
+            out_specs=P("tp"),
+            check_vma=False,
+        )(xp, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"])
+        return out[:N].reshape(shape)
 
     def local_fn(xr, w_router, w_gate, w_up, w_down):
         off = jax.lax.axis_index("tp") * E_local

@@ -106,6 +106,18 @@ class DiskKvPool:
             self.stats.onboards += 1
             return parent, kv
 
+    def peek(self, block_hash: int) -> np.ndarray | None:
+        """Non-destructive read (peer-serving: the block stays resident)."""
+        with self._lock:
+            if block_hash not in self._index:
+                return None
+            self._index.move_to_end(block_hash)
+            try:
+                return np.load(self._path(block_hash))
+            except OSError:
+                log.warning("disk tier: failed to load block %x", block_hash)
+                return None
+
 
 class OffloadEngine:
     """Background transfer worker between the KV tiers.
@@ -186,6 +198,19 @@ class OffloadEngine:
                 return blk.parent_hash, blk.kv
         if self.disk is not None:
             return self.disk.pop(block_hash)
+        return None
+
+    def peek(self, block_hash: int) -> np.ndarray | None:
+        """Non-destructive read of a tiered block's page (peer-serving —
+        the block stays where it is); waits out an in-flight transfer."""
+        with self._cond:
+            while block_hash in self._pending:
+                self._cond.wait(timeout=30)
+            blk = self.host.get(block_hash)
+            if blk is not None:
+                return blk.kv
+        if self.disk is not None:
+            return self.disk.peek(block_hash)
         return None
 
     def flush(self) -> None:

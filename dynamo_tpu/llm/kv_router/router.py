@@ -97,6 +97,7 @@ class KvRouter:
         result = self.selector.select_worker(
             workers, overlaps, len(token_ids), self.active, config
         )
+        result.overlaps = dict(overlaps)
         self.active.add_request(
             request_id, result.worker_id, len(token_ids), result.overlap_blocks
         )
@@ -185,6 +186,21 @@ class KvPushRouter:
             selection = self.router.find_best_match(request_id, token_ids, workers, config)
         payload = dict(payload)
         payload.setdefault("meta", {})["overlap_blocks"] = selection.overlap_blocks
+        # Cross-worker prefix pull (reference KVBM-distributed semantics,
+        # block_manager/distributed/leader.rs:64): when routing lands on
+        # a worker with LESS of this prompt cached than some peer —
+        # busy-avoidance, temperature sampling, migration exclusion — the
+        # hint lets the chosen worker pull the peer's blocks (device or
+        # offload tiers) over the data plane instead of recomputing.
+        if selection.overlaps:
+            peer, blocks = max(
+                selection.overlaps.items(), key=lambda kv: kv[1]
+            )
+            if peer != selection.worker_id and blocks > selection.overlap_blocks:
+                payload["kv_transfer_params"] = dict(
+                    payload.get("kv_transfer_params") or {},
+                    peer_prefix={"worker_id": peer, "blocks": blocks},
+                )
 
         first = True
         try:

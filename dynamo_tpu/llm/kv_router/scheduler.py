@@ -32,6 +32,10 @@ class SelectionResult:
     overlap_blocks: int
     required_prefill_tokens: int
     costs: dict[int, float]
+    # Per-worker cached-prefix overlap (blocks) as the indexer saw it at
+    # selection time — lets the caller detect a better-overlapping PEER
+    # than the chosen worker (cross-worker prefix pull).
+    overlaps: dict[int, int] = None  # type: ignore[assignment]
 
 
 class WorkerSelector(Protocol):

@@ -39,10 +39,7 @@ def init_multihost(
     import jax
 
     if local_cpu_devices:
-        # The TPU PJRT plugin ignores the JAX_PLATFORMS env var; the
-        # config update is the authoritative switch.
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(local_cpu_devices))
+        force_cpu_devices(local_cpu_devices)
     jax.distributed.initialize(
         coordinator, num_processes=num_processes, process_id=process_id
     )
@@ -51,6 +48,17 @@ def init_multihost(
         process_id, num_processes,
         len(jax.local_devices()), len(jax.devices()),
     )
+
+
+def force_cpu_devices(n: int) -> None:
+    """Virtual-device validation mode: N CPU devices stand in for a
+    multi-chip host. The TPU PJRT plugin ignores the JAX_PLATFORMS env
+    var; the config update is the authoritative switch. Call BEFORE any
+    other jax use."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", int(n))
 
 
 def fetch_replicated(x) -> np.ndarray:

