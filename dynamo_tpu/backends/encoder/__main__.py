@@ -1,0 +1,3 @@
+from dynamo_tpu.backends.encoder.main import main
+
+main()

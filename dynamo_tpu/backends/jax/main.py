@@ -29,9 +29,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import dataclasses
-import json
 import logging
 import uuid
+
+import msgpack
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
@@ -57,8 +58,21 @@ async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None
     leader/worker (block_manager/distributed/leader.rs:64)."""
 
     async def kv_fetch_handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        import numpy as np
+
         hashes = list(request.get("hashes") or [])
         chunk = int(request.get("chunk_blocks", 32))
+        # Page geometry first (the kv_transfer descriptor pattern): the
+        # consumer must parse our bytes with OUR layout, not assume its
+        # own (cross-precision fleets).
+        yield {
+            "version": 2,
+            "shape": [
+                core.cfg.num_layers, core.engine.block_size,
+                2 * core.cfg.num_kv_heads, core.cfg.head_dim,
+            ],
+            "dtype": np.dtype(core.cfg.jax_dtype).name,
+        }
         sent = 0
         for s in range(0, len(hashes), chunk):
             pages = await asyncio.to_thread(
@@ -93,6 +107,8 @@ async def _pull_peer_prefix(
     want = hashes[start:]
     if not want:
         return 0
+    # Defaults overridden by the server's geometry frame (a peer on a
+    # different precision reports its own dtype; import_blocks casts).
     shape = [
         core.cfg.num_layers, bs, 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
     ]
@@ -106,6 +122,9 @@ async def _pull_peer_prefix(
                 hint["worker_id"], {"hashes": want}
             )
             async for frame in stream:
+                if "shape" in frame:
+                    shape = list(frame["shape"])
+                    dtype = frame["dtype"]
                 if "kv" not in frame:
                     continue
                 s = frame["start"]
@@ -132,6 +151,59 @@ async def _pull_peer_prefix(
             imported, hint.get("worker_id"),
         )
     return imported
+
+
+async def _resolve_mm(core, encode_client, embed_fetch_client, request: dict) -> None:
+    """Resolve a request's image refs to embedding rows IN PLACE.
+
+    Preferred path: the encoder fleet (reference
+    examples/multimodal/encode_worker.py) — encode returns a descriptor,
+    the tensor is pulled by id over the data plane. No encoder fleet (or
+    a failure) falls back to encoding in-process: single-worker
+    deployments stay multimodal."""
+    import numpy as np
+
+    from dynamo_tpu.llm.multimodal import image_bytes, patch_embed
+
+    mm = request.get("mm")
+    if not mm or mm.get("embeds") is not None or not mm.get("images"):
+        return
+    h = core.cfg.hidden_size
+    use_fleet = encode_client is not None and encode_client.instance_ids()
+
+    async def one(ref: str):
+        if use_fleet:
+            try:
+                async with asyncio.timeout(30.0):
+                    desc = None
+                    stream = await encode_client.round_robin(
+                        {"image": ref, "hidden_size": h}
+                    )
+                    async for out in stream:
+                        desc = out
+                    data = None
+                    if desc and "embed_id" in desc:
+                        fstream = await embed_fetch_client.direct(
+                            desc["worker_id"], {"embed_id": desc["embed_id"]}
+                        )
+                        async for out in fstream:
+                            data = out.get("data", data)
+                    if data is None:
+                        raise ConnectionError("encoder returned no embedding")
+                    return np.frombuffer(data, np.float32).reshape(
+                        tuple(desc["shape"])
+                    )
+            except Exception:  # noqa: BLE001 — local encode is equivalent
+                log.warning("encoder fleet failed; encoding locally", exc_info=True)
+        return await asyncio.to_thread(patch_embed, image_bytes(ref), h)
+
+    # Per-image resolutions are independent: run them concurrently (one
+    # fleet round-trip bounds the latency, not one per image).
+    embeds = await asyncio.gather(*(one(ref) for ref in mm["images"]))
+    allemb = np.concatenate(list(embeds), axis=0).astype(np.float32)
+    request["mm"] = dict(
+        mm, embeds=allemb.tobytes(), embeds_shape=list(allemb.shape)
+    )
 
 
 def _eos_for(tokenizer: str) -> tuple[int, ...]:
@@ -303,7 +375,7 @@ async def run_jax_worker(
         return await _run_multihost(
             runtime, model_name, preset, namespace, component,
             engine_overrides, tokenizer, seed, served_event, core_out,
-            tp, dp, quant, nnodes, node_rank,
+            tp, dp, quant, moe_dispatch, nnodes, node_rank,
         )
     worker_id = runtime.primary_lease_id
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
@@ -349,6 +421,15 @@ async def run_jax_worker(
         runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
     )
     await metrics_pub.start()
+
+    # Multimodal: encoder-fleet clients (idle watches when no encoder
+    # component is deployed; _resolve_mm falls back to local encode).
+    encode_client = await (
+        runtime.namespace(namespace).component("encoder").endpoint("encode").client()
+    )
+    embed_fetch_client = await (
+        runtime.namespace(namespace).component("encoder").endpoint("embed_fetch").client()
+    )
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
@@ -422,7 +503,9 @@ async def run_jax_worker(
                 # expires instead of living in the store forever.
                 lease = await runtime.store.lease_grant(ttl=60.0, keepalive=False)
                 await runtime.store.kv_put(
-                    task["reply_key"], json.dumps(last).encode(), lease=lease
+                    task["reply_key"],
+                    msgpack.packb(last, use_bin_type=True),
+                    lease=lease
                 )
             except Exception:
                 log.exception("queued prefill failed")
@@ -430,7 +513,9 @@ async def run_jax_worker(
                     lease = await runtime.store.lease_grant(ttl=60.0, keepalive=False)
                     await runtime.store.kv_put(
                         task["reply_key"],
-                        json.dumps({"error": "remote prefill failed"}).encode(),
+                        msgpack.packb(
+                            {"error": "remote prefill failed"}, use_bin_type=True
+                        ),
                         lease=lease,
                     )
                 except Exception:  # noqa: BLE001 — store down; caller times out
@@ -452,8 +537,8 @@ async def run_jax_worker(
                     sem.release()
                     continue
                 try:
-                    task = json.loads(payload)
-                except ValueError:
+                    task = msgpack.unpackb(payload, raw=False)
+                except (ValueError, msgpack.UnpackException):
                     log.warning("dropping malformed prefill task")
                     sem.release()
                     continue
@@ -497,6 +582,7 @@ async def run_jax_worker(
                 async for out in engine.generate(request, context):
                     yield out
                 return
+            await _resolve_mm(core, encode_client, embed_fetch_client, request)
             pre = PreprocessedRequest.from_wire(request)
             pre.request_id = pre.request_id or context.id
             hint = (pre.kv_transfer_params or {}).get("peer_prefix")
@@ -550,6 +636,7 @@ async def run_jax_worker(
         )
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            await _resolve_mm(core, encode_client, embed_fetch_client, request)
             hint = (request.get("kv_transfer_params") or {}).get("peer_prefix")
             if (
                 hint
@@ -587,6 +674,7 @@ async def _run_multihost(
     tp: int,
     dp: int,
     quant: str | None,
+    moe_dispatch: str | None,
     nnodes: int,
     node_rank: int,
 ) -> None:
@@ -645,7 +733,7 @@ async def _run_multihost(
         core, engine = await asyncio.to_thread(
             build_engine, preset, engine_overrides, seed=seed,
             eos_token_ids=eos, on_stored=on_stored, on_removed=on_removed,
-            tp=tp, dp=dp, quant=quant,
+            tp=tp, dp=dp, quant=quant, moe_dispatch=moe_dispatch,
             core_cls=LeaderCore, core_kwargs={"publish": publish},
         )
         if core_out is not None:
@@ -682,6 +770,7 @@ async def _run_multihost(
     core, _engine = await asyncio.to_thread(
         build_engine, preset, engine_overrides, seed=seed,
         eos_token_ids=eos, tp=tp, dp=dp, quant=quant,
+        moe_dispatch=moe_dispatch,
     )
     if core_out is not None:
         core_out.append(core)
@@ -730,16 +819,20 @@ async def _remote_prefill_then_decode(
     sub = await store.kv_watch(reply_key, with_initial=False)
     first: dict | None = None
     try:
+        # msgpack, not json: multimodal requests carry raw embedding
+        # bytes which json cannot represent (and the data plane is
+        # msgpack everywhere else).
         await store.queue_push(
             qname,
-            json.dumps(
-                {"request": prefill_req.to_wire(), "reply_key": reply_key}
-            ).encode(),
+            msgpack.packb(
+                {"request": prefill_req.to_wire(), "reply_key": reply_key},
+                use_bin_type=True,
+            ),
         )
         ev = await sub.get(timeout=reply_timeout)
         event = StoreClient.as_watch_event(ev)
         if event.value is not None:
-            first = json.loads(event.value)
+            first = msgpack.unpackb(event.value, raw=False)
     finally:
         await sub.unsubscribe()
         await store.kv_del(reply_key)

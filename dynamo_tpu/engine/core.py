@@ -90,6 +90,10 @@ class Sequence:
     # Disaggregation: a remote-decode prefill holds its blocks after finish
     # until the decode worker pulls them (reference disagg_serving.md flow).
     hold_blocks: bool = False
+    # Multimodal: encoder output rows to splice over placeholder prompt
+    # positions ([n_total, h] f32) and their [start, count] spans.
+    mm_embeds: Any = None
+    mm_positions: list | None = None
 
     @property
     def prompt_len(self) -> int:
@@ -228,16 +232,21 @@ def _ring_prefill_and_sample(
 def _prefill_and_sample(
     params, cache, tokens, positions, write_pages, write_offs,
     kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
-    seeds, counters, temperature, top_k, top_p,
-    *, need_mask, all_greedy=False, want_logprobs=False, cfg, engine, mesh=None,
+    seeds, counters, temperature, top_k, top_p, mm_embeds, mm_mask,
+    *, need_mask, all_greedy=False, want_logprobs=False, want_mm=False,
+    cfg, engine, mesh=None,
 ):
     """One ragged prefill wave + fused first-token sampling: every row of
     the [S, vocab] last-token logits is sampled on-device; the host keeps
-    only rows whose prompt completed this wave."""
+    only rows whose prompt completed this wave. ``want_mm`` (a separate
+    compiled variant) splices multimodal embedding rows over placeholder
+    positions (llm/multimodal.py)."""
     logits, cache = forward_tokens(
         params, cache, tokens, positions, write_pages, write_offs,
         kv_lens, block_tables, cu_q_lens, num_seqs, last_rows,
         cfg, engine, mesh,
+        mm_embeds=mm_embeds if want_mm else None,
+        mm_mask=mm_mask if want_mm else None,
     )
     toks = _sample_from_logits(
         logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
@@ -394,7 +403,7 @@ class EngineCore:
 
         self._prefill = jax.jit(
             partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
-            static_argnames=("need_mask", "all_greedy", "want_logprobs"),
+            static_argnames=("need_mask", "all_greedy", "want_logprobs", "want_mm"),
             donate_argnums=(1,),
         )
         self.sp_mesh = sp_mesh
@@ -455,6 +464,26 @@ class EngineCore:
             )
         if (pre.kv_transfer_params or {}).get("do_remote_decode"):
             seq.hold_blocks = True
+        if pre.mm and pre.mm.get("embeds") is not None:
+            embeds = np.frombuffer(pre.mm["embeds"], np.float32).reshape(
+                tuple(pre.mm["embeds_shape"])
+            )
+            if embeds.shape[1] != self.cfg.hidden_size:
+                raise ValueError(
+                    f"multimodal embeds of width {embeds.shape[1]} != "
+                    f"hidden_size {self.cfg.hidden_size}"
+                )
+            positions = [list(p) for p in pre.mm["positions"]]
+            need_rows = sum(cnt for _, cnt in positions)
+            if embeds.shape[0] < need_rows:
+                # Reject HERE, not as an IndexError inside the prefill
+                # wave (which would fail every co-scheduled request).
+                raise ValueError(
+                    f"multimodal embeds have {embeds.shape[0]} rows but the "
+                    f"placeholder spans need {need_rows}"
+                )
+            seq.mm_embeds = embeds
+            seq.mm_positions = positions
         self._enqueue(seq)
         return seq
 
@@ -654,6 +683,30 @@ class EngineCore:
         want_lp = any(s.logprobs is not None for s, _ in chosen)
         all_greedy = all(s.sampling.temperature == 0.0 for s, _ in chosen)
 
+        # Multimodal splice (separate compiled variant): override rows
+        # whose prompt position falls inside an image span with the
+        # encoder's embedding for that patch.
+        want_mm = any(s.mm_embeds is not None for s, _ in chosen)
+        if want_mm:
+            mm_embeds = np.zeros((T, self.cfg.hidden_size), np.float32)
+            mm_mask = np.zeros(T, bool)
+            t0 = 0
+            for seq, chunk in chosen:
+                if seq.mm_embeds is not None:
+                    lo, hi = seq.prefilled, seq.prefilled + chunk
+                    row = 0
+                    for start, cnt in seq.mm_positions:
+                        for j in range(cnt):
+                            p = start + j
+                            if lo <= p < hi:
+                                mm_embeds[t0 + (p - lo)] = seq.mm_embeds[row]
+                                mm_mask[t0 + (p - lo)] = True
+                            row += 1
+                t0 += chunk
+        else:  # tiny dummies: the want_mm=False variant never reads them
+            mm_embeds = np.zeros((1, 1), np.float32)
+            mm_mask = np.zeros(1, bool)
+
         toks, lps, self.cache = self._prefill(
             self.params,
             self.cache,
@@ -671,9 +724,12 @@ class EngineCore:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            jnp.asarray(mm_embeds),
+            jnp.asarray(mm_mask),
             need_mask=need_mask and not all_greedy,
             all_greedy=all_greedy,
             want_logprobs=want_lp,
+            want_mm=want_mm,
         )
         toks = fetch_replicated(toks)
         lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
@@ -704,6 +760,8 @@ class EngineCore:
         for seq in prefills:
             if seq.prefilled or seq.committed_blocks:
                 continue  # cached prefix / mid-flight: paged waves own it
+            if seq.mm_embeds is not None:
+                continue  # multimodal splice is a paged-wave variant only
             if seq.prompt_len < self.engine.ring_prefill_threshold:
                 continue
             try:
@@ -1200,20 +1258,26 @@ class EngineCore:
                 pages_dev = self._gather_pages(
                     self.cache, jnp.asarray(padded, jnp.int32)
                 )
-        dev_pages = fetch_replicated(pages_dev) if pages_dev is not None else None
-        out: list[bytes] = []
-        for kind, ref in where:
-            if kind == "dev":
-                out.append(np.ascontiguousarray(dev_pages[ref]).tobytes())
-            else:
-                kv = self.offload.peek(ref)
-                if kv is None:
-                    break  # evicted between contains() and peek()
-                out.append(np.ascontiguousarray(kv).tobytes())
-        if dev_hashes:
-            with self._step_lock:
-                self.allocator.release(dev_hashes)
-        return out
+        try:
+            dev_pages = (
+                fetch_replicated(pages_dev) if pages_dev is not None else None
+            )
+            out: list[bytes] = []
+            for kind, ref in where:
+                if kind == "dev":
+                    out.append(np.ascontiguousarray(dev_pages[ref]).tobytes())
+                else:
+                    kv = self.offload.peek(ref)
+                    if kv is None:
+                        break  # evicted between contains() and peek()
+                    out.append(np.ascontiguousarray(kv).tobytes())
+            return out
+        finally:
+            # A raise anywhere above must not leave pins behind — leaked
+            # refcounts would gradually pin the whole pool.
+            if dev_hashes:
+                with self._step_lock:
+                    self.allocator.release(dev_hashes)
 
     def cached_prefix_tokens(self, token_ids: list[int]) -> int:
         """Locally cached leading tokens (disagg local-vs-remote decision)."""

@@ -525,6 +525,8 @@ def forward_tokens(
     cfg: ModelConfig,
     engine: EngineConfig,
     mesh=None,
+    mm_embeds=None,          # [T, h] — multimodal rows (override where mask)
+    mm_mask=None,            # [T] bool
 ) -> tuple[jax.Array, jax.Array]:
     """One step over every scheduled token. Returns (last-token logits
     [S, vocab] f32, cache). Prefill chunks, decode tokens, and mixed
@@ -534,6 +536,7 @@ def forward_tokens(
     x, cache = forward_hidden(
         params, cache, tokens, positions, write_pages, write_offs,
         kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine, mesh,
+        mm_embeds=mm_embeds, mm_mask=mm_mask,
     )
     last = x[last_rows]  # [S, h]
     return _logits(last, params, cfg), cache
@@ -553,15 +556,23 @@ def forward_hidden(
     cfg: ModelConfig,
     engine: EngineConfig,
     mesh=None,
+    mm_embeds=None,
+    mm_mask=None,
 ) -> tuple[jax.Array, jax.Array]:
     """The transformer stack up to the final norm: returns (hidden states
     [T, h], cache). Shared by the logits path (:func:`forward_tokens`)
     and the embeddings path (reference serves /v1/embeddings through its
-    engines, http/service/service_v2.rs:277-336)."""
+    engines, http/service/service_v2.rs:277-336).
+
+    ``mm_embeds``/``mm_mask`` (a separately-compiled prefill variant)
+    override the token-embedding rows at multimodal placeholder
+    positions with encoder output (llm/multimodal.py)."""
     T = tokens.shape[0]
     tp = int(mesh.shape["tp"]) if mesh is not None else 1
     sm_scale = cfg.head_dim ** -0.5
     x = params["embed"][tokens]  # [T, h]
+    if mm_embeds is not None:
+        x = jnp.where(mm_mask[:, None], mm_embeds.astype(x.dtype), x)
     lp_all = params["layers"]
 
     for l in range(cfg.num_layers):

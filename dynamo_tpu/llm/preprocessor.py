@@ -43,12 +43,24 @@ class OpenAIPreprocessor:
     # -- request side ------------------------------------------------------
 
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        from dynamo_tpu.llm.multimodal import split_images, splice_pseudo_tokens
+
+        messages = [m.model_dump(exclude_none=True) for m in request.messages]
+        vocab = getattr(self.tokenizer, "vocab_size", 32000)
+        messages, image_refs = split_images(messages, vocab)
         prompt = self.tokenizer.apply_chat_template(
-            [m.model_dump(exclude_none=True) for m in request.messages],
-            add_generation_prompt=True,
+            messages, add_generation_prompt=True
         )
         token_ids = self.tokenizer.encode(prompt)
-        return self._build(request, token_ids)
+        mm = None
+        if image_refs:
+            token_ids, positions = splice_pseudo_tokens(
+                token_ids, image_refs, vocab, self.tokenizer.encode
+            )
+            mm = {"images": image_refs, "positions": positions}
+        pre = self._build(request, token_ids)
+        pre.mm = mm
+        return pre
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
         prompt = request.prompt

@@ -105,6 +105,12 @@ class PreprocessedRequest:
     kv_transfer_params: dict[str, Any] | None = None
     annotations: list[str] = field(default_factory=list)
     request_id: str | None = None
+    # Multimodal: {"images": [ref, ...], "positions": [[start, count],
+    # ...]} — token_ids carry content-fingerprint pseudo ids at those
+    # positions; the worker resolves refs to embeddings (encoder fleet)
+    # and the engine splices them over the placeholder rows
+    # (llm/multimodal.py; reference examples/multimodal pipeline).
+    mm: dict[str, Any] | None = None
 
     def to_wire(self) -> dict:
         return asdict(self)
@@ -121,6 +127,7 @@ class PreprocessedRequest:
             kv_transfer_params=d.get("kv_transfer_params"),
             annotations=d.get("annotations", []),
             request_id=d.get("request_id"),
+            mm=d.get("mm"),
         )
 
 
