@@ -47,10 +47,11 @@ class Config:
     primary: bool = False
     model: str | None = None   # preset override (default: flagship 1b)
     quant: bool = False        # int8 weight-only quantization
-    # Measured repetitions (best kept): the shared-relay chip shows ±30%
-    # run-to-run latency noise; best-of-N measures the hardware, not the
-    # relay's weather.
-    reps: int = 2
+    # Measured repetitions: the shared-relay chip shows ±30% run-to-run
+    # latency noise. The headline (value / vs_baseline) is the MEDIAN of
+    # N reps — an honest order statistic; *_best fields carry best-of-N
+    # (which isolates the hardware from the relay's weather) alongside.
+    reps: int = 3
 
 
 CONFIGS = [
@@ -146,18 +147,6 @@ def run_config(cfg_model, c: Config) -> dict:
     core.add_request(req(99991, eng.decode_chain))
     drain(2)
 
-    best = None
-    for rep in range(max(1, c.reps)):
-        for i in range(c.batch):
-            core.add_request(req(rep * 100000 + i, c.osl))
-        tokens, elapsed, first, tpots = drain(c.batch)
-        if best is None or tokens / elapsed > best[0] / best[1]:
-            best = (tokens, elapsed, first, tpots)
-    tokens, elapsed, first, tpots = best
-    del core
-
-    throughput = tokens / elapsed
-
     # Decode roofline: per step, weights + live KV of the batch stream
     # from HBM. Mean context during decode = ISL + OSL/2.
     kv_bytes_per_tok = (
@@ -170,27 +159,59 @@ def run_config(cfg_model, c: Config) -> dict:
     step_bytes = pbytes + c.batch * mean_ctx * kv_bytes_per_tok
     roofline = c.batch / (step_bytes / (HBM_GBPS * 1e9))
 
-    # vs_baseline compares the DECODE phase against the decode roofline
-    # (the roofline models decode HBM traffic only): decode window = end
-    # of the last prefill (every request's first token is prefill-
-    # sampled) to the last token.
-    decode_time = max(elapsed - max(first.values()), 1e-9)
-    decode_tok_s = (tokens - len(first)) / decode_time
+    reps = []
+    for rep in range(max(1, c.reps)):
+        for i in range(c.batch):
+            core.add_request(req(rep * 100000 + i, c.osl))
+        tokens, elapsed, first, tpots = drain(c.batch)
+        # vs_baseline compares the DECODE phase against the decode
+        # roofline (the roofline models decode HBM traffic only): decode
+        # window = end of the last prefill (every request's first token
+        # is prefill-sampled) to the last token.
+        decode_time = max(elapsed - max(first.values()), 1e-9)
+        decode_tok_s = (tokens - len(first)) / decode_time
+        ttfts = sorted(first.values())
+        reps.append({
+            "value": tokens / elapsed,
+            "decode_tok_s": decode_tok_s,
+            "vs_baseline": decode_tok_s / roofline,
+            "ttft_p50": ttfts[len(ttfts) // 2],
+            "tpot_p50": sorted(tpots)[len(tpots) // 2] if tpots else None,
+        })
+    del core
 
-    ttfts = sorted(first.values())
+    # Median rep (by end-to-end throughput; lower-middle for even N so
+    # the headline never benefits from the rounding) + best rep.
+    ordered = sorted(reps, key=lambda r: r["value"])
+    med = ordered[(len(ordered) - 1) // 2]
+    best = ordered[-1]
     return {
         "metric": (
             f"{cfg_model.name}{'-int8' if c.quant else ''} agg tokens/sec/chip "
             f"({c.name}: B={c.batch}, {c.isl}/{c.osl})"
         ),
-        "value": round(throughput, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": round(decode_tok_s / roofline, 4),
-        "decode_tok_s": round(decode_tok_s, 1),
-        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+        "value": round(med["value"], 1),
+        "unit": "tokens/sec (median of %d reps; *_best = best rep)" % len(reps),
+        "vs_baseline": round(med["vs_baseline"], 4),
+        "value_best": round(best["value"], 1),
+        "vs_baseline_best": round(best["vs_baseline"], 4),
+        "decode_tok_s": round(med["decode_tok_s"], 1),
+        "decode_tok_s_best": round(best["decode_tok_s"], 1),
+        "ttft_p50_ms": round(med["ttft_p50"] * 1e3, 1),
         "tpot_p50_ms": (
-            round(sorted(tpots)[len(tpots) // 2] * 1e3, 2) if tpots else None
+            round(med["tpot_p50"] * 1e3, 2) if med["tpot_p50"] is not None else None
         ),
+        # Metric derivation, per config (VERDICT r4 weak #2): vs_baseline
+        # = decode_tok_s / roofline_tok_s, where roofline = B / (weights
+        # + live-KV bytes per step / HBM_GBPS).
+        "derivation": {
+            "roofline_tok_s": round(roofline, 1),
+            "step_gb": round(step_bytes / 1e9, 3),
+            "param_gb": round(pbytes / 1e9, 3),
+            "kv_gb_per_step": round(c.batch * mean_ctx * kv_bytes_per_tok / 1e9, 3),
+            "hbm_gbps": HBM_GBPS,
+            "decode_window": "last prefill-sampled token -> last token",
+        },
     }
 
 

@@ -239,6 +239,7 @@ def build_engine(
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     quant: str | None = None,
     moe_dispatch: str | None = None,
     core_cls=None,
@@ -284,6 +285,21 @@ def build_engine(
         engine_cfg = EngineConfig(**overrides) if overrides else EngineConfig()
     mesh = None
     sp_mesh = None
+    pp_mesh = None
+    if pp > 1:
+        if tp * dp > 1 or sp > 1:
+            raise ValueError("--pp is mutually exclusive with --tp/--dp/--sp for now")
+        from dynamo_tpu.parallel.pipeline import make_pp_mesh
+
+        pp_mesh = make_pp_mesh(pp)
+        # Prefill buckets and decode widths must split into pp microbatch
+        # groups (EngineCore validates; pre-trim decode widths here the
+        # same way dp does below).
+        buckets = tuple(b for b in engine_cfg.decode_buckets if b % pp == 0)
+        if buckets != engine_cfg.decode_buckets:
+            if not buckets:
+                buckets = (pp * max(1, engine_cfg.decode_buckets[-1] // pp),)
+            engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
     if sp > 1:
         if tp * dp > 1:
             raise ValueError("--sp is mutually exclusive with --tp/--dp for now")
@@ -311,7 +327,7 @@ def build_engine(
             engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
     params = None
     if quant == "int8":
-        if mesh is not None:
+        if mesh is not None or pp_mesh is not None:
             raise ValueError("int8 quantization is single-chip for now")
         import jax
 
@@ -330,6 +346,7 @@ def build_engine(
         on_removed=on_removed,
         mesh=mesh,
         sp_mesh=sp_mesh,
+        pp_mesh=pp_mesh,
         **(core_kwargs or {}),
     )
     return core, TpuEngine(core)
@@ -351,6 +368,7 @@ async def run_jax_worker(
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     quant: str | None = None,
     moe_dispatch: str | None = None,
     nnodes: int = 1,
@@ -368,6 +386,10 @@ async def run_jax_worker(
         if sp > 1:
             raise ValueError(
                 "--sp (ring prefill) is not supported under --nnodes yet"
+            )
+        if pp > 1:
+            raise ValueError(
+                "--pp (pipeline parallel) is not supported under --nnodes yet"
             )
         if (engine_overrides or {}).get("held_block_ttl_s", 0) != 0:
             raise ValueError("held_block_ttl_s must be 0 under multi-host")
@@ -410,6 +432,7 @@ async def run_jax_worker(
         tp=tp,
         dp=dp,
         sp=sp,
+        pp=pp,
         quant=quant,
         moe_dispatch=moe_dispatch,
     )
@@ -753,6 +776,16 @@ async def _run_multihost(
         )
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+            mm = request.get("mm") if isinstance(request, dict) else None
+            if mm and mm.get("images") and mm.get("embeds") is None:
+                # No encoder resolution is wired on the multihost leader
+                # yet; running anyway would silently attend unspliced
+                # placeholder tokens and ignore the image. Fail the ONE
+                # request loudly instead.
+                raise ValueError(
+                    "multimodal serving under --nnodes is not wired yet "
+                    "(route image requests to a single-host worker)"
+                )
             async for out in engine.generate(request, context):
                 yield out
 
@@ -962,6 +995,12 @@ def main() -> None:
         help="prompts at least this long take the ring-prefill path "
              "(default with --sp: half the largest prefill bucket)",
     )
+    ap.add_argument(
+        "--pp", type=int, default=1,
+        help="pipeline-parallel degree: layers stage over a pp-device mesh "
+             "(GPipe prefill waves + wavefront decode chains; exclusive "
+             "with tp/dp/sp)",
+    )
     ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
     # Multi-host (reference parity: sglang multinode flags dist-init-addr/
     # nnodes/node-rank, multinode-examples.md:10). Rank 0 serves; other
@@ -1025,6 +1064,7 @@ def main() -> None:
             tp=args.tp,
             dp=args.dp,
             sp=args.sp,
+            pp=args.pp,
             quant=args.quant,
             moe_dispatch=args.moe_dispatch,
             nnodes=args.nnodes,

@@ -255,6 +255,123 @@ def _prefill_and_sample(
     return _replicate_out(toks, mesh), _replicate_out(lps, mesh), cache
 
 
+def _pp_prefill_and_sample(
+    params, cache, mb_tokens, mb_positions, mb_pages, mb_offs,
+    mb_kv_lens, block_tables, mb_cu, num_seqs, mb_last_local, mb_last_mask,
+    seeds, counters, temperature, top_k, top_p,
+    *, need_mask, all_greedy=False, want_logprobs=False,
+    cfg, engine, pp_mesh, n_micro,
+):
+    """Prefill wave under pipeline parallelism: the GPipe shard_map
+    program (parallel/pipeline.py) + the same fused first-token sampling
+    as :func:`_prefill_and_sample`."""
+    from dynamo_tpu.parallel.pipeline import pp_forward_impl
+
+    logits, cache = pp_forward_impl(
+        params, cache, mb_tokens, mb_positions, mb_pages, mb_offs,
+        mb_kv_lens, block_tables, mb_cu, num_seqs, mb_last_local,
+        mb_last_mask, cfg=cfg, engine=engine, mesh=pp_mesh, n_micro=n_micro,
+    )
+    toks = _sample_from_logits(
+        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
+    )
+    lps = token_logprobs(logits, toks) if want_logprobs else None
+    return (
+        _replicate_out(toks, pp_mesh), _replicate_out(lps, pp_mesh), cache
+    )
+
+
+def _pp_decode_chain(
+    params, cache, tokens, block_tables, positions, active,
+    seeds, counters, temperature, top_k, top_p,
+    *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
+    cfg, engine, pp_mesh, n_micro,
+):
+    """Wavefront pipeline-parallel decode: ``B`` lanes split into ``M``
+    groups that march through the ``pp`` stages staggered one round
+    apart, so in steady state EVERY stage works EVERY round (utilization
+    ``n_steps*M / (n_steps*M + pp - 1)`` — the fill/drain bubble is paid
+    once per chain, not once per token). The autoregressive feedback
+    rides the ring: group ``g``'s next token is sampled when it drains
+    stage ``pp-1`` at round ``g + t*M + pp - 1`` and re-enters stage 0 at
+    round ``g + (t+1)*M`` — legal exactly when ``M >= pp`` (enforced by
+    EngineCore). Same contract as :func:`_decode_chain`: returns sampled
+    ``[n_steps, B]`` (+ logprobs) and the cache.
+
+    No GPU schedule looks like this — it exists because under jit the
+    whole chain is ONE XLA program and ppermute edges are ICI
+    neighbor-hops, so "pipeline" degenerates into a ring rotation with
+    modular-arithmetic bookkeeping (the reference delegates PP to its
+    engines per-microbatch with host-driven queues instead)."""
+    from dynamo_tpu.parallel.pipeline import pp_decode_round
+
+    pp = int(pp_mesh.shape["pp"])
+    M = n_micro
+    B = tokens.shape[0]
+    Bm = B // M
+    tok_g = tokens.reshape(M, Bm)
+    tab_g = block_tables.reshape(M, Bm, -1)
+    pos_g = positions.reshape(M, Bm)
+    act_g = active.reshape(M, Bm)
+    seeds_g = seeds.reshape(M, Bm)
+    cnt_g = counters.reshape(M, Bm)
+    temp_g = temperature.reshape(M, Bm)
+    k_g = top_k.reshape(M, Bm)
+    p_g = top_p.reshape(M, Bm)
+
+    R = n_steps * M + pp - 1
+    buf0 = jnp.zeros((pp, Bm, cfg.hidden_size), cfg.jax_dtype)
+    out0 = jnp.zeros((n_steps, M, Bm), jnp.int32)
+    if want_logprobs:
+        lp0 = (
+            jnp.zeros((n_steps, M, Bm), jnp.float32),
+            jnp.zeros((n_steps, M, Bm, LOGPROBS_K), jnp.int32),
+            jnp.zeros((n_steps, M, Bm, LOGPROBS_K), jnp.float32),
+        )
+    else:
+        lp0 = None
+
+    def body(carry, r):
+        store, buf, cache, out, lps = carry
+        buf, cache, logits = pp_decode_round(
+            params, cache, buf, r, store, tab_g, pos_g, act_g,
+            cfg=cfg, engine=engine, mesh=pp_mesh, n_micro=M, n_steps=n_steps,
+        )
+        # Work item draining the last stage this round.
+        e = r - (pp - 1)
+        ev = e >= 0  # e < n_steps*M holds by construction of R
+        ec = jnp.maximum(e, 0)
+        ge = ec % M
+        te = ec // M
+        nxt = _sample_from_logits(
+            logits, seeds_g[ge], cnt_g[ge] + te, temp_g[ge], k_g[ge], p_g[ge],
+            need_mask, all_greedy,
+        )
+        new_tok = jnp.where(ev, nxt, store[ge])
+        store = store.at[ge].set(new_tok)
+        out = out.at[te, ge].set(jnp.where(ev, nxt, out[te, ge]))
+        if lps is not None:
+            chosen, ids, vals = token_logprobs(logits, nxt)
+            lps = (
+                lps[0].at[te, ge].set(jnp.where(ev, chosen, lps[0][te, ge])),
+                lps[1].at[te, ge].set(jnp.where(ev, ids, lps[1][te, ge])),
+                lps[2].at[te, ge].set(jnp.where(ev, vals, lps[2][te, ge])),
+            )
+        return (store, buf, cache, out, lps), None
+
+    (store, buf, cache, out, lps), _ = jax.lax.scan(
+        body, (tok_g, buf0, cache, out0, lp0), jnp.arange(R)
+    )
+    sampled = out.reshape(n_steps, B)
+    if lps is not None:
+        lps = tuple(
+            a.reshape((n_steps, B) + a.shape[3:]) for a in lps
+        )
+    return (
+        _replicate_out(sampled, pp_mesh), _replicate_out(lps, pp_mesh), cache
+    )
+
+
 class EngineCore:
     def __init__(
         self,
@@ -267,13 +384,16 @@ class EngineCore:
         on_removed: Callable[[list[int]], None] | None = None,
         mesh: Any = None,
         sp_mesh: Any = None,
+        pp_mesh: Any = None,
     ):
         """``mesh`` (a jax.sharding.Mesh with axes ("dp", "tp")) turns on
         in-engine model parallelism: params/cache shard per
         parallel/sharding.py (megatron TP over ICI; MoE experts over the
         same axis), decode batches shard over dp. The reference only plumbs
         tp_size flags to its engines (vllm/args.py:239-258); here the
-        partitioning is first-party."""
+        partitioning is first-party. ``pp_mesh`` (axes ("pp",)) selects
+        pipeline parallelism instead: layer-staged GPipe prefill waves and
+        wavefront decode chains (parallel/pipeline.py)."""
         bs = engine_cfg.block_size
         for b in engine_cfg.prefill_buckets:
             if b % bs:
@@ -282,9 +402,72 @@ class EngineCore:
         self.engine = engine_cfg
         self.eos_token_ids = set(eos_token_ids)
         self.mesh = mesh
+        self.pp_mesh = pp_mesh
+        self._pp = 1
+        self._pp_micro = 1
         self._dp = 1
         self._batch_shardings = None
-        if mesh is not None:
+        if pp_mesh is not None:
+            if mesh is not None or sp_mesh is not None:
+                raise ValueError(
+                    "pp_mesh is mutually exclusive with mesh (tp/dp) and "
+                    "sp_mesh for now (pp x tp composition: future work)"
+                )
+            from dynamo_tpu.parallel.pipeline import (
+                cache_sharding_pp,
+                pp_param_specs,
+                shard_params_pp,
+            )
+
+            pp = int(pp_mesh.shape["pp"])
+            self._pp = pp
+            # Microbatch count: the wavefront schedule needs M >= pp for
+            # the ring-fed token feedback; M = pp also makes per-step lm-
+            # head traffic match the unpipelined engine (V/pp per stage).
+            self._pp_micro = pp
+            if model_cfg.num_layers % pp:
+                raise ValueError(
+                    f"pp={pp} must divide num_layers={model_cfg.num_layers}"
+                )
+            if model_cfg.vocab_size % pp:
+                raise ValueError(
+                    f"pp={pp} must divide vocab_size={model_cfg.vocab_size}"
+                )
+            for b in engine_cfg.prefill_buckets:
+                if b % self._pp_micro:
+                    raise ValueError(
+                        f"prefill bucket {b} not a multiple of pp microbatch "
+                        f"count {self._pp_micro}"
+                    )
+            for b in engine_cfg.decode_buckets:
+                if b % self._pp_micro:
+                    raise ValueError(
+                        f"decode bucket {b} not a multiple of pp microbatch "
+                        f"count {self._pp_micro}"
+                    )
+            if params is not None:
+                _check_fuse_tp(params, 1)  # pp stages keep tp=1 layouts
+                params = shard_params_pp(params, model_cfg, pp_mesh)
+            else:
+                from jax.sharding import NamedSharding
+
+                specs = pp_param_specs(model_cfg, pp)
+                params = jax.jit(
+                    init_params,
+                    static_argnums=(1,),
+                    out_shardings=jax.tree.map(
+                        lambda s: NamedSharding(pp_mesh, s), specs,
+                        is_leaf=lambda x: isinstance(
+                            x, jax.sharding.PartitionSpec
+                        ),
+                    ),
+                )(jax.random.PRNGKey(seed), model_cfg)
+            self.params = params
+            self.cache = jax.jit(
+                partial(init_cache, model_cfg, engine_cfg),
+                out_shardings=cache_sharding_pp(pp_mesh),
+            )()
+        elif mesh is not None:
             from dynamo_tpu.parallel.sharding import (
                 cache_sharding,
                 decode_batch_shardings,
@@ -426,6 +609,27 @@ class EngineCore:
             static_argnames=("n_steps", "need_mask", "all_greedy", "want_logprobs"),
             donate_argnums=(1,),
         )
+        self._prefill_pp = None
+        self._decode_pp = None
+        if pp_mesh is not None:
+            self._prefill_pp = jax.jit(
+                partial(
+                    _pp_prefill_and_sample, cfg=model_cfg, engine=engine_cfg,
+                    pp_mesh=pp_mesh, n_micro=self._pp_micro,
+                ),
+                static_argnames=("need_mask", "all_greedy", "want_logprobs"),
+                donate_argnums=(1,),
+            )
+            self._decode_pp = jax.jit(
+                partial(
+                    _pp_decode_chain, cfg=model_cfg, engine=engine_cfg,
+                    pp_mesh=pp_mesh, n_micro=self._pp_micro,
+                ),
+                static_argnames=(
+                    "n_steps", "need_mask", "all_greedy", "want_logprobs"
+                ),
+                donate_argnums=(1,),
+            )
 
     # -- request intake (any thread) --------------------------------------
 
@@ -465,6 +669,13 @@ class EngineCore:
         if (pre.kv_transfer_params or {}).get("do_remote_decode"):
             seq.hold_blocks = True
         if pre.mm and pre.mm.get("embeds") is not None:
+            if self.pp_mesh is not None:
+                # Reject at admission (a NotImplementedError inside the
+                # prefill wave would fail every co-scheduled request).
+                raise ValueError(
+                    "multimodal embedding splice under pipeline parallelism "
+                    "is not wired yet (route mm requests to a tp/dp worker)"
+                )
             embeds = np.frombuffer(pre.mm["embeds"], np.float32).reshape(
                 tuple(pre.mm["embeds_shape"])
             )
@@ -707,30 +918,63 @@ class EngineCore:
             mm_embeds = np.zeros((1, 1), np.float32)
             mm_mask = np.zeros(1, bool)
 
-        toks, lps, self.cache = self._prefill(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(write_pages),
-            jnp.asarray(write_offs),
-            jnp.asarray(kv_lens),
-            jnp.asarray(tables),
-            jnp.asarray(cu),
-            jnp.asarray(np.array([len(chosen)], np.int32)),
-            jnp.asarray(last_rows),
-            jnp.asarray(seeds),
-            jnp.asarray(counters),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(mm_embeds),
-            jnp.asarray(mm_mask),
-            need_mask=need_mask and not all_greedy,
-            all_greedy=all_greedy,
-            want_logprobs=want_lp,
-            want_mm=want_mm,
-        )
+        if self.pp_mesh is not None:
+            # want_mm cannot be true here: add_request rejects mm
+            # requests on pp engines at admission.
+            from dynamo_tpu.parallel.pipeline import plan_microbatches
+
+            plan = plan_microbatches(
+                tokens, positions, write_pages, write_offs, kv_lens, cu,
+                len(chosen), last_rows, self._pp_micro,
+                self.engine.garbage_block,
+            )
+            toks, lps, self.cache = self._prefill_pp(
+                self.params,
+                self.cache,
+                jnp.asarray(plan.tokens),
+                jnp.asarray(plan.positions),
+                jnp.asarray(plan.write_pages),
+                jnp.asarray(plan.write_offs),
+                jnp.asarray(plan.kv_lens),
+                jnp.asarray(tables),
+                jnp.asarray(plan.cu_q_lens),
+                jnp.asarray(np.array([len(chosen)], np.int32)),
+                jnp.asarray(plan.last_local),
+                jnp.asarray(plan.last_mask),
+                jnp.asarray(seeds),
+                jnp.asarray(counters),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                need_mask=need_mask and not all_greedy,
+                all_greedy=all_greedy,
+                want_logprobs=want_lp,
+            )
+        else:
+            toks, lps, self.cache = self._prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(write_pages),
+                jnp.asarray(write_offs),
+                jnp.asarray(kv_lens),
+                jnp.asarray(tables),
+                jnp.asarray(cu),
+                jnp.asarray(np.array([len(chosen)], np.int32)),
+                jnp.asarray(last_rows),
+                jnp.asarray(seeds),
+                jnp.asarray(counters),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(mm_embeds),
+                jnp.asarray(mm_mask),
+                need_mask=need_mask and not all_greedy,
+                all_greedy=all_greedy,
+                want_logprobs=want_lp,
+                want_mm=want_mm,
+            )
         toks = fetch_replicated(toks)
         lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
 
@@ -891,7 +1135,8 @@ class EngineCore:
         )
         want_lp = any(s.logprobs is not None for s in seqs)
         all_greedy = all(s.sampling.temperature == 0.0 for s in seqs)
-        out, lps, self.cache = self._decode(
+        decode_fn = self._decode_pp if self.pp_mesh is not None else self._decode
+        out, lps, self.cache = decode_fn(
             self.params,
             self.cache,
             self._put_batch(tokens),

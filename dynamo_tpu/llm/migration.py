@@ -6,6 +6,16 @@ worker — the client sees one uninterrupted stream. Token replay is
 engine-agnostic: with prefix caching the new worker re-prefills cheaply.
 Bounded by the model card's ``migration_limit``.
 
+Structured as pipeline-graph nodes (runtime/pipeline.py):
+:class:`MigrationOperator` is the canonical full Operator — it must carry
+state from the backward path (tokens already streamed) into the forward
+path (the replayed request), exactly the property the reference built its
+PipelineOperator trait for — and :class:`RouterEgress` is the terminal
+backend that routes one attempt to a worker over the data plane.
+:class:`Migration` assembles the two into a ServicePipeline (the same
+composition `build_routed_pipeline` does on model-add, reference
+`lib/llm/src/entrypoint/input/common.rs:216`).
+
 Capability parity: reference `lib/llm/src/migration.rs:26,74-89`
 (RetryManager) + `docs/architecture/request_migration.md`.
 """
@@ -20,32 +30,36 @@ from typing import AsyncIterator
 from dynamo_tpu.llm.kv_router.router import KvPushRouter
 from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import NextFn, PipelineBuilder, ServicePipeline
 
 log = logging.getLogger("dynamo_tpu.migration")
 
 _RETRY_WAIT_S = 0.2
 
 
-class Migration:
+class RouterEgress:
+    """Terminal pipeline backend: route ONE attempt of a preprocessed
+    request to a worker instance and stream its wire chunks back. Routing
+    hints ride the context: ``exclude_instances`` (workers the migration
+    operator has seen die) and the caller's headers."""
+
     def __init__(
         self,
         client: EndpointClient,
         push_router: KvPushRouter | None,
         mode: str = "kv",
-        limit: int = 3,
     ):
         self.client = client
         self.push_router = push_router
         self.mode = mode
-        self.limit = limit
 
-    async def _dispatch(
-        self,
-        pre: PreprocessedRequest,
-        headers: dict[str, str] | None,
-        exclude: set[int],
+    async def generate(
+        self, pre: PreprocessedRequest, context: Context
     ) -> AsyncIterator[LLMEngineOutput]:
         payload = pre.to_wire()
+        exclude = context.meta.get("exclude_instances", set())
+        headers = context.headers or None
         if self.push_router is not None:
             stream = self.push_router.generate(
                 payload,
@@ -67,16 +81,29 @@ class Migration:
                 e.worker_id = worker_id  # type: ignore[attr-defined]
                 raise
 
+
+class MigrationOperator:
+    """Retry-with-token-replay around the downstream egress. Forward path:
+    rewrites the request with already-generated tokens appended and the
+    stop budget shrunk; backward path: accumulates streamed tokens (the
+    state the next forward rewrite needs) and closes the stream exactly
+    once a finish reason passes."""
+
+    def __init__(self, limit: int = 3):
+        self.limit = limit
+
     async def generate(
-        self, pre: PreprocessedRequest, headers: dict[str, str] | None = None
+        self, pre: PreprocessedRequest, context: Context, next: NextFn
     ) -> AsyncIterator[LLMEngineOutput]:
         attempts = 0
         generated: list[int] = []
         failed_workers: set[int] = set()
         current = pre
         while True:
+            attempt_ctx = context.child()
+            attempt_ctx.meta["exclude_instances"] = failed_workers
             try:
-                async for out in self._dispatch(current, headers, failed_workers):
+                async for out in next(current, attempt_ctx):
                     generated.extend(out.token_ids)
                     yield out
                     if out.finish_reason is not None:
@@ -115,3 +142,40 @@ class Migration:
                     pre.request_id, attempts, self.limit, len(generated), e,
                 )
                 await asyncio.sleep(_RETRY_WAIT_S)
+
+
+class Migration:
+    """The assembled routed pipeline segment: MigrationOperator →
+    RouterEgress. Kept as a class so callers (ModelManager, disagg
+    router) hold one object with the historical ``generate(pre,
+    headers)`` surface; internally it IS a ServicePipeline and further
+    operators can be linked in front via ``build_pipeline``."""
+
+    def __init__(
+        self,
+        client: EndpointClient,
+        push_router: KvPushRouter | None,
+        mode: str = "kv",
+        limit: int = 3,
+    ):
+        self.client = client
+        self.push_router = push_router
+        self.mode = mode
+        self.limit = limit
+        self.pipeline: ServicePipeline = self.build_pipeline()
+
+    def build_pipeline(self, *front_operators) -> ServicePipeline:
+        """Assemble ``front_operators → MigrationOperator → RouterEgress``."""
+        builder = PipelineBuilder()
+        for op in front_operators:
+            builder.link(op)
+        return builder.link(MigrationOperator(self.limit)).backend(
+            RouterEgress(self.client, self.push_router, self.mode)
+        )
+
+    async def generate(
+        self, pre: PreprocessedRequest, headers: dict[str, str] | None = None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        ctx = Context(request_id=pre.request_id, headers=headers)
+        async for out in self.pipeline.generate(pre, ctx):
+            yield out

@@ -1,14 +1,17 @@
-"""Per-model serving state on a frontend: preprocessor + routed client.
+"""Per-model serving state on a frontend: preprocessor + routed pipeline.
 
 The :class:`ModelManager` reacts to discovery events: when a model gains
 its first worker it builds the preprocessor (tokenizer from the MDC), the
-endpoint client, and — in ``kv`` mode — the KV router; when its last worker
-leaves, everything is torn down. Request handlers look models up here.
+endpoint client, the KV router (in ``kv`` mode), and the routed pipeline
+segment ``MigrationOperator → RouterEgress`` (a runtime/pipeline.py
+ServicePipeline — further operators compose in front via
+``Migration.build_pipeline``); when its last worker leaves, everything is
+torn down. Request handlers look models up here.
 
 Capability parity: reference `lib/llm/src/discovery/model_manager.rs` +
 `entrypoint/input/common.rs:216` (build_routed_pipeline: the per-model
 pipeline SegmentSource→Preprocessor→Backend→Migration→Router assembled on
-model-add).
+model-add; the operator-graph machinery is `runtime/src/pipeline/nodes.rs`).
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ place of the image's prompt positions. TPU-native shape of each piece:
 from __future__ import annotations
 
 import base64
+import functools
 import hashlib
 
 import numpy as np
@@ -87,19 +88,25 @@ def patch_grid(raw: bytes) -> np.ndarray:
     return (buf.astype(np.float32) / 127.5 - 1.0).reshape(MM_PATCHES, PATCH_DIM)
 
 
+@functools.lru_cache(maxsize=8)
+def _projection(hidden_size: int, seed: int) -> np.ndarray:
+    """The fixed [PATCH_DIM, h]/sqrt(d) Gaussian — depends only on
+    (hidden_size, seed), so it is cached, not re-drawn per request."""
+    rng = np.random.RandomState(seed)
+    w = rng.standard_normal((PATCH_DIM, hidden_size)).astype(np.float32)
+    w /= np.sqrt(PATCH_DIM)  # in place: float32 survives NEP-50 promotion
+    w.setflags(write=False)  # cached — callers must not mutate
+    return w
+
+
 def patch_embed(raw: bytes, hidden_size: int, seed: int = 0) -> np.ndarray:
     """The stand-in vision tower: project the patch grid to the model's
     hidden size with a fixed seeded Gaussian ([PATCH_DIM, h] / sqrt(d)).
     float32 [MM_PATCHES, hidden_size]."""
-    rng = np.random.RandomState(seed)
-    w = rng.standard_normal((PATCH_DIM, hidden_size)).astype(np.float32)
-    w /= np.sqrt(PATCH_DIM)
-    return patch_grid(raw) @ w
+    return patch_grid(raw) @ _projection(hidden_size, seed)
 
 
-def split_images(
-    messages: list[dict], vocab_size: int
-) -> tuple[list[dict], list[str]]:
+def split_images(messages: list[dict]) -> tuple[list[dict], list[str]]:
     """Processor step: strip image parts out of chat messages, returning
     (text-only messages with inline markers, image refs in order). The
     marker ``\x00img{i}\x00`` survives any tokenizer byte-exactly and is

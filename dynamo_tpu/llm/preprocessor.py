@@ -47,7 +47,7 @@ class OpenAIPreprocessor:
 
         messages = [m.model_dump(exclude_none=True) for m in request.messages]
         vocab = getattr(self.tokenizer, "vocab_size", 32000)
-        messages, image_refs = split_images(messages, vocab)
+        messages, image_refs = split_images(messages)
         prompt = self.tokenizer.apply_chat_template(
             messages, add_generation_prompt=True
         )

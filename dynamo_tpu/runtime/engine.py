@@ -33,6 +33,10 @@ class Context:
     def __init__(self, request_id: str | None = None, headers: dict[str, str] | None = None):
         self.id = request_id or uuid.uuid4().hex
         self.headers: dict[str, str] = headers or {}
+        # Open per-request scratch for pipeline operators (runtime/
+        # pipeline.py) to pass hints to downstream nodes — e.g. the
+        # migration operator's exclude-list for the router egress.
+        self.meta: dict[str, Any] = {}
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
 
@@ -59,6 +63,7 @@ class Context:
         child = Context.__new__(Context)
         child.id = self.id
         child.headers = self.headers
+        child.meta = self.meta
         child._stopped = self._stopped
         child._killed = self._killed
         return child

@@ -42,7 +42,7 @@ def test_processor_split_and_splice():
             {"type": "text", "text": " ?"},
         ]},
     ]
-    out, refs = split_images(messages, vocab_size=259)
+    out, refs = split_images(messages)
     assert refs == [IMG_A]
     assert "\x00img0\x00" in out[0]["content"]
 

@@ -1,0 +1,207 @@
+"""Composable service-pipeline graph (runtime/pipeline.py).
+
+Parity target: reference `lib/runtime/src/pipeline/nodes.rs` — operators
+transform the forward (request) path, the backward (response) path, or
+both; links assemble frontend→operators→backend; an assembled pipeline is
+itself an engine (nestable). Plus the llm-layer composition: the
+migration segment (MigrationOperator → RouterEgress) as a pipeline with
+an extra operator linked in front.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import (
+    FunctionOperator,
+    PipelineBuilder,
+    ServicePipeline,
+)
+
+
+class EchoBackend:
+    """Yields its request n times (records what it actually received)."""
+
+    def __init__(self, n=2):
+        self.n = n
+        self.seen = []
+
+    async def generate(self, request, context):
+        self.seen.append((request, dict(context.meta)))
+        for i in range(self.n):
+            yield f"{request}:{i}"
+
+
+async def collect(stream):
+    return [x async for x in stream]
+
+
+def test_forward_and_backward_transforms_compose_in_order():
+    backend = EchoBackend()
+    pipe = (
+        PipelineBuilder()
+        .link(FunctionOperator(forward=lambda r, c: r + "+a"))
+        .link(FunctionOperator(
+            forward=lambda r, c: r + "+b",
+            backward=lambda x, c: x.upper(),
+        ))
+        .link(FunctionOperator(backward=lambda x, c: x + "!"))
+        .backend(backend)
+    )
+    out = asyncio.run(collect(pipe.generate("req", Context())))
+    # Forward order a then b; backward order innermost-first (! before upper).
+    assert backend.seen[0][0] == "req+a+b"
+    assert out == ["REQ+A+B:0!", "REQ+A+B:1!"]
+
+
+def test_operator_carries_forward_state_into_backward_path():
+    """The load-bearing Operator property (reference nodes.rs doc): one
+    node sees both paths of the same request — here, a retry operator
+    replays with state accumulated from the partial response stream."""
+
+    class FlakyBackend:
+        def __init__(self):
+            self.calls = []
+
+        async def generate(self, request, context):
+            self.calls.append(request)
+            yield request + 1
+            if len(self.calls) == 1:
+                raise ConnectionError("worker died")
+            yield request + 2
+
+    class RetryOperator:
+        async def generate(self, request, context, next):
+            got = []
+            while True:
+                try:
+                    async for item in next(request + sum(got), context):
+                        got.append(item)
+                        yield item
+                    return
+                except ConnectionError:
+                    continue  # replay with forward state from backward path
+
+    backend = FlakyBackend()
+    pipe = PipelineBuilder().link(RetryOperator()).backend(backend)
+    out = asyncio.run(collect(pipe.generate(10, Context())))
+    # First attempt saw 10, yielded 11, died; retry saw 10+11=21.
+    assert backend.calls == [10, 21]
+    assert out == [11, 22, 23]
+
+
+def test_short_circuit_without_calling_next():
+    class CacheOperator:
+        async def generate(self, request, context, next):
+            if request == "cached":
+                yield "hit"
+                return
+            async for item in next(request, context):
+                yield item
+
+    backend = EchoBackend(n=1)
+    pipe = PipelineBuilder().link(CacheOperator()).backend(backend)
+    assert asyncio.run(collect(pipe.generate("cached", Context()))) == ["hit"]
+    assert backend.seen == []
+    assert asyncio.run(collect(pipe.generate("miss", Context()))) == ["miss:0"]
+
+
+def test_pipeline_nests_as_backend():
+    inner = PipelineBuilder().link(
+        FunctionOperator(backward=lambda x, c: f"[{x}]")
+    ).backend(EchoBackend(n=1))
+    outer = PipelineBuilder().link(
+        FunctionOperator(forward=lambda r, c: r + "-outer")
+    ).backend(inner)
+    assert isinstance(inner, ServicePipeline)
+    out = asyncio.run(collect(outer.generate("x", Context())))
+    assert out == ["[x-outer:0]"]
+
+
+def test_bare_async_function_as_backend():
+    async def backend_fn(request, context):
+        yield request * 2
+
+    pipe = PipelineBuilder().backend(backend_fn)
+    assert asyncio.run(collect(pipe.generate(21, Context()))) == [42]
+
+
+def test_context_meta_flows_to_backend():
+    class HintOperator:
+        async def generate(self, request, context, next):
+            ctx = context.child()
+            ctx.meta["exclude_instances"] = {7}
+            async for item in next(request, ctx):
+                yield item
+
+    backend = EchoBackend(n=1)
+    pipe = PipelineBuilder().link(HintOperator()).backend(backend)
+    asyncio.run(collect(pipe.generate("r", Context())))
+    assert backend.seen[0][1]["exclude_instances"] == {7}
+
+
+def test_migration_segment_is_a_pipeline_with_front_operators():
+    """The llm migration segment composes like any other graph: an audit
+    operator linked in FRONT of MigrationOperator sees the original
+    request once while the egress (downstream of migration) sees the
+    replayed request after a mid-stream worker death."""
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.llm.protocols.common import (
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    class FlakyClient:
+        """EndpointClient stand-in: first worker dies mid-stream."""
+
+        def __init__(self):
+            self.dispatches = []
+
+        def pick_instance(self, mode, exclude):
+            return 2 if 1 in exclude else 1
+
+        async def direct(self, worker_id, payload, headers=None):
+            self.dispatches.append((worker_id, list(payload["token_ids"])))
+
+            async def stream():
+                yield LLMEngineOutput(token_ids=[100]).to_wire()
+                if worker_id == 1:
+                    raise ConnectionError("conn reset")
+                yield LLMEngineOutput(
+                    token_ids=[101], finish_reason="stop"
+                ).to_wire()
+
+            return stream()
+
+    audited = []
+
+    class AuditOperator:
+        async def generate(self, request, context, next):
+            audited.append(list(request.token_ids))
+            async for item in next(request, context):
+                yield item
+
+    client = FlakyClient()
+    m = Migration(client=client, push_router=None, mode="round_robin", limit=2)
+    pipe = m.build_pipeline(AuditOperator())
+    pre = PreprocessedRequest(
+        model="t", token_ids=[1, 2, 3], request_id="r1",
+        sampling=SamplingOptions(), stop=StopConditions(max_tokens=8),
+    )
+
+    async def run():
+        from dynamo_tpu.runtime.engine import Context as Ctx
+
+        return [o async for o in pipe.generate(pre, Ctx(request_id="r1"))]
+
+    out = asyncio.run(run())
+    assert [o.token_ids for o in out] == [[100], [100], [101]]
+    assert out[-1].finish_reason == "stop"
+    # Audit (upstream of migration) saw the ORIGINAL request once; the
+    # egress saw the replay with the streamed token appended and the
+    # failed worker excluded.
+    assert audited == [[1, 2, 3]]
+    assert client.dispatches == [(1, [1, 2, 3]), (2, [1, 2, 3, 100])]

@@ -1,0 +1,251 @@
+"""Pipeline parallelism on the virtual 8-device CPU mesh.
+
+The GPipe shard_map program (parallel/pipeline.py) must produce the SAME
+last-token logits and the SAME paged cache as the single-device
+`model.forward_tokens` — including when microbatch boundaries cut through
+the middle of a sequence (chunked-prefill causality across rounds), and
+when decode steps ride the pipe.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model import (
+    decode_tokens,
+    forward_tokens,
+    init_cache,
+    init_params,
+)
+from dynamo_tpu.parallel.pipeline import (
+    cache_sharding_pp,
+    make_pp_mesh,
+    plan_microbatches,
+    pp_forward_tokens,
+    pp_param_specs,
+    shard_params_pp,
+)
+
+CFG = ModelConfig(
+    name="pp-test",
+    vocab_size=512,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=4,
+    num_heads=8,
+    num_kv_heads=8,
+    head_dim=16,
+    dtype="float32",
+    tie_embeddings=True,
+)
+ENG = EngineConfig(
+    num_kv_blocks=32,
+    block_size=8,
+    max_num_seqs=8,
+    max_model_len=128,
+    prefill_buckets=(64,),
+    decode_buckets=(4, 8),
+)
+
+
+def build_wave(seq_lens: list[int], pad_to: int, rng: np.random.RandomState):
+    """Multi-sequence ragged prefill wave, the EngineCore layout: returns
+    (global numpy operands dict, per-seq block ids)."""
+    S = len(seq_lens)
+    bs = ENG.block_size
+    T = sum(seq_lens)
+    assert pad_to >= T
+    tokens = np.zeros(pad_to, np.int32)
+    positions = np.zeros(pad_to, np.int32)
+    write_pages = np.full(pad_to, ENG.garbage_block, np.int32)
+    write_offs = np.zeros(pad_to, np.int32)
+    tables = np.full((S, ENG.max_blocks_per_seq), ENG.garbage_block, np.int32)
+    cu = np.zeros(S + 1, np.int32)
+    next_block = 0
+    for s, n in enumerate(seq_lens):
+        lo = cu[s]
+        cu[s + 1] = lo + n
+        tokens[lo : lo + n] = rng.randint(1, CFG.vocab_size, size=n)
+        pos = np.arange(n, dtype=np.int32)
+        positions[lo : lo + n] = pos
+        n_blocks = (n + bs - 1) // bs
+        ids = np.arange(next_block, next_block + n_blocks, dtype=np.int32)
+        next_block += n_blocks
+        tables[s, :n_blocks] = ids
+        write_pages[lo : lo + n] = ids[pos // bs]
+        write_offs[lo : lo + n] = pos % bs
+    return {
+        "tokens": tokens,
+        "positions": positions,
+        "write_pages": write_pages,
+        "write_offs": write_offs,
+        "kv_lens": np.asarray(seq_lens, np.int32),
+        "block_tables": tables,
+        "cu_q_lens": cu,
+        "num_seqs": np.asarray([S], np.int32),
+        "last_rows": (cu[1:] - 1).astype(np.int32),
+    }
+
+
+def single_device_prefill(params, wave):
+    cache = init_cache(CFG, ENG)
+    logits, cache = forward_tokens(
+        params, cache,
+        jnp.asarray(wave["tokens"]), jnp.asarray(wave["positions"]),
+        jnp.asarray(wave["write_pages"]), jnp.asarray(wave["write_offs"]),
+        jnp.asarray(wave["kv_lens"]), jnp.asarray(wave["block_tables"]),
+        jnp.asarray(wave["cu_q_lens"]), jnp.asarray(wave["num_seqs"]),
+        jnp.asarray(wave["last_rows"]), CFG, ENG, None,
+    )
+    return logits, cache
+
+
+def pp_prefill(params_pp, cache_pp, wave, mesh, n_micro):
+    plan = plan_microbatches(
+        wave["tokens"], wave["positions"], wave["write_pages"],
+        wave["write_offs"], wave["kv_lens"], wave["cu_q_lens"],
+        int(wave["num_seqs"][0]), wave["last_rows"], n_micro,
+        ENG.garbage_block,
+    )
+    return pp_forward_tokens(
+        params_pp, cache_pp,
+        jnp.asarray(plan.tokens), jnp.asarray(plan.positions),
+        jnp.asarray(plan.write_pages), jnp.asarray(plan.write_offs),
+        jnp.asarray(plan.kv_lens), jnp.asarray(wave["block_tables"]),
+        jnp.asarray(plan.cu_q_lens), jnp.asarray(wave["num_seqs"]),
+        jnp.asarray(plan.last_local), jnp.asarray(plan.last_mask),
+        cfg=CFG, engine=ENG, mesh=mesh, n_micro=plan.n_micro,
+    )
+
+
+@pytest.mark.parametrize("n_micro", [1, 3])
+def test_pp_prefill_matches_single_device(n_micro):
+    """Microbatch boundaries cut mid-sequence (lens 20/13/9, Tm=14 at
+    M=3): per-chunk kv_lens must give chunked-prefill causality, and the
+    drained logits + the full layer-sharded cache must match."""
+    rng = np.random.RandomState(0)
+    wave = build_wave([20, 13, 9], pad_to=42, rng=rng)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    want_logits, want_cache = single_device_prefill(params, wave)
+
+    mesh = make_pp_mesh(4)
+    params_pp = shard_params_pp(params, CFG, mesh)
+    cache_pp = jax.device_put(init_cache(CFG, ENG), cache_sharding_pp(mesh))
+    got_logits, got_cache = pp_prefill(params_pp, cache_pp, wave, mesh, n_micro)
+
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    # Garbage page excluded: both paths scribble pad/bubble writes there
+    # (its content is unspecified by contract; nothing reads it unmasked).
+    real = slice(0, ENG.num_kv_blocks)
+    np.testing.assert_allclose(
+        np.asarray(got_cache)[:, real], np.asarray(want_cache)[:, real],
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_pp_decode_step_matches_single_device():
+    """A decode step (one token per sequence) rides the same pipe: PP
+    prefill then PP decode vs single-device prefill + decode_tokens."""
+    rng = np.random.RandomState(1)
+    lens = [20, 13, 9]
+    wave = build_wave(lens, pad_to=42, rng=rng)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    want_logits, want_cache = single_device_prefill(params, wave)
+
+    B = 4  # decode bucket (one pad lane)
+    nxt = np.zeros(B, np.int32)
+    nxt[:3] = np.argmax(np.asarray(want_logits), axis=-1)
+    tables = np.full((B, ENG.max_blocks_per_seq), ENG.garbage_block, np.int32)
+    tables[:3] = wave["block_tables"]
+    pos = np.zeros(B, np.int32)
+    pos[:3] = lens
+    active = np.zeros(B, bool)
+    active[:3] = True
+    want_d, _ = decode_tokens(
+        params, want_cache, jnp.asarray(nxt), jnp.asarray(tables),
+        jnp.asarray(pos), jnp.asarray(active), CFG, ENG, None,
+    )
+
+    mesh = make_pp_mesh(4)
+    params_pp = shard_params_pp(params, CFG, mesh)
+    cache_pp = jax.device_put(init_cache(CFG, ENG), cache_sharding_pp(mesh))
+    _, cache_pp = pp_prefill(params_pp, cache_pp, wave, mesh, 3)
+
+    # Decode wave in the ragged layout: B rows, q_len 1 each.
+    bs = ENG.block_size
+    wp = np.where(active, tables[np.arange(B), pos // bs], ENG.garbage_block)
+    dec = {
+        "tokens": nxt,
+        "positions": pos,
+        "write_pages": wp.astype(np.int32),
+        "write_offs": (pos % bs).astype(np.int32),
+        "kv_lens": np.where(active, pos + 1, 1).astype(np.int32),
+        "block_tables": tables,
+        "cu_q_lens": np.arange(B + 1, dtype=np.int32),
+        "num_seqs": np.asarray([B], np.int32),
+        "last_rows": np.arange(B, dtype=np.int32),
+    }
+    got_d, _ = pp_prefill(params_pp, cache_pp, dec, mesh, 2)
+    np.testing.assert_allclose(
+        np.asarray(got_d)[:3], np.asarray(want_d)[:3], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pp_param_specs_reject_bad_layer_split():
+    with pytest.raises(ValueError, match="divide num_layers"):
+        pp_param_specs(CFG, 3)
+
+
+def test_engine_core_pp_matches_single_device():
+    """The REAL EngineCore on a pp=4 mesh — GPipe prefill waves plus the
+    wavefront decode chain — produces identical greedy output, including
+    a non-greedy seeded lane (sampler feedback rides the ring)."""
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    def run(pp_mesh):
+        core = EngineCore(CFG, ENG, seed=0, pp_mesh=pp_mesh)
+        reqs = [
+            PreprocessedRequest(
+                model="t",
+                token_ids=list(range(3 + i, 40 + 3 * i)),
+                request_id=f"r{i}",
+                sampling=SamplingOptions(
+                    temperature=0.0 if i < 2 else 0.8, seed=7,
+                ),
+                stop=StopConditions(max_tokens=6, ignore_eos=True),
+            )
+            for i in range(3)
+        ]
+        seqs = [core.add_request(r) for r in reqs]
+        done: dict[str, list[int]] = {s.request_id: [] for s in seqs}
+        fins: dict[str, str] = {}
+        for _ in range(300):
+            for seq, out in core.step():
+                done[seq.request_id].extend(out.token_ids)
+                if out.finish_reason:
+                    fins[seq.request_id] = out.finish_reason
+            if len(fins) == 3:
+                break
+        assert len(fins) == 3
+        return done
+
+    assert run(make_pp_mesh(4)) == run(None)
+
+
+def test_engine_core_pp_rejects_bad_buckets():
+    import dataclasses
+
+    from dynamo_tpu.engine.core import EngineCore
+
+    bad = dataclasses.replace(ENG, decode_buckets=(6,))
+    with pytest.raises(ValueError, match="decode bucket"):
+        EngineCore(CFG, bad, seed=0, pp_mesh=make_pp_mesh(4))
