@@ -327,13 +327,20 @@ def build_engine(
             engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
     params = None
     if quant == "int8":
-        if mesh is not None or pp_mesh is not None:
-            raise ValueError("int8 quantization is single-chip for now")
+        if pp_mesh is not None:
+            raise ValueError("int8 under pipeline parallelism: not wired yet")
         import jax
 
         from dynamo_tpu.engine.model import init_params_quantized
 
-        params = init_params_quantized(jax.random.PRNGKey(seed), model_cfg)
+        # Under a tp/dp mesh the int8 pytree is built with the mesh's
+        # fused-column layout and sharded by EngineCore (shard_params
+        # understands {w, scale} leaves — the 70B-int8 serving mode,
+        # parallel/placement.py). Random init materializes on the default
+        # device first; real checkpoints stream through engine/loader.py.
+        params = init_params_quantized(
+            jax.random.PRNGKey(seed), model_cfg, tp=tp if mesh is not None else 1
+        )
     elif quant:
         raise ValueError(f"unknown quantization {quant!r}")
     core = (core_cls or EngineCore)(

@@ -421,6 +421,12 @@ class EngineCore:
 
             pp = int(pp_mesh.shape["pp"])
             self._pp = pp
+            if model_cfg.is_moe:
+                # Reject at construction, not at the first prefill wave.
+                raise ValueError(
+                    "pipeline parallelism for MoE presets is not built yet "
+                    "(compose pp with the EP dispatch inside each stage)"
+                )
             # Microbatch count: the wavefront schedule needs M >= pp for
             # the ring-fed token feedback; M = pp also makes per-step lm-
             # head traffic match the unpipelined engine (V/pp per stage).

@@ -508,6 +508,53 @@ def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
     ).reshape(T, 2 * cfg.num_kv_heads, cfg.head_dim)
 
 
+def dense_layer(
+    x: jax.Array,            # [T, h]
+    lp: dict,                # ONE layer's params (leaves already indexed)
+    cache: jax.Array,        # [n_layers_here, n_pages, page_size, 2*n_kv, d]
+    layer_idx: int,          # row of `cache` this layer writes/reads
+    positions: jax.Array,
+    write_pages: jax.Array,
+    write_offs: jax.Array,
+    kv_lens: jax.Array,
+    block_tables: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    tp: int = 1,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """One transformer block over a ragged token batch: attn-norm → fused
+    qkv → rope → in-place page scatter → ragged paged attention → wo →
+    mlp. Shared by :func:`forward_hidden` (cache carries ALL layers,
+    ``layer_idx`` = l) and the pipeline-parallel stage body
+    (parallel/pipeline.py — cache carries only the stage's layer slice),
+    so the layer math cannot drift between the two."""
+    T = x.shape[0]
+    sm_scale = cfg.head_dim ** -0.5
+    y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
+    q, k, v = split_qkv(qkv, cfg, tp)
+    q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
+    k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+    kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
+    cache = cache.at[layer_idx, write_pages, write_offs].set(kvn)
+    if mesh is not None:
+        attn = sharded_ragged_attention(
+            mesh, q, cache[layer_idx], kv_lens, block_tables, cu_q_lens,
+            num_seqs, sm_scale=sm_scale,
+        )
+    else:
+        attn = ragged_paged_attention(
+            q, cache[layer_idx], kv_lens, block_tables, cu_q_lens, num_seqs,
+            sm_scale=sm_scale,
+        )
+    x = x + _dot(attn.reshape(T, cfg.q_size), lp["wo"]).astype(x.dtype)
+    x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
+    return x, cache
+
+
 # -- the unified forward ----------------------------------------------------
 
 def forward_tokens(
@@ -567,9 +614,7 @@ def forward_hidden(
     ``mm_embeds``/``mm_mask`` (a separately-compiled prefill variant)
     override the token-embedding rows at multimodal placeholder
     positions with encoder output (llm/multimodal.py)."""
-    T = tokens.shape[0]
     tp = int(mesh.shape["tp"]) if mesh is not None else 1
-    sm_scale = cfg.head_dim ** -0.5
     x = params["embed"][tokens]  # [T, h]
     if mm_embeds is not None:
         x = jnp.where(mm_mask[:, None], mm_embeds.astype(x.dtype), x)
@@ -577,26 +622,11 @@ def forward_hidden(
 
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
-        q, k, v = split_qkv(qkv, cfg, tp)
-        q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
-        k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
-        kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-        cache = cache.at[l, write_pages, write_offs].set(kvn)
-        if mesh is not None:
-            attn = sharded_ragged_attention(
-                mesh, q, cache[l], kv_lens, block_tables, cu_q_lens, num_seqs,
-                sm_scale=sm_scale,
-            )
-        else:
-            attn = ragged_paged_attention(
-                q, cache[l], kv_lens, block_tables, cu_q_lens, num_seqs,
-                sm_scale=sm_scale,
-            )
-        attn = attn.reshape(T, cfg.q_size)
-        x = x + _dot(attn, lp["wo"]).astype(x.dtype)
-        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
+        x, cache = dense_layer(
+            x, lp, cache, l, positions, write_pages, write_offs,
+            kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine,
+            tp=tp, mesh=mesh,
+        )
 
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), cache
 

@@ -55,14 +55,10 @@ from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.model import (
     Params,
     _dot,
-    _interleave_kv,
     _logits,
+    dense_layer,
     rms_norm,
-    rope,
-    split_gu,
-    split_qkv,
 )
-from dynamo_tpu.ops.ragged_attention import ragged_paged_attention
 
 
 def make_pp_mesh(pp: int, devices=None) -> Mesh:
@@ -202,31 +198,18 @@ def plan_microbatches(
 def _stage_layers(
     x, layers_local, cache_local, positions, write_pages, write_offs,
     kv_lens, block_tables, cu_q_lens, num_seqs, cfg: ModelConfig,
+    engine: EngineConfig,
 ):
-    """One stage's layer slice over one microbatch — the same llama layer
-    math as :func:`model.forward_hidden` (kept in lockstep; the PP parity
-    test pins them equal), against the stage-local ``[Lp, ...]`` cache."""
-    T = x.shape[0]
+    """One stage's layer slice over one microbatch: the SAME
+    :func:`model.dense_layer` block as forward_hidden, against the
+    stage-local ``[Lp, ...]`` cache slice (layer math cannot drift)."""
     Lp = cache_local.shape[0]
-    sm_scale = cfg.head_dim ** -0.5
     for j in range(Lp):
         lp = jax.tree.map(lambda a: a[j], layers_local)
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
-        q, k, v = split_qkv(qkv, cfg)
-        q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
-        k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
-        kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-        cache_local = cache_local.at[j, write_pages, write_offs].set(kvn)
-        attn = ragged_paged_attention(
-            q, cache_local[j], kv_lens, block_tables, cu_q_lens, num_seqs,
-            sm_scale=sm_scale,
+        x, cache_local = dense_layer(
+            x, lp, cache_local, j, positions, write_pages, write_offs,
+            kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine,
         )
-        x = x + _dot(attn.reshape(T, cfg.q_size), lp["wo"]).astype(x.dtype)
-        y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        gu = _dot(y, lp["wgu"])
-        g, u = split_gu(gu)
-        x = x + _dot((jax.nn.silu(g) * u).astype(x.dtype), lp["w_down"]).astype(x.dtype)
     return x, cache_local
 
 
@@ -256,7 +239,7 @@ def _pp_program(
         pages = jnp.where(valid, mb_pages[mbc], engine.garbage_block)
         x, cache = _stage_layers(
             x, params["layers"], cache, pos, pages, mb_offs[mbc],
-            mb_kv_lens[mbc], block_tables, mb_cu[mbc], num_seqs, cfg,
+            mb_kv_lens[mbc], block_tables, mb_cu[mbc], num_seqs, cfg, engine,
         )
         # Last stage banks each sequence's last-token hidden state the
         # round its microbatch drains.
@@ -380,7 +363,7 @@ def _pp_decode_round_body(
 
     x, cache = _stage_layers(
         x, params["layers"], cache, pos, write_pages, write_offs,
-        kv_lens, table, cu, num_seqs, cfg,
+        kv_lens, table, cu, num_seqs, cfg, engine,
     )
     # Exit: the last stage's final-norm rows, replicated; then this
     # stage's V/pp slice of the logits.

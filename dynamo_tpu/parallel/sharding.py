@@ -116,11 +116,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def expand_specs_for_params(specs: Any, params: Any) -> Any:
+    """Match a PartitionSpec pytree to a possibly int8-quantized params
+    pytree: where params holds a quantized weight ``{"w", "scale"}``
+    (model.quantize_weight layout) under a single spec leaf, expand to
+    per-member specs. ``scale`` is ``w``'s shape with the contraction
+    axis collapsed to 1, so any sharded axis that is size-1 in scale
+    (row-parallel weights: wo, w_down) replicates instead."""
+    def expand(spec, p):
+        if isinstance(p, dict) and set(p) == {"w", "scale"}:
+            scale_spec = P(*[
+                ax if p["scale"].shape[i] != 1 else None
+                for i, ax in enumerate(spec)
+            ])
+            return {"w": spec, "scale": scale_spec}
+        if isinstance(p, dict):
+            return {k: expand(spec[k], p[k]) for k in p}
+        return spec
+
+    return {k: expand(specs[k], params[k]) for k in params}
+
+
 def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
-    """Place an (unsharded) params pytree onto the mesh."""
-    shardings = param_shardings(cfg, mesh)
+    """Place an (unsharded, possibly int8-quantized) params pytree onto
+    the mesh."""
+    specs = param_partition_specs(cfg, mesh.shape["tp"])
     if "fuse_tp" not in params:  # pytrees predating the layout marker
-        shardings.pop("fuse_tp")
+        specs.pop("fuse_tp")
+    specs = expand_specs_for_params(specs, params)
     return jax.tree.map(
-        lambda x, sh: jax.device_put(x, sh), params, shardings
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P),
     )

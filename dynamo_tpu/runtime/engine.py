@@ -63,7 +63,10 @@ class Context:
         child = Context.__new__(Context)
         child.id = self.id
         child.headers = self.headers
-        child.meta = self.meta
+        # Copied, not aliased: a child scopes one downstream attempt, and
+        # its hints (e.g. migration's exclude list) must not leak back
+        # into the parent or into sibling attempts.
+        child.meta = dict(self.meta)
         child._stopped = self._stopped
         child._killed = self._killed
         return child

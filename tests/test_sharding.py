@@ -175,6 +175,55 @@ def test_decode_batch_shardings_cover_operands():
     assert set(sh) == {"tokens", "block_tables", "positions", "active"}
 
 
+def test_int8_engine_on_mesh_matches_int8_single_device():
+    """int8 weight-only params under a tp mesh (the 70B serving mode —
+    placement.py fits llama3-70b-int8 on v5e-64 at tp=8 x dp=8): the
+    {w, scale} dict leaves shard via expand_specs_for_params (scale
+    replicates where its contraction axis collapsed to 1), and greedy
+    output matches the single-device int8 engine exactly.
+
+    The two param pytrees describe the SAME quantized model: init_params
+    with different tp is a fused-column permutation, and per-output-
+    channel quantization is permutation-equivariant."""
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.model import init_params, quantize_params
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    def run(params, mesh):
+        core = EngineCore(CFG, ENG, params=params, seed=0, mesh=mesh)
+        seqs = [
+            core.add_request(
+                PreprocessedRequest(
+                    model="t",
+                    token_ids=list(range(3 + i, 40 + i)),
+                    request_id=f"r{i}",
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=5, ignore_eos=True),
+                )
+            )
+            for i in range(2)
+        ]
+        done: dict[str, list[int]] = {s.request_id: [] for s in seqs}
+        fins = 0
+        for _ in range(200):
+            for seq, out in core.step():
+                done[seq.request_id].extend(out.token_ids)
+                fins += bool(out.finish_reason)
+            if fins == 2:
+                return done
+        raise AssertionError("never finished")
+
+    q1 = quantize_params(init_params(jax.random.PRNGKey(0), CFG, tp=1))
+    want = run(q1, None)
+    q2 = quantize_params(init_params(jax.random.PRNGKey(0), CFG, tp=2))
+    got = run(q2, make_mesh(dp=2, tp=2))
+    assert got == want
+
+
 def test_cross_tp_kv_transfer_matches_aggregated():
     """P<->D mesh mismatch: a tp=2 prefill core's held blocks imported by
     a tp=1 decode core (and the reverse direction's staging) must decode

@@ -34,8 +34,12 @@ from dynamo_tpu.engine.model import (
 from dynamo_tpu.ops.ragged_attention import ragged_paged_attention
 
 
-def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
-    """One decode step over B lanes with stages toggleable."""
+def build_forward(cfg, engine, *, attn=True, scatter=True, head=True,
+                  dense_attn=False):
+    """One decode step over B lanes with stages toggleable. ``dense_attn``
+    swaps the Pallas kernel for the pure-XLA gather/softmax reference —
+    more raw bytes, but it fuses with the surrounding layer instead of
+    paying the custom-call boundary per layer."""
 
     def fwd(params, cache, tokens, block_tables, positions, active):
         B = tokens.shape[0]
@@ -61,7 +65,16 @@ def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
             kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
             if scatter:
                 cache = cache.at[l, write_pages, write_offs].set(kvn)
-            if attn:
+            if attn and dense_attn:
+                from dynamo_tpu.ops.ragged_attention import (
+                    ragged_paged_attention_ref,
+                )
+
+                a = ragged_paged_attention_ref(
+                    q, cache[l], kv_lens, block_tables, cu, num_seqs,
+                    sm_scale=sm_scale,
+                )
+            elif attn:
                 a = ragged_paged_attention(
                     q, cache[l], kv_lens, block_tables, cu, num_seqs,
                     sm_scale=sm_scale,
@@ -86,7 +99,7 @@ def build_forward(cfg, engine, *, attn=True, scatter=True, head=True):
     return fwd
 
 
-def build_chain(cfg, engine, n_steps, **flags):
+def build_chain(cfg, engine, n_steps, unroll=False, **flags):
     fwd = build_forward(cfg, engine, **flags)
 
     def chain(params, cache, tokens, block_tables, positions, active):
@@ -97,6 +110,12 @@ def build_chain(cfg, engine, n_steps, **flags):
             nxt, cache = fwd(params, cache, toks, block_tables, positions + i * step, active)
             return (nxt, cache), nxt
 
+        if unroll:
+            toks, outs = tokens, []
+            for i in range(n_steps):
+                (toks, cache), nxt = body((toks, cache), jnp.int32(i))
+                outs.append(nxt)
+            return jnp.stack(outs), cache
         (_, cache), sampled = jax.lax.scan(body, (tokens, cache), jnp.arange(n_steps))
         return sampled, cache
 
@@ -160,10 +179,15 @@ def main():
 
     variants = [
         ("full", dict()),
+        ("full_unrolled", dict(unroll=True)),
+        ("full_dense_attn", dict(dense_attn=True)),
         ("no_attn", dict(attn=False)),
         ("no_scatter", dict(scatter=False)),
         ("no_head", dict(head=False)),
         ("no_attn_no_scatter", dict(attn=False, scatter=False)),
+        # NOTE: variants with head=False AND scatter=False have a loop-
+        # invariant scan body at long chains — XLA hoists it and the
+        # number measures nothing. Trust matmuls_only at --steps 32 only.
         ("matmuls_only", dict(attn=False, scatter=False, head=False)),
     ]
     if args.only:
