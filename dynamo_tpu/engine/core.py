@@ -469,8 +469,12 @@ class EngineCore:
                     ),
                 )(jax.random.PRNGKey(seed), model_cfg)
             self.params = params
+            # pp keeps the STACKED [L, ...] cache — the layer axis is the
+            # stage sharding (parallel/pipeline.py).
+            from dynamo_tpu.engine.model import init_cache_stacked
+
             self.cache = jax.jit(
-                partial(init_cache, model_cfg, engine_cfg),
+                partial(init_cache_stacked, model_cfg, engine_cfg),
                 out_shardings=cache_sharding_pp(pp_mesh),
             )()
         elif mesh is not None:
@@ -545,24 +549,42 @@ class EngineCore:
         # Page movement programs (offload demotion + disagg transfer).
         # Slices/gathers are enqueued on the device stream — executions
         # are in-order, so they read bytes before any later program can
-        # rewrite them — and landed host-side off the step path.
-        self._slice_page = jax.jit(lambda cache, bid: cache[:, bid])
-        self._gather_pages = jax.jit(
-            lambda cache, ids: jnp.moveaxis(cache[:, ids], 1, 0)
-        )
-        self._scatter_pages = jax.jit(
-            lambda cache, ids, pages: cache.at[:, ids].set(
-                jnp.moveaxis(pages, 0, 1)
-            ),
-            donate_argnums=(0,),
-        )
+        # rewrite them — and landed host-side off the step path. The
+        # host/wire layouts stay layer-major ([L, ...] / [n, L, ...]) so
+        # descriptors, offload tiers, and cross-core transfers are
+        # byte-compatible across cache layouts (per-layer tuple vs the
+        # pp-stacked array).
+        def _slice_page_fn(cache, bid):
+            if isinstance(cache, tuple):
+                return jnp.stack([c[bid] for c in cache])        # [L, ps, 2kv, d]
+            return cache[:, bid]
+
+        def _gather_pages_fn(cache, ids):
+            if isinstance(cache, tuple):
+                return jnp.stack([c[ids] for c in cache], axis=1)  # [n, L, ...]
+            return jnp.moveaxis(cache[:, ids], 1, 0)
+
+        def _scatter_pages_fn(cache, ids, pages):
+            if isinstance(cache, tuple):
+                return tuple(
+                    c.at[ids].set(pages[:, l]) for l, c in enumerate(cache)
+                )
+            return cache.at[:, ids].set(jnp.moveaxis(pages, 0, 1))
+
+        def _copy_pages_fn(src, dst, sids, dids):
+            if isinstance(dst, tuple):
+                return tuple(
+                    d.at[dids].set(s[sids]) for s, d in zip(src, dst)
+                )
+            return dst.at[:, dids].set(src[:, sids])
+
+        self._slice_page = jax.jit(_slice_page_fn)
+        self._gather_pages = jax.jit(_gather_pages_fn)
+        self._scatter_pages = jax.jit(_scatter_pages_fn, donate_argnums=(0,))
         # Device-direct cache->cache block copy (one program: gather from
         # the source cache, scatter into ours — no host staging and no
-        # intermediate buffer).
-        self._copy_pages_from = jax.jit(
-            lambda src, dst, sids, dids: dst.at[:, dids].set(src[:, sids]),
-            donate_argnums=(1,),
-        )
+        # intermediate buffer). Requires matching layouts on both cores.
+        self._copy_pages_from = jax.jit(_copy_pages_fn, donate_argnums=(1,))
 
         self._inbox: deque[Sequence] = deque()   # thread-safe enqueue
         self.waiting: deque[Sequence] = deque()
@@ -805,7 +827,10 @@ class EngineCore:
             except OutOfBlocksError:
                 self.offload.reinsert(h, parent_hash, kv)  # undo the pop
                 break
-            self.cache = self.cache.at[:, bid].set(jnp.asarray(kv))
+            self.cache = self._scatter_pages(
+                self.cache, jnp.asarray([bid], jnp.int32),
+                jnp.asarray(kv)[None],
+            )
             self.allocator.register_inactive(bid, h, parent_hash, emit=False)
             cached_ids.extend(self.allocator.acquire_cached([h]))
             ncached += 1
@@ -1656,6 +1681,11 @@ class EngineCore:
         acquisition makes mutual pulls deadlock-free."""
         if src is self:
             raise ValueError("cannot direct-import from self")
+        if isinstance(src.cache, tuple) != isinstance(self.cache, tuple):
+            raise ValueError(
+                "direct import needs matching cache layouts (per-layer "
+                "tuple vs pp-stacked); use the staged wire path instead"
+            )
         descs = src.export_descriptors(request_id)
         first, second = (src, self) if id(src) < id(self) else (self, src)
         with first._step_lock, second._step_lock:
@@ -1712,25 +1742,27 @@ class EngineCore:
         n_pages = -(-bucket // bs)
         if getattr(self, "_embed_scratch", None) is None:
             shape = (
-                self.cfg.num_layers,
                 -(-self.engine.prefill_buckets[-1] // bs) + 1,
                 bs,
                 2 * self.cfg.num_kv_heads,
                 self.cfg.head_dim,
             )
-            self._embed_scratch = jnp.zeros(shape, self.cfg.jax_dtype)
+            self._embed_scratch = tuple(
+                jnp.zeros(shape, self.cfg.jax_dtype)
+                for _ in range(self.cfg.num_layers)
+            )
             self._embed_fn = jax.jit(
                 partial(embed_forward, cfg=self.cfg, engine=self.engine, mesh=self.mesh),
                 donate_argnums=(1,),
             )
-        garbage = self._embed_scratch.shape[1] - 1
+        garbage = self._embed_scratch[0].shape[0] - 1
         tokens = np.zeros(bucket, np.int32)
         tokens[:T] = token_ids
         valid = np.zeros(bucket, bool)
         valid[:T] = True
         write_pages = np.full(bucket, garbage, np.int32)
         write_pages[:T] = np.arange(T) // bs
-        tables = np.full((1, self._embed_scratch.shape[1] - 1), garbage, np.int32)
+        tables = np.full((1, self._embed_scratch[0].shape[0] - 1), garbage, np.int32)
         tables[0, :n_pages] = np.arange(n_pages)
         pooled, self._embed_scratch = self._embed_fn(
             self.params,

@@ -239,9 +239,34 @@ def params_fuse_tp(params: Params) -> int:
     return 1 if v is None else int(v)
 
 
-def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> jax.Array:
-    """Combined KV cache ``[L, n_pages, page_size, 2*n_kv, d]`` (the last
-    page is the garbage page absorbing padded-position writes)."""
+def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple:
+    """Combined KV cache: a TUPLE of per-layer page arrays
+    ``[n_pages, page_size, 2*n_kv, d]`` (the last page is the garbage
+    page absorbing padded-position writes).
+
+    Per-layer arrays instead of one stacked ``[L, ...]`` tensor is a
+    measured −1.4 ms/step at 1B decode shapes (tools/profile_decode.py
+    full vs full_split_cache, PERF.md r5): feeding the Pallas attention
+    custom call a ``cache[l]`` slice of the stacked donated buffer made
+    XLA materialize a per-layer copy each step; separate buffers give
+    the kernel aliased views for free. Pipeline parallelism keeps the
+    stacked layout (:func:`init_cache_stacked`) — its stage sharding IS
+    the layer axis."""
+    dtype = dtype or cfg.jax_dtype
+    shape = (
+        engine.num_kv_blocks + 1,
+        engine.block_size,
+        2 * cfg.num_kv_heads,
+        cfg.head_dim,
+    )
+    return tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers))
+
+
+def init_cache_stacked(
+    cfg: ModelConfig, engine: EngineConfig, dtype=None
+) -> jax.Array:
+    """Stacked ``[L, n_pages, page_size, 2*n_kv, d]`` cache — the
+    pipeline-parallel layout (layer axis shards over the pp mesh)."""
     dtype = dtype or cfg.jax_dtype
     shape = (
         cfg.num_layers,
@@ -511,8 +536,7 @@ def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
 def dense_layer(
     x: jax.Array,            # [T, h]
     lp: dict,                # ONE layer's params (leaves already indexed)
-    cache: jax.Array,        # [n_layers_here, n_pages, page_size, 2*n_kv, d]
-    layer_idx: int,          # row of `cache` this layer writes/reads
+    cache_l: jax.Array,      # ONE layer's pages [n_pages, page_size, 2*n_kv, d]
     positions: jax.Array,
     write_pages: jax.Array,
     write_offs: jax.Array,
@@ -521,16 +545,17 @@ def dense_layer(
     cu_q_lens: jax.Array,
     num_seqs: jax.Array,
     cfg: ModelConfig,
-    engine: EngineConfig,
     tp: int = 1,
     mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """One transformer block over a ragged token batch: attn-norm → fused
     qkv → rope → in-place page scatter → ragged paged attention → wo →
-    mlp. Shared by :func:`forward_hidden` (cache carries ALL layers,
-    ``layer_idx`` = l) and the pipeline-parallel stage body
-    (parallel/pipeline.py — cache carries only the stage's layer slice),
-    so the layer math cannot drift between the two."""
+    mlp. Shared by :func:`forward_hidden` (per-layer tuple cache) and the
+    pipeline-parallel stage body (parallel/pipeline.py — stage-stacked
+    cache, sliced per layer), so the layer math cannot drift. Operating
+    on ONE layer's page array is also the perf contract: the Pallas
+    attention call must see its own buffer, not a slice of a stacked
+    tensor (see :func:`init_cache`)."""
     T = x.shape[0]
     sm_scale = cfg.head_dim ** -0.5
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -539,27 +564,27 @@ def dense_layer(
     q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
     k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
     kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-    cache = cache.at[layer_idx, write_pages, write_offs].set(kvn)
+    cache_l = cache_l.at[write_pages, write_offs].set(kvn)
     if mesh is not None:
         attn = sharded_ragged_attention(
-            mesh, q, cache[layer_idx], kv_lens, block_tables, cu_q_lens,
+            mesh, q, cache_l, kv_lens, block_tables, cu_q_lens,
             num_seqs, sm_scale=sm_scale,
         )
     else:
         attn = ragged_paged_attention(
-            q, cache[layer_idx], kv_lens, block_tables, cu_q_lens, num_seqs,
+            q, cache_l, kv_lens, block_tables, cu_q_lens, num_seqs,
             sm_scale=sm_scale,
         )
     x = x + _dot(attn.reshape(T, cfg.q_size), lp["wo"]).astype(x.dtype)
     x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
-    return x, cache
+    return x, cache_l
 
 
 # -- the unified forward ----------------------------------------------------
 
 def forward_tokens(
     params: Params,
-    cache: jax.Array,        # [L, n_pages, page_size, 2*n_kv, d] (donated)
+    cache: tuple,            # L x [n_pages, page_size, 2*n_kv, d] (donated)
     tokens: jax.Array,       # [T] i32 — all scheduled tokens, ragged-concat
     positions: jax.Array,    # [T] i32 — absolute position of each token
     write_pages: jax.Array,  # [T] i32 — destination page (garbage for pads)
@@ -620,20 +645,21 @@ def forward_hidden(
         x = jnp.where(mm_mask[:, None], mm_embeds.astype(x.dtype), x)
     lp_all = params["layers"]
 
+    layer_caches = list(cache)
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
-        x, cache = dense_layer(
-            x, lp, cache, l, positions, write_pages, write_offs,
-            kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine,
+        x, layer_caches[l] = dense_layer(
+            x, lp, layer_caches[l], positions, write_pages, write_offs,
+            kv_lens, block_tables, cu_q_lens, num_seqs, cfg,
             tp=tp, mesh=mesh,
         )
 
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), cache
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), tuple(layer_caches)
 
 
 def forward_ring_prefill(
     params: Params,
-    cache: jax.Array,        # paged cache (donated)
+    cache: tuple,            # per-layer paged cache (donated)
     tokens: jax.Array,       # [T] i32, ONE prompt, bucket-padded
     write_pages: jax.Array,  # [T] i32 (garbage page for pad rows)
     write_offs: jax.Array,   # [T] i32
@@ -662,6 +688,7 @@ def forward_ring_prefill(
     x = params["embed"][tokens]  # [T, h]
     lp_all = params["layers"]
 
+    layer_caches = list(cache)
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -671,7 +698,7 @@ def forward_ring_prefill(
         k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
         v3 = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
         kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-        cache = cache.at[l, write_pages, write_offs].set(kvn)
+        layer_caches[l] = layer_caches[l].at[write_pages, write_offs].set(kvn)
         attn = ring_attention(q, k, v3, mesh=sp_mesh, axis_name=axis_name)
         attn = attn.reshape(T, cfg.q_size)
         x = x + _dot(attn, lp["wo"]).astype(x.dtype)
@@ -679,7 +706,7 @@ def forward_ring_prefill(
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jax.lax.dynamic_slice_in_dim(x, last_row, 1, axis=0)  # [1, h]
-    return _logits(last, params, cfg), cache
+    return _logits(last, params, cfg), tuple(layer_caches)
 
 
 def embed_forward(

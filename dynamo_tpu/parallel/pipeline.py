@@ -198,18 +198,21 @@ def plan_microbatches(
 def _stage_layers(
     x, layers_local, cache_local, positions, write_pages, write_offs,
     kv_lens, block_tables, cu_q_lens, num_seqs, cfg: ModelConfig,
-    engine: EngineConfig,
 ):
     """One stage's layer slice over one microbatch: the SAME
-    :func:`model.dense_layer` block as forward_hidden, against the
-    stage-local ``[Lp, ...]`` cache slice (layer math cannot drift)."""
+    :func:`model.dense_layer` block as forward_hidden, sliced out of the
+    stage-local stacked ``[Lp, ...]`` cache (pp keeps the stacked layout
+    — the layer axis IS the stage sharding — and pays the slice
+    roundtrip the engine's tuple cache avoids; pp is a capacity mode,
+    not the single-chip fast path)."""
     Lp = cache_local.shape[0]
     for j in range(Lp):
         lp = jax.tree.map(lambda a: a[j], layers_local)
-        x, cache_local = dense_layer(
-            x, lp, cache_local, j, positions, write_pages, write_offs,
-            kv_lens, block_tables, cu_q_lens, num_seqs, cfg, engine,
+        x, cache_j = dense_layer(
+            x, lp, cache_local[j], positions, write_pages, write_offs,
+            kv_lens, block_tables, cu_q_lens, num_seqs, cfg,
         )
+        cache_local = cache_local.at[j].set(cache_j)
     return x, cache_local
 
 
@@ -239,7 +242,7 @@ def _pp_program(
         pages = jnp.where(valid, mb_pages[mbc], engine.garbage_block)
         x, cache = _stage_layers(
             x, params["layers"], cache, pos, pages, mb_offs[mbc],
-            mb_kv_lens[mbc], block_tables, mb_cu[mbc], num_seqs, cfg, engine,
+            mb_kv_lens[mbc], block_tables, mb_cu[mbc], num_seqs, cfg,
         )
         # Last stage banks each sequence's last-token hidden state the
         # round its microbatch drains.
@@ -363,7 +366,7 @@ def _pp_decode_round_body(
 
     x, cache = _stage_layers(
         x, params["layers"], cache, pos, write_pages, write_offs,
-        kv_lens, table, cu, num_seqs, cfg, engine,
+        kv_lens, table, cu, num_seqs, cfg,
     )
     # Exit: the last stage's final-norm rows, replicated; then this
     # stage's V/pp slice of the logits.

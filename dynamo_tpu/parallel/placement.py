@@ -102,10 +102,12 @@ def memory_plan(
         else:
             param_bytes += math.ceil(n / div) * sd.dtype.itemsize
 
-    cache_shape = jax.eval_shape(lambda: init_cache(model, engine))
-    cache_n = math.prod(cache_shape.shape)
-    # cache [L, pages, bs, 2kv, d]: combined-head axis over tp.
-    cache_bytes = math.ceil(cache_n / tp) * cache_shape.dtype.itemsize
+    # Per-layer tuple cache (model.init_cache): combined-head axis over tp.
+    cache_shapes = jax.eval_shape(lambda: init_cache(model, engine))
+    cache_bytes = sum(
+        math.ceil(math.prod(s.shape) / tp) * s.dtype.itemsize
+        for s in cache_shapes
+    )
 
     return MemoryPlan(
         param_bytes_per_chip=param_bytes,

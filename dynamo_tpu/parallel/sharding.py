@@ -97,8 +97,10 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """[L, n_pages, page_size, 2*n_kv, d] — combined-head axis on tp."""
-    return NamedSharding(mesh, P(None, None, None, "tp", None))
+    """Per-layer cache pages [n_pages, page_size, 2*n_kv, d] —
+    combined-head axis on tp. One sharding covers every element of the
+    per-layer tuple (model.init_cache) as a pytree prefix."""
+    return NamedSharding(mesh, P(None, None, "tp", None))
 
 
 def decode_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:

@@ -17,6 +17,7 @@ from dynamo_tpu.engine.model import (
     decode_tokens,
     forward_tokens,
     init_cache,
+    init_cache_stacked,
     init_params,
 )
 from dynamo_tpu.parallel.pipeline import (
@@ -132,7 +133,9 @@ def test_pp_prefill_matches_single_device(n_micro):
 
     mesh = make_pp_mesh(4)
     params_pp = shard_params_pp(params, CFG, mesh)
-    cache_pp = jax.device_put(init_cache(CFG, ENG), cache_sharding_pp(mesh))
+    cache_pp = jax.device_put(
+        init_cache_stacked(CFG, ENG), cache_sharding_pp(mesh)
+    )
     got_logits, got_cache = pp_prefill(params_pp, cache_pp, wave, mesh, n_micro)
 
     np.testing.assert_allclose(
@@ -140,9 +143,11 @@ def test_pp_prefill_matches_single_device(n_micro):
     )
     # Garbage page excluded: both paths scribble pad/bubble writes there
     # (its content is unspecified by contract; nothing reads it unmasked).
+    # want_cache is the engine's per-layer tuple; got_cache is pp-stacked.
     real = slice(0, ENG.num_kv_blocks)
+    want_stacked = np.stack([np.asarray(c) for c in want_cache])
     np.testing.assert_allclose(
-        np.asarray(got_cache)[:, real], np.asarray(want_cache)[:, real],
+        np.asarray(got_cache)[:, real], want_stacked[:, real],
         rtol=2e-4, atol=2e-4,
     )
 
@@ -172,7 +177,9 @@ def test_pp_decode_step_matches_single_device():
 
     mesh = make_pp_mesh(4)
     params_pp = shard_params_pp(params, CFG, mesh)
-    cache_pp = jax.device_put(init_cache(CFG, ENG), cache_sharding_pp(mesh))
+    cache_pp = jax.device_put(
+        init_cache_stacked(CFG, ENG), cache_sharding_pp(mesh)
+    )
     _, cache_pp = pp_prefill(params_pp, cache_pp, wave, mesh, 3)
 
     # Decode wave in the ragged layout: B rows, q_len 1 each.

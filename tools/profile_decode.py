@@ -35,11 +35,14 @@ from dynamo_tpu.ops.ragged_attention import ragged_paged_attention
 
 
 def build_forward(cfg, engine, *, attn=True, scatter=True, head=True,
-                  dense_attn=False):
+                  dense_attn=False, stacked_cache=False):
     """One decode step over B lanes with stages toggleable. ``dense_attn``
     swaps the Pallas kernel for the pure-XLA gather/softmax reference —
     more raw bytes, but it fuses with the surrounding layer instead of
-    paying the custom-call boundary per layer."""
+    paying the custom-call boundary per layer. ``stacked_cache`` times the
+    pre-r5 [L, ...] single-array layout: its per-layer slices forced XLA
+    to materialize a copy at each Pallas call (measured +1.4 ms/step at
+    B=32 — the reason model.init_cache is a per-layer tuple now)."""
 
     def fwd(params, cache, tokens, block_tables, positions, active):
         B = tokens.shape[0]
@@ -63,20 +66,27 @@ def build_forward(cfg, engine, *, attn=True, scatter=True, head=True,
             q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
             k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
             kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-            if scatter:
-                cache = cache.at[l, write_pages, write_offs].set(kvn)
+            if stacked_cache:
+                if scatter:
+                    cache = cache.at[l, write_pages, write_offs].set(kvn)
+                cache_l = cache[l]
+            else:
+                cache_l = cache[l]
+                if scatter:
+                    cache_l = cache_l.at[write_pages, write_offs].set(kvn)
+                    cache = cache[:l] + (cache_l,) + cache[l + 1:]
             if attn and dense_attn:
                 from dynamo_tpu.ops.ragged_attention import (
                     ragged_paged_attention_ref,
                 )
 
                 a = ragged_paged_attention_ref(
-                    q, cache[l], kv_lens, block_tables, cu, num_seqs,
+                    q, cache_l, kv_lens, block_tables, cu, num_seqs,
                     sm_scale=sm_scale,
                 )
             elif attn:
                 a = ragged_paged_attention(
-                    q, cache[l], kv_lens, block_tables, cu, num_seqs,
+                    q, cache_l, kv_lens, block_tables, cu, num_seqs,
                     sm_scale=sm_scale,
                 )
             else:
@@ -179,6 +189,7 @@ def main():
 
     variants = [
         ("full", dict()),
+        ("full_stacked_cache", dict(stacked_cache=True)),
         ("full_unrolled", dict(unroll=True)),
         ("full_dense_attn", dict(dense_attn=True)),
         ("no_attn", dict(attn=False)),
@@ -194,7 +205,11 @@ def main():
         variants = [v for v in variants if v[0] == args.only]
     results = {}
     for name, flags in variants:
-        cache = init_cache(cfg, engine)
+        cache = init_cache(cfg, engine)  # per-layer tuple (engine layout)
+        if flags.get("stacked_cache"):
+            from dynamo_tpu.engine.model import init_cache_stacked
+
+            cache = init_cache_stacked(cfg, engine)
         fn = build_chain(cfg, engine, n_steps, **flags)
         t, cache = timeit(fn, (params, cache, tokens, tables, positions, active), cache)
         del cache
