@@ -211,7 +211,14 @@ def _eos_for(tokenizer: str) -> tuple[int, ...]:
         from dynamo_tpu.llm.tokenizer import ByteTokenizer
 
         return (ByteTokenizer.EOS,)
-    return ()
+    try:
+        from dynamo_tpu.llm.tokenizer import load_tokenizer
+
+        eos = load_tokenizer(tokenizer).eos_token_id
+        return (eos,) if eos is not None else ()
+    except Exception:  # noqa: BLE001 — serving without eos still works
+        log.warning("could not resolve eos for tokenizer %r", tokenizer)
+        return ()
 
 
 def _model_card(model_name: str, tokenizer: str, core) -> ModelDeploymentCard:
@@ -242,6 +249,7 @@ def build_engine(
     pp: int = 1,
     quant: str | None = None,
     moe_dispatch: str | None = None,
+    model_path: str | None = None,
     core_cls=None,
     core_kwargs: dict[str, Any] | None = None,
 ):
@@ -273,15 +281,35 @@ def build_engine(
         tiny_engine,
     )
 
-    model_cfg = PRESETS[preset]()
+    loaded_params = None
+    if model_path is not None:
+        # Serve real weights from an HF checkpoint directory (llama or
+        # qwen2 family — engine/loader.py; the reference resolves HF
+        # repos the same way, lib/llm/src/local_model.rs:429). The fused
+        # layout is built for the serving tp; pp keeps tp=1 layouts.
+        # int8 quantizes host-side inside the loader so the device never
+        # holds the bf16 footprint (the 8B-on-one-16GB-chip mode).
+        from dynamo_tpu.engine.loader import load_hf_llama
+
+        if quant == "int8" and pp > 1:
+            raise ValueError("int8 under pipeline parallelism: not wired yet")
+        model_cfg, loaded_params = load_hf_llama(
+            model_path, tp=tp if tp > 1 else 1, quant=quant
+        )
+        quant = None  # handled by the loader; skip the random-init path
+    else:
+        model_cfg = PRESETS[preset]()
     if moe_dispatch is not None:
         if not model_cfg.is_moe:
             raise ValueError(f"--moe-dispatch set but preset {preset!r} is dense")
         model_cfg = dataclasses.replace(model_cfg, moe_dispatch=moe_dispatch)
     overrides = dict(engine_overrides or {})
-    if preset in ("tiny", "tiny-moe"):
+    if preset in ("tiny", "tiny-moe") and model_path is None:
         engine_cfg = tiny_engine(**overrides)
     else:
+        # Checkpoint serving uses the full-size engine defaults (the
+        # --preset default of "tiny" selects a MODEL, which --model-path
+        # replaces; it must not also shrink the engine limits).
         engine_cfg = EngineConfig(**overrides) if overrides else EngineConfig()
     mesh = None
     sp_mesh = None
@@ -325,7 +353,7 @@ def build_engine(
             if not buckets:
                 buckets = (dp * max(1, engine_cfg.decode_buckets[-1] // dp),)
             engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
-    params = None
+    params = loaded_params
     if quant == "int8":
         if pp_mesh is not None:
             raise ValueError("int8 under pipeline parallelism: not wired yet")
@@ -378,11 +406,16 @@ async def run_jax_worker(
     pp: int = 1,
     quant: str | None = None,
     moe_dispatch: str | None = None,
+    model_path: str | None = None,
     nnodes: int = 1,
     node_rank: int = 0,
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
+    if model_path is not None and tokenizer == "byte":
+        # HF checkpoints carry their tokenizer; serve with it unless the
+        # caller explicitly chose another.
+        tokenizer = model_path
     if nnodes > 1:
         # Multi-host lockstep (backends/jax/multihost.py): the caller has
         # already joined the jax.distributed runtime; here the engine is
@@ -397,6 +430,13 @@ async def run_jax_worker(
         if pp > 1:
             raise ValueError(
                 "--pp (pipeline parallel) is not supported under --nnodes yet"
+            )
+        if model_path is not None:
+            # Silently serving random preset weights with the
+            # checkpoint's tokenizer would be the worst failure mode.
+            raise ValueError(
+                "--model-path is not supported under --nnodes yet "
+                "(per-rank checkpoint loading is not wired)"
             )
         if (engine_overrides or {}).get("held_block_ttl_s", 0) != 0:
             raise ValueError("held_block_ttl_s must be 0 under multi-host")
@@ -422,7 +462,10 @@ async def run_jax_worker(
             lambda: loop.create_task(kv_pub.removed(hashes))
         )
 
-    eos = _eos_for(tokenizer)
+    # Off the event loop like the build below: resolving eos for an HF
+    # tokenizer reads tokenizer.json, and blocking the loop starves the
+    # store lease keepalive.
+    eos = await asyncio.to_thread(_eos_for, tokenizer)
 
     # Build (and compile) off the event loop: on real TPU hardware the
     # first jit takes tens of seconds, and blocking the loop that long
@@ -442,6 +485,7 @@ async def run_jax_worker(
         pp=pp,
         quant=quant,
         moe_dispatch=moe_dispatch,
+        model_path=model_path,
     )
 
     if core_out is not None:
@@ -968,7 +1012,8 @@ def main() -> None:
     ap.add_argument("--model-name", default="tiny")
     ap.add_argument(
         "--preset", default="tiny",
-        choices=["tiny", "tiny-moe", "llama3-1b", "llama3-8b", "llama3-70b", "mixtral-8x7b"],
+        choices=["tiny", "tiny-moe", "llama3-1b", "llama3-8b", "llama3-70b",
+                 "qwen2-7b", "mixtral-8x7b"],
     )
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default=None, help="defaults by role")
@@ -980,6 +1025,10 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
+    ap.add_argument("--model-path", default=None,
+                    help="HF checkpoint directory (llama/qwen2 family); "
+                         "overrides --preset and defaults the tokenizer "
+                         "to the checkpoint's")
     ap.add_argument("--moe-dispatch", default=None,
                     choices=["replicated", "alltoall"],
                     help="EP dispatch mode for MoE presets (alltoall = "
@@ -1074,6 +1123,7 @@ def main() -> None:
             pp=args.pp,
             quant=args.quant,
             moe_dispatch=args.moe_dispatch,
+            model_path=args.model_path,
             nnodes=args.nnodes,
             node_rank=args.node_rank,
         )

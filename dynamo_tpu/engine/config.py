@@ -37,6 +37,9 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # Byte-level models (test tokenizer) tie embeddings to save params.
     tie_embeddings: bool = False
+    # Qwen2-family attention: biases on the fused qkv projection only
+    # (o/gate/up/down stay bias-free, per the architecture).
+    attn_qkv_bias: bool = False
     # Sparse MoE (Mixtral-style): 0 experts = dense MLP. Experts shard
     # over the mesh's model axis (expert parallelism, SURVEY.md §2.6).
     num_experts: int = 0
@@ -197,6 +200,25 @@ def llama3_1b() -> ModelConfig:
     )
 
 
+def qwen2_7b() -> ModelConfig:
+    """Qwen2.5-7B: GQA llama-family body + qkv biases (the family's one
+    architectural delta; reference serves Qwen through its engines, e.g.
+    the DSR1-distill recipes)."""
+    return ModelConfig(
+        name="qwen2-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attn_qkv_bias=True,
+    )
+
+
 def mixtral_8x7b() -> ModelConfig:
     return ModelConfig(
         name="mixtral-8x7b",
@@ -265,6 +287,7 @@ PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "llama3-1b": llama3_1b,
+    "qwen2-7b": qwen2_7b,
     "mixtral-8x7b": mixtral_8x7b,
     "tiny": tiny_model,
     "tiny-moe": tiny_moe,

@@ -41,6 +41,9 @@ def config_from_hf(path: str | Path) -> ModelConfig:
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         tie_embeddings=hf.get("tie_word_embeddings", False),
+        # Qwen2-family checkpoints carry qkv biases (the architecture's
+        # one delta from llama; qwen3 dropped them again).
+        attn_qkv_bias=hf.get("model_type") == "qwen2",
     )
 
 
@@ -77,14 +80,32 @@ def _read_state_dict(path: Path) -> dict[str, np.ndarray]:
     return tensors
 
 
-def load_hf_llama(path: str | Path, dtype=None, tp: int = 1) -> tuple[ModelConfig, Any]:
-    """Returns (ModelConfig, params pytree) from an HF llama checkpoint.
+def _quantize_np(w: np.ndarray) -> dict[str, Any]:
+    """Host-side numpy twin of model.quantize_weight (per-output-channel
+    symmetric int8) — quantizing BEFORE the device transfer is what lets
+    a 16 GB chip load a model whose bf16 weights alone would not fit."""
+    import jax.numpy as jnp
+
+    scale = np.maximum(np.abs(w).max(axis=-2, keepdims=True) / 127.0, 1e-8)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return {"w": jnp.asarray(q), "scale": jnp.asarray(scale.astype(np.float32))}
+
+
+def load_hf_llama(
+    path: str | Path, dtype=None, tp: int = 1, quant: str | None = None
+) -> tuple[ModelConfig, Any]:
+    """Returns (ModelConfig, params pytree) from an HF llama/qwen2
+    checkpoint.
 
     ``tp`` fixes the shard-blocked layout of the fused wqkv/wgu projections
     (model.fuse_qkv/fuse_gu) and must match the serving mesh's tp axis.
+    ``quant='int8'`` quantizes the projections host-side so the device
+    only ever sees the int8 footprint (the llama3-8b-on-one-chip mode).
     """
     import jax.numpy as jnp
 
+    if quant not in (None, "int8"):
+        raise ValueError(f"unknown quantization {quant!r}")
     path = Path(path)
     cfg = config_from_hf(path)
     dt = dtype or cfg.jax_dtype
@@ -121,15 +142,40 @@ def load_hf_llama(path: str | Path, dtype=None, tp: int = 1) -> tuple[ModelConfi
         "wgu": _fuse_np([stack("mlp.gate_proj"), stack("mlp.up_proj")], tp),
         "w_down": stack("mlp.down_proj"),
     }
+    if cfg.attn_qkv_bias:
+        def bias(name: str) -> np.ndarray:
+            return np.stack(
+                [t(f"model.layers.{i}.{name}.bias") for i in range(L)]
+            )
+
+        layers["bqkv"] = _fuse_np(
+            [
+                bias("self_attn.q_proj"),
+                bias("self_attn.k_proj"),
+                bias("self_attn.v_proj"),
+            ],
+            tp,
+        )
+    def place(name: str, v: np.ndarray):
+        if quant == "int8" and name in ("wqkv", "wo", "wgu", "w_down"):
+            return _quantize_np(v)  # projections int8; norms/bias at dt
+        return jnp.asarray(v, dt)
+
     params: dict[str, Any] = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
-        "layers": {k: jnp.asarray(v, dt) for k, v in layers.items()},
+        "layers": {k: place(k, v) for k, v in layers.items()},
         "final_norm": jnp.asarray(t("model.norm.weight"), dt),
         # The fuse layout is tp-dependent; record it so serving can verify
         # params match the mesh (EngineCore asserts fuse_tp == mesh tp).
         "fuse_tp": jnp.asarray(tp, jnp.int32),
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = jnp.asarray(t("lm_head.weight").T, dt)
-    log.info("loaded %s: %d layers, vocab %d", path, L, cfg.vocab_size)
+        head = t("lm_head.weight").T
+        params["lm_head"] = (
+            _quantize_np(head) if quant == "int8" else jnp.asarray(head, dt)
+        )
+    log.info(
+        "loaded %s: %d layers, vocab %d%s", path, L, cfg.vocab_size,
+        " (int8 weight-only)" if quant == "int8" else "",
+    )
     return cfg, params

@@ -110,6 +110,11 @@ def init_params_quantized(rng: jax.Array, cfg: ModelConfig, tp: int = 1) -> Para
             "wgu": qdense_stacked(keys[5], (h, 2 * i), h),
             "w_down": qdense_stacked(keys[7], (i, h), i),
         }
+        if cfg.attn_qkv_bias:
+            layers["bqkv"] = dense(
+                jax.random.fold_in(rng, 11),
+                (L, cfg.q_size + 2 * cfg.kv_size), 1,
+            )  # biases stay unquantized
         params: Params = {
             "embed": dense(keys[0], (v, h), h),
             "layers": layers,
@@ -208,6 +213,13 @@ def init_params(rng: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
         "wqkv": fuse_qkv(wq, wk, wv, tp),
         "wo": dense(keys[4], (L, cfg.q_size, h), cfg.q_size),
     }
+    if cfg.attn_qkv_bias:
+        # Qwen2-family qkv bias, in the same shard-blocked fused column
+        # order as wqkv (random fused == fused random for init; the
+        # loader fuses real biases with _fuse_np).
+        layers["bqkv"] = dense(
+            jax.random.fold_in(rng, 11), (L, cfg.q_size + 2 * cfg.kv_size), 1
+        )
     if cfg.is_moe:
         E = cfg.num_experts
         layers["w_router"] = dense(jax.random.fold_in(rng, 7), (L, h, E), h)
@@ -559,7 +571,10 @@ def dense_layer(
     T = x.shape[0]
     sm_scale = cfg.head_dim ** -0.5
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
+    qkv = _dot(y, lp["wqkv"])
+    if "bqkv" in lp:  # Qwen2-family qkv bias (fused column order)
+        qkv = qkv + lp["bqkv"]
+    qkv = qkv.astype(x.dtype)
     q, k, v = split_qkv(qkv, cfg, tp)
     q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
     k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
@@ -692,7 +707,10 @@ def forward_ring_prefill(
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        qkv = _dot(y, lp["wqkv"]).astype(x.dtype)
+        qkv = _dot(y, lp["wqkv"])
+        if "bqkv" in lp:
+            qkv = qkv + lp["bqkv"]
+        qkv = qkv.astype(x.dtype)
         q, k, v = split_qkv(qkv, cfg)
         q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
         k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)

@@ -9,7 +9,10 @@ inverses over UTF-8, which incremental detokenization tests exploit.
 
 from __future__ import annotations
 
+import logging
 from typing import Protocol, Sequence, runtime_checkable
+
+log = logging.getLogger("dynamo_tpu.tokenizer")
 
 
 @runtime_checkable
@@ -85,11 +88,33 @@ class HFTokenizer:
 
 def load_tokenizer(spec: str) -> Tokenizer:
     """``"byte"`` → ByteTokenizer; ``*.gguf`` → the checkpoint's embedded
-    tokenizer (engine/gguf.py); anything else is a local HF path."""
+    tokenizer (engine/gguf.py); anything else is a local HF path. A
+    checkpoint directory without tokenizer files serves byte-level with a
+    warning instead of killing worker startup (weights-only checkpoints
+    are common in tests and conversions)."""
     if spec == "byte":
         return ByteTokenizer()
     if spec.endswith(".gguf"):
         from dynamo_tpu.engine.gguf import GGUFTokenizer, read_gguf
 
         return GGUFTokenizer.from_gguf(read_gguf(spec))
-    return HFTokenizer(spec)
+    try:
+        return HFTokenizer(spec)
+    except Exception as e:  # noqa: BLE001 — see the narrowing below
+        from pathlib import Path
+
+        p = Path(spec)
+        tok_files = (
+            "tokenizer.json", "tokenizer_config.json", "vocab.json",
+            "tokenizer.model",
+        )
+        if p.is_dir() and not any((p / f).exists() for f in tok_files):
+            # Weights-only checkpoint directory: degrade, loudly. A
+            # mistyped path or a CORRUPT tokenizer still fails fast — only
+            # the genuinely-absent case falls back.
+            log.warning(
+                "checkpoint %r has no tokenizer files; serving byte-level",
+                spec,
+            )
+            return ByteTokenizer()
+        raise

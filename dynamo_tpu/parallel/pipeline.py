@@ -82,6 +82,8 @@ def pp_param_specs(cfg: ModelConfig, pp: int) -> dict[str, Any]:
         "wqkv": P("pp"),
         "wo": P("pp"),
     }
+    if cfg.attn_qkv_bias:
+        layers["bqkv"] = P("pp")
     if cfg.is_moe:
         layers["w_router"] = P("pp")
         layers["w_gate"] = P("pp")

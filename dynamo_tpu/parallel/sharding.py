@@ -62,6 +62,8 @@ def param_partition_specs(cfg: ModelConfig, tp: int) -> dict[str, Any]:
         "wqkv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
     }
+    if cfg.attn_qkv_bias:
+        layers["bqkv"] = P(None, "tp")  # fused column order, like wqkv
     if cfg.is_moe:
         # Expert parallelism: the expert axis shards over the model axis;
         # the expert-sum contraction becomes a psum over 'tp'.

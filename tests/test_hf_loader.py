@@ -53,6 +53,104 @@ def test_loader_matches_transformers_logits(hf_checkpoint):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
 
 
+@pytest.fixture(scope="module")
+def qwen2_checkpoint(tmp_path_factory):
+    cfg = transformers.Qwen2Config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(cfg)
+    path = tmp_path_factory.mktemp("hf-tiny-qwen2")
+    model.save_pretrained(path)
+    return path, model
+
+
+def test_qwen2_loader_matches_transformers_logits(qwen2_checkpoint):
+    """Qwen2 family: same llama body + qkv biases — the bias must ride
+    the fused shard-blocked layout and land in dense_layer's qkv add."""
+    path, hf_model = qwen2_checkpoint
+    cfg, params = load_hf_llama(path, dtype=jnp.float32)
+    assert cfg.attn_qkv_bias and "bqkv" in params["layers"]
+
+    prompt = [3, 17, 42, 99, 7, 64, 23, 5]
+    with torch.no_grad():
+        want = hf_model(torch.tensor([prompt])).logits[0, -1].numpy()
+
+    eng = EngineConfig(
+        num_kv_blocks=16, block_size=8, max_num_seqs=2, max_model_len=64,
+        prefill_buckets=(16, 32), decode_buckets=(2,),
+    )
+    cache = init_cache(cfg, eng, dtype=jnp.float32)
+    got, _ = prefill_chunk(params, cache, prompt, 0, [0, 1], cfg, eng, 16)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen2_tp_layout_same_model(qwen2_checkpoint):
+    """tp=2-fused qwen2 params (weights AND biases) describe the same
+    model: sharded engine output matches the tp=1 load exactly."""
+    from dynamo_tpu.parallel.sharding import (
+        cache_sharding,
+        make_mesh,
+        shard_params,
+    )
+    from tests.model_harness import prefill_chunk as chunk
+
+    path, _ = qwen2_checkpoint
+    cfg, p1 = load_hf_llama(path, dtype=jnp.float32, tp=1)
+    _, p2 = load_hf_llama(path, dtype=jnp.float32, tp=2)
+    eng = EngineConfig(
+        num_kv_blocks=16, block_size=8, max_num_seqs=2, max_model_len=64,
+        prefill_buckets=(16, 32), decode_buckets=(2,),
+    )
+    prompt = [5, 9, 100, 42, 77]
+    want, _ = chunk(p1, init_cache(cfg, eng, dtype=jnp.float32), prompt, 0,
+                    [0], cfg, eng, 16)
+    import jax
+
+    mesh = make_mesh(dp=1, tp=2)
+    sp = shard_params(p2, cfg, mesh)
+    cd = jax.device_put(
+        init_cache(cfg, eng, dtype=jnp.float32), cache_sharding(mesh)
+    )
+    got, _ = chunk(sp, cd, prompt, 0, [0], cfg, eng, 16, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_loader_host_side_int8_matches_device_quantize(hf_checkpoint):
+    """load_hf_llama(quant='int8') quantizes host-side (the device never
+    holds the bf16 footprint — the 8B-on-16GB mode); its values must
+    match quantize_params applied after a plain load."""
+    from dynamo_tpu.engine.model import quantize_params
+
+    path, _ = hf_checkpoint
+    cfg, p_host = load_hf_llama(path, dtype=jnp.float32, quant="int8")
+    _, p_plain = load_hf_llama(path, dtype=jnp.float32)
+    p_dev = quantize_params(p_plain)
+    for k in ("wqkv", "wo", "wgu", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(p_host["layers"][k]["w"]),
+            np.asarray(p_dev["layers"][k]["w"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(p_host["layers"][k]["scale"]),
+            np.asarray(p_dev["layers"][k]["scale"]), rtol=1e-6,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(p_host["lm_head"]["w"]), np.asarray(p_dev["lm_head"]["w"])
+    )
+
+
 def test_loader_tp_blocked_layout_matches_tp1(hf_checkpoint):
     """load_hf_llama(tp=2) is a column permutation of tp=1 — same model."""
     from dynamo_tpu.engine.model import split_gu, split_qkv
