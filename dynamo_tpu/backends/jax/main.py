@@ -293,9 +293,7 @@ def build_engine(
 
         if quant == "int8" and pp > 1:
             raise ValueError("int8 under pipeline parallelism: not wired yet")
-        model_cfg, loaded_params = load_hf_llama(
-            model_path, tp=tp if tp > 1 else 1, quant=quant
-        )
+        model_cfg, loaded_params = load_hf_llama(model_path, tp=tp, quant=quant)
         quant = None  # handled by the loader; skip the random-init path
     else:
         model_cfg = PRESETS[preset]()
@@ -394,7 +392,7 @@ async def run_jax_worker(
     namespace: str = "dynamo",
     component: str | None = None,
     engine_overrides: dict[str, Any] | None = None,
-    tokenizer: str = "byte",
+    tokenizer: str | None = None,
     seed: int = 0,
     role: str = "aggregated",   # aggregated | prefill | decode
     disagg_config: DisaggConfig | None = None,
@@ -412,10 +410,11 @@ async def run_jax_worker(
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
-    if model_path is not None and tokenizer == "byte":
-        # HF checkpoints carry their tokenizer; serve with it unless the
-        # caller explicitly chose another.
-        tokenizer = model_path
+    if tokenizer is None:
+        # Unset: HF checkpoints serve with their own tokenizer; presets
+        # default to byte-level. An EXPLICIT --tokenizer byte (or any
+        # other spec) always wins.
+        tokenizer = model_path if model_path is not None else "byte"
     if nnodes > 1:
         # Multi-host lockstep (backends/jax/multihost.py): the caller has
         # already joined the jax.distributed runtime; here the engine is
@@ -764,7 +763,7 @@ async def _run_multihost(
 
     import msgpack
 
-    eos = _eos_for(tokenizer)
+    eos = await asyncio.to_thread(_eos_for, tokenizer)
     loop = asyncio.get_running_loop()
     subject = steps_subject(namespace, component)
     worker_id = runtime.primary_lease_id
@@ -1017,7 +1016,9 @@ def main() -> None:
     )
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default=None, help="defaults by role")
-    ap.add_argument("--tokenizer", default="byte", help="'byte' or an HF tokenizer path")
+    ap.add_argument("--tokenizer", default=None,
+                    help="'byte' or an HF tokenizer path (default: the "
+                         "checkpoint's with --model-path, else byte)")
     ap.add_argument("--num-kv-blocks", type=int, default=None)
     ap.add_argument("--block-size", type=int, default=None)
     ap.add_argument("--max-num-seqs", type=int, default=None)

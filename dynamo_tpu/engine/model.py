@@ -298,16 +298,33 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding; x: [..., T, n, d], positions: [..., T]."""
-    d = x.shape[-1]
+def rope_tables(
+    positions: jax.Array, d: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) ``[..., T, d/2]`` for :func:`rope_apply`. Positions are
+    the same for every layer of a forward pass, so the tables are
+    computed ONCE per program instead of twice per layer (the transcend-
+    entals are VPU work that used to recur 2L times per wave)."""
     freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, d/2]
-    cos = jnp.cos(angles)[..., None, :]
-    sin = jnp.sin(angles)[..., None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def rope_apply(
+    x: jax.Array, cos: jax.Array, sin: jax.Array
+) -> jax.Array:
+    """Rotate ``x`` ``[..., T, n, d]`` by precomputed tables ``[..., T, d/2]``."""
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [..., T, n, d], positions: [..., T]."""
+    cos, sin = rope_tables(positions, x.shape[-1], theta)
+    return rope_apply(x, cos, sin)
 
 
 def _mlp(x, lp, cfg: ModelConfig, tp: int, mesh=None):
@@ -559,6 +576,7 @@ def dense_layer(
     cfg: ModelConfig,
     tp: int = 1,
     mesh=None,
+    rope_cs: tuple[jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One transformer block over a ragged token batch: attn-norm → fused
     qkv → rope → in-place page scatter → ragged paged attention → wo →
@@ -567,17 +585,20 @@ def dense_layer(
     cache, sliced per layer), so the layer math cannot drift. Operating
     on ONE layer's page array is also the perf contract: the Pallas
     attention call must see its own buffer, not a slice of a stacked
-    tensor (see :func:`init_cache`)."""
+    tensor (see :func:`init_cache`). ``rope_cs`` carries the per-pass
+    precomputed rotary tables (:func:`rope_tables`)."""
     T = x.shape[0]
     sm_scale = cfg.head_dim ** -0.5
+    if rope_cs is None:
+        rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
     qkv = _dot(y, lp["wqkv"])
     if "bqkv" in lp:  # Qwen2-family qkv bias (fused column order)
         qkv = qkv + lp["bqkv"]
     qkv = qkv.astype(x.dtype)
     q, k, v = split_qkv(qkv, cfg, tp)
-    q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
-    k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+    q = rope_apply(q.reshape(T, cfg.num_heads, cfg.head_dim), *rope_cs)
+    k = rope_apply(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), *rope_cs)
     kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
     cache_l = cache_l.at[write_pages, write_offs].set(kvn)
     if mesh is not None:
@@ -660,13 +681,14 @@ def forward_hidden(
         x = jnp.where(mm_mask[:, None], mm_embeds.astype(x.dtype), x)
     lp_all = params["layers"]
 
+    rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     layer_caches = list(cache)
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
         x, layer_caches[l] = dense_layer(
             x, lp, layer_caches[l], positions, write_pages, write_offs,
             kv_lens, block_tables, cu_q_lens, num_seqs, cfg,
-            tp=tp, mesh=mesh,
+            tp=tp, mesh=mesh, rope_cs=rope_cs,
         )
 
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), tuple(layer_caches)
@@ -703,6 +725,7 @@ def forward_ring_prefill(
     x = params["embed"][tokens]  # [T, h]
     lp_all = params["layers"]
 
+    rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     layer_caches = list(cache)
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[l], lp_all)
@@ -712,8 +735,8 @@ def forward_ring_prefill(
             qkv = qkv + lp["bqkv"]
         qkv = qkv.astype(x.dtype)
         q, k, v = split_qkv(qkv, cfg)
-        q = rope(q.reshape(T, cfg.num_heads, cfg.head_dim), positions, cfg.rope_theta)
-        k = rope(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), positions, cfg.rope_theta)
+        q = rope_apply(q.reshape(T, cfg.num_heads, cfg.head_dim), *rope_cs)
+        k = rope_apply(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), *rope_cs)
         v3 = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
         kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
         layer_caches[l] = layer_caches[l].at[write_pages, write_offs].set(kvn)

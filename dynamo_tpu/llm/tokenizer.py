@@ -9,6 +9,7 @@ inverses over UTF-8, which incremental detokenization tests exploit.
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Protocol, Sequence, runtime_checkable
 
@@ -86,12 +87,16 @@ class HFTokenizer:
         return "\n".join(parts)
 
 
+@functools.lru_cache(maxsize=8)
 def load_tokenizer(spec: str) -> Tokenizer:
     """``"byte"`` → ByteTokenizer; ``*.gguf`` → the checkpoint's embedded
     tokenizer (engine/gguf.py); anything else is a local HF path. A
     checkpoint directory without tokenizer files serves byte-level with a
     warning instead of killing worker startup (weights-only checkpoints
-    are common in tests and conversions)."""
+    are common in tests and conversions). Cached per spec: eos
+    resolution and the preprocessor would otherwise parse the same
+    multi-MB tokenizer.json twice at startup (tokenizers are read-only
+    after construction)."""
     if spec == "byte":
         return ByteTokenizer()
     if spec.endswith(".gguf"):

@@ -207,12 +207,16 @@ def _stage_layers(
     — the layer axis IS the stage sharding — and pays the slice
     roundtrip the engine's tuple cache avoids; pp is a capacity mode,
     not the single-chip fast path)."""
+    from dynamo_tpu.engine.model import rope_tables
+
+    rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     Lp = cache_local.shape[0]
     for j in range(Lp):
         lp = jax.tree.map(lambda a: a[j], layers_local)
         x, cache_j = dense_layer(
             x, lp, cache_local[j], positions, write_pages, write_offs,
             kv_lens, block_tables, cu_q_lens, num_seqs, cfg,
+            rope_cs=rope_cs,
         )
         cache_local = cache_local.at[j].set(cache_j)
     return x, cache_local
