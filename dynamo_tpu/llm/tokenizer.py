@@ -88,24 +88,29 @@ class HFTokenizer:
 
 
 @functools.lru_cache(maxsize=8)
+def _load_cached(spec: str) -> Tokenizer:
+    if spec.endswith(".gguf"):
+        from dynamo_tpu.engine.gguf import GGUFTokenizer, read_gguf
+
+        return GGUFTokenizer.from_gguf(read_gguf(spec))
+    return HFTokenizer(spec)
+
+
 def load_tokenizer(spec: str) -> Tokenizer:
     """``"byte"`` → ByteTokenizer; ``*.gguf`` → the checkpoint's embedded
     tokenizer (engine/gguf.py); anything else is a local HF path. A
     checkpoint directory without tokenizer files serves byte-level with a
     warning instead of killing worker startup (weights-only checkpoints
-    are common in tests and conversions). Cached per spec: eos
-    resolution and the preprocessor would otherwise parse the same
-    multi-MB tokenizer.json twice at startup (tokenizers are read-only
-    after construction)."""
+    are common in tests and conversions). Successful loads are cached per
+    spec (eos resolution and the preprocessor would otherwise parse the
+    same multi-MB tokenizer.json twice at startup; tokenizers are
+    read-only after construction) — the byte-level FALLBACK is not, so a
+    tokenizer that appears later is picked up."""
     if spec == "byte":
         return ByteTokenizer()
-    if spec.endswith(".gguf"):
-        from dynamo_tpu.engine.gguf import GGUFTokenizer, read_gguf
-
-        return GGUFTokenizer.from_gguf(read_gguf(spec))
     try:
-        return HFTokenizer(spec)
-    except Exception as e:  # noqa: BLE001 — see the narrowing below
+        return _load_cached(spec)
+    except Exception:  # noqa: BLE001 — see the narrowing below
         from pathlib import Path
 
         p = Path(spec)

@@ -58,6 +58,7 @@ from dynamo_tpu.engine.model import (
     _logits,
     dense_layer,
     rms_norm,
+    rope_tables,
 )
 
 
@@ -207,8 +208,6 @@ def _stage_layers(
     — the layer axis IS the stage sharding — and pays the slice
     roundtrip the engine's tuple cache avoids; pp is a capacity mode,
     not the single-chip fast path)."""
-    from dynamo_tpu.engine.model import rope_tables
-
     rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
     Lp = cache_local.shape[0]
     for j in range(Lp):

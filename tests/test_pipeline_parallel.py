@@ -248,6 +248,45 @@ def test_engine_core_pp_matches_single_device():
     assert run(make_pp_mesh(4)) == run(None)
 
 
+def test_engine_core_pp_logprobs_match_single_device():
+    """Logprobs ride the wavefront chain (vocab-sharded lm head + the
+    banked per-round (te, ge) scatter) — values must match the
+    unpipelined engine's."""
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.protocols.common import (
+        OutputOptions,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    def run(pp_mesh):
+        core = EngineCore(CFG, ENG, seed=0, pp_mesh=pp_mesh)
+        seq = core.add_request(
+            PreprocessedRequest(
+                model="t", token_ids=list(range(5, 30)), request_id="r",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=5, ignore_eos=True),
+                output=OutputOptions(logprobs=2),
+            )
+        )
+        lps: list[dict] = []
+        for _ in range(100):
+            for s, out in core.step():
+                if out.logprobs:
+                    lps.extend(out.logprobs)
+            if seq.finish is not None:
+                return lps
+        raise AssertionError("never finished")
+
+    want = run(None)
+    got = run(make_pp_mesh(4))
+    assert [e["token_id"] for e in got] == [e["token_id"] for e in want]
+    for g, w in zip(got, want):
+        assert abs(g["logprob"] - w["logprob"]) < 1e-3
+        assert [t for t, _ in g["top"]] == [t for t, _ in w["top"]]
+
+
 def test_engine_core_pp_rejects_bad_buckets():
     import dataclasses
 

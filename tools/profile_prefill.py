@@ -35,12 +35,19 @@ def main():
 
     cfg = llama3_1b()
     T, S = args.bucket, args.seqs
+    if T % S:
+        raise SystemExit(f"--bucket {T} must be a multiple of --seqs {S}")
     per = T // S  # tokens per sequence
     eng = EngineConfig(
         num_kv_blocks=args.blocks, block_size=32, max_num_seqs=args.seqs,
         max_model_len=max(512, per), prefill_buckets=(args.bucket,),
         decode_buckets=(args.seqs,),
     )
+    if per % eng.block_size:
+        # The page assignment below tiles whole pages per sequence.
+        raise SystemExit(
+            f"tokens/seq {per} must be a multiple of block_size {eng.block_size}"
+        )
     bs = eng.block_size
     rng = np.random.RandomState(0)
 
@@ -101,7 +108,11 @@ def main():
         times.append(time.perf_counter() - t0)
     times.sort()
 
-    flops = 2 * T * (cfg.param_bytes() // 2)  # ~2*T*params (bf16 entries)
+    # Matmul FLOPs only: the embedding table is a gather (0 FLOPs) and
+    # the lm head runs over the S last rows, not all T.
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    per_layer = h * (cfg.q_size + 2 * cfg.kv_size) + cfg.q_size * h + 3 * h * i
+    flops = 2 * T * cfg.num_layers * per_layer + 2 * S * h * cfg.vocab_size
     peak = 197e12  # v5e bf16
     hbm = 819e9
     floor_flops = flops / peak * 1e3
