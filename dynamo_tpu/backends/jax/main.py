@@ -430,20 +430,13 @@ async def run_jax_worker(
             raise ValueError(
                 "--pp (pipeline parallel) is not supported under --nnodes yet"
             )
-        if model_path is not None:
-            # Silently serving random preset weights with the
-            # checkpoint's tokenizer would be the worst failure mode.
-            raise ValueError(
-                "--model-path is not supported under --nnodes yet "
-                "(per-rank checkpoint loading is not wired)"
-            )
         if (engine_overrides or {}).get("held_block_ttl_s", 0) != 0:
             raise ValueError("held_block_ttl_s must be 0 under multi-host")
         engine_overrides = dict(engine_overrides or {}, held_block_ttl_s=0)
         return await _run_multihost(
             runtime, model_name, preset, namespace, component,
             engine_overrides, tokenizer, seed, served_event, core_out,
-            tp, dp, quant, moe_dispatch, nnodes, node_rank,
+            tp, dp, quant, moe_dispatch, model_path, nnodes, node_rank,
         )
     worker_id = runtime.primary_lease_id
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
@@ -748,6 +741,7 @@ async def _run_multihost(
     dp: int,
     quant: str | None,
     moe_dispatch: str | None,
+    model_path: str | None,
     nnodes: int,
     node_rank: int,
 ) -> None:
@@ -807,6 +801,7 @@ async def _run_multihost(
             build_engine, preset, engine_overrides, seed=seed,
             eos_token_ids=eos, on_stored=on_stored, on_removed=on_removed,
             tp=tp, dp=dp, quant=quant, moe_dispatch=moe_dispatch,
+            model_path=model_path,
             core_cls=LeaderCore, core_kwargs={"publish": publish},
         )
         if core_out is not None:
@@ -853,7 +848,7 @@ async def _run_multihost(
     core, _engine = await asyncio.to_thread(
         build_engine, preset, engine_overrides, seed=seed,
         eos_token_ids=eos, tp=tp, dp=dp, quant=quant,
-        moe_dispatch=moe_dispatch,
+        moe_dispatch=moe_dispatch, model_path=model_path,
     )
     if core_out is not None:
         core_out.append(core)

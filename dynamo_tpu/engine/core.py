@@ -513,6 +513,9 @@ class EngineCore:
         else:
             if params is not None:
                 _check_fuse_tp(params, 1)
+                # Host pytrees (engine/loader.py returns numpy) land on
+                # device ONCE here; device arrays pass through untouched.
+                params = jax.device_put(params)
             self.params = params if params is not None else init_params(
                 jax.random.PRNGKey(seed), model_cfg
             )

@@ -82,13 +82,11 @@ def _read_state_dict(path: Path) -> dict[str, np.ndarray]:
 
 def _quantize_np(w: np.ndarray) -> dict[str, Any]:
     """Host-side numpy twin of model.quantize_weight (per-output-channel
-    symmetric int8) — quantizing BEFORE the device transfer is what lets
+    symmetric int8) — quantizing BEFORE any device transfer is what lets
     a 16 GB chip load a model whose bf16 weights alone would not fit."""
-    import jax.numpy as jnp
-
     scale = np.maximum(np.abs(w).max(axis=-2, keepdims=True) / 127.0, 1e-8)
     q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
-    return {"w": jnp.asarray(q), "scale": jnp.asarray(scale.astype(np.float32))}
+    return {"w": q, "scale": scale.astype(np.float32)}
 
 
 def load_hf_llama(
@@ -101,9 +99,12 @@ def load_hf_llama(
     (model.fuse_qkv/fuse_gu) and must match the serving mesh's tp axis.
     ``quant='int8'`` quantizes the projections host-side so the device
     only ever sees the int8 footprint (the llama3-8b-on-one-chip mode).
-    """
-    import jax.numpy as jnp
 
+    The returned pytree lives on HOST (numpy; bf16 via ml_dtypes): the
+    caller's placement (EngineCore device_put / shard_params) is the
+    FIRST device transfer, so sharded serving never materializes the
+    full model on one chip — a 70B pod loads rank-local shards only.
+    """
     if quant not in (None, "int8"):
         raise ValueError(f"unknown quantization {quant!r}")
     path = Path(path)
@@ -156,23 +157,25 @@ def load_hf_llama(
             ],
             tp,
         )
+    np_dt = np.dtype(dt)  # bf16 numpy dtype via jax's ml_dtypes registration
+
     def place(name: str, v: np.ndarray):
         if quant == "int8" and name in ("wqkv", "wo", "wgu", "w_down"):
             return _quantize_np(v)  # projections int8; norms/bias at dt
-        return jnp.asarray(v, dt)
+        return np.asarray(v, np_dt)
 
     params: dict[str, Any] = {
-        "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
+        "embed": np.asarray(t("model.embed_tokens.weight"), np_dt),
         "layers": {k: place(k, v) for k, v in layers.items()},
-        "final_norm": jnp.asarray(t("model.norm.weight"), dt),
+        "final_norm": np.asarray(t("model.norm.weight"), np_dt),
         # The fuse layout is tp-dependent; record it so serving can verify
         # params match the mesh (EngineCore asserts fuse_tp == mesh tp).
-        "fuse_tp": jnp.asarray(tp, jnp.int32),
+        "fuse_tp": np.asarray(tp, np.int32),
     }
     if not cfg.tie_embeddings:
         head = t("lm_head.weight").T
         params["lm_head"] = (
-            _quantize_np(head) if quant == "int8" else jnp.asarray(head, dt)
+            _quantize_np(head) if quant == "int8" else np.asarray(head, np_dt)
         )
     log.info(
         "loaded %s: %d layers, vocab %d%s", path, L, cfg.vocab_size,

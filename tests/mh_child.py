@@ -2,7 +2,12 @@
 builds the EngineCore over the GLOBAL dp=2 x tp=4 mesh, runs a scripted
 greedy workload, and writes its emitted tokens to a file.
 
-Run: python tests/mh_child.py <coordinator> <rank> <out_path>
+Run: python tests/mh_child.py <coordinator> <rank> <out_path> [ckpt_dir]
+
+With ``ckpt_dir``, every rank loads the SAME HF checkpoint host-side
+(engine/loader.py) and shard_params places each process's addressable
+shards onto the global mesh — the multi-host real-weights path that
+``--model-path --nnodes N`` exercises in production.
 """
 
 import json
@@ -14,6 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     coordinator, rank, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    ckpt = sys.argv[4] if len(sys.argv) > 4 else None
     os.environ.pop("XLA_FLAGS", None)  # the pod size comes from init_multihost
     from dynamo_tpu.parallel.multihost import init_multihost
 
@@ -29,16 +35,24 @@ def main() -> None:
     )
     from dynamo_tpu.parallel.sharding import make_mesh
 
-    cfg = ModelConfig(
-        name="dryrun", vocab_size=512, hidden_size=64, intermediate_size=128,
-        num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16,
-        dtype="float32", tie_embeddings=True,
-    )
+    params = None
+    if ckpt is not None:
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine.loader import load_hf_llama
+
+        cfg, params = load_hf_llama(ckpt, dtype=jnp.float32, tp=4)
+    else:
+        cfg = ModelConfig(
+            name="dryrun", vocab_size=512, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=8, num_kv_heads=8,
+            head_dim=16, dtype="float32", tie_embeddings=True,
+        )
     eng = EngineConfig(
         num_kv_blocks=32, block_size=8, max_num_seqs=8, max_model_len=128,
         prefill_buckets=(32, 64, 128), decode_buckets=(4, 8),
     )
-    core = EngineCore(cfg, eng, seed=0, mesh=make_mesh(dp=2, tp=4))
+    core = EngineCore(cfg, eng, params=params, seed=0, mesh=make_mesh(dp=2, tp=4))
     seqs = [
         core.add_request(
             PreprocessedRequest(
