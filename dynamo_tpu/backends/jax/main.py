@@ -41,6 +41,7 @@ from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPu
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
 from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.tasks import spawn_logged
 from dynamo_tpu.runtime.worker import dynamo_worker
 
 log = logging.getLogger("dynamo_tpu.backends.jax")
@@ -211,14 +212,17 @@ def _eos_for(tokenizer: str) -> tuple[int, ...]:
         from dynamo_tpu.llm.tokenizer import ByteTokenizer
 
         return (ByteTokenizer.EOS,)
-    try:
-        from dynamo_tpu.llm.tokenizer import load_tokenizer
+    # No blanket except here: load_tokenizer already degrades gracefully
+    # (byte-level fallback) when tokenizer files are genuinely absent, so
+    # anything it raises is a real failure (mistyped path, corrupt
+    # tokenizer.json, transient I/O). Swallowing it would silently serve
+    # without EOS for the worker's lifetime — requests would stop only on
+    # max_tokens while the preprocessor happily loads the same tokenizer.
+    # Fail worker startup fast instead (ADVICE r5).
+    from dynamo_tpu.llm.tokenizer import load_tokenizer
 
-        eos = load_tokenizer(tokenizer).eos_token_id
-        return (eos,) if eos is not None else ()
-    except Exception:  # noqa: BLE001 — serving without eos still works
-        log.warning("could not resolve eos for tokenizer %r", tokenizer)
-        return ()
+    eos = load_tokenizer(tokenizer).eos_token_id
+    return (eos,) if eos is not None else ()
 
 
 def _model_card(model_name: str, tokenizer: str, core) -> ModelDeploymentCard:
@@ -585,7 +589,10 @@ async def run_jax_worker(
                         lease=lease,
                     )
                 except Exception:  # noqa: BLE001 — store down; caller times out
-                    pass
+                    log.warning(
+                        "could not publish prefill-failure reply for %r",
+                        task.get("reply_key"), exc_info=True,
+                    )
             finally:
                 sem.release()
 
@@ -597,6 +604,8 @@ async def run_jax_worker(
                 except asyncio.CancelledError:
                     raise
                 except Exception:  # noqa: BLE001 — store closed on shutdown
+                    log.debug("prefill queue pop failed; consumer exiting",
+                              exc_info=True)
                     sem.release()
                     return
                 if payload is None:
@@ -626,7 +635,10 @@ async def run_jax_worker(
 
     if role == "decode":
         disagg = DisaggRouter(disagg_config)
-        asyncio.create_task(disagg.watch_store(runtime.store, namespace))
+        spawn_logged(
+            disagg.watch_store(runtime.store, namespace),
+            name="disagg-watch-store", logger=log,
+        )
         prefill_client = await (
             runtime.namespace(namespace).component("prefill").endpoint("generate").client()
         )
@@ -661,6 +673,8 @@ async def run_jax_worker(
                 try:
                     depth = await runtime.store.queue_len(qname)
                 except Exception:  # noqa: BLE001 — store hiccup: stay local
+                    log.debug("queue_len failed; treating prefill queue as "
+                              "full (local prefill)", exc_info=True)
                     depth = disagg.config.max_prefill_queue_size + 1
             if (
                 prefill_client.instance_ids()

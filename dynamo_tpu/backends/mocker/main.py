@@ -22,6 +22,7 @@ from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPu
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
 from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.tasks import spawn_logged
 from dynamo_tpu.runtime.worker import dynamo_worker
 
 log = logging.getLogger("dynamo_tpu.backends.mocker")
@@ -43,10 +44,10 @@ async def run_mocker(
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
 
     def on_stored(hashes: list[int], parent: int | None) -> None:
-        asyncio.get_running_loop().create_task(kv_pub.stored(hashes, parent))
+        spawn_logged(kv_pub.stored(hashes, parent), name="kv-stored", logger=log)
 
     def on_removed(hashes: list[int]) -> None:
-        asyncio.get_running_loop().create_task(kv_pub.removed(hashes))
+        spawn_logged(kv_pub.removed(hashes), name="kv-removed", logger=log)
 
     engine.kv.on_stored = on_stored
     engine.kv.on_removed = on_removed

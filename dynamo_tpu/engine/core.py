@@ -452,6 +452,21 @@ class EngineCore:
                         f"count {self._pp_micro}"
                     )
             if params is not None:
+                # Mirror build_engine's CLI guard: int8 params are
+                # {'w','scale'} dict leaves, which pp_param_specs knows
+                # nothing about — shard_params_pp would die with an opaque
+                # pytree-structure mismatch deep in jax.tree.map.
+                quant_leaves = jax.tree.leaves(
+                    params,
+                    is_leaf=lambda x: isinstance(x, dict)
+                    and set(x) == {"w", "scale"},
+                )
+                if any(isinstance(l, dict) for l in quant_leaves):
+                    raise ValueError(
+                        "int8 under pipeline parallelism: not wired yet "
+                        "(quantized {'w','scale'} leaves cannot be sharded "
+                        "by pp_param_specs)"
+                    )
                 _check_fuse_tp(params, 1)  # pp stages keep tp=1 layouts
                 params = shard_params_pp(params, model_cfg, pp_mesh)
             else:
@@ -1394,6 +1409,7 @@ class EngineCore:
     def _check_stop(self, seq: Sequence, token: int) -> str | None:
         return seq.stop.check_token(token, seq.generated, self.eos_token_ids)
 
+    # dynalint: holds-lock(_step_lock) — only called from the step path
     def _finish(self, seq: Sequence) -> None:
         if seq in self.running:
             self.running.remove(seq)
@@ -1406,6 +1422,7 @@ class EngineCore:
         else:
             self._release_blocks(seq)
 
+    # dynalint: holds-lock(_step_lock) — called at the top of _step_locked
     def _sweep_expired_holds(self) -> None:
         """Release held prefills whose decode side never came (timeout,
         crash): without this, abandoned holds pin device blocks until the
@@ -1564,6 +1581,7 @@ class EngineCore:
         with self._step_lock:
             return self.allocator.match_prefix(hashes) * self.engine.block_size
 
+    # dynalint: holds-lock(_step_lock) — transfer endpoints lock first
     def _touch_hold(self, request_id: str) -> None:
         """Refresh a hold's expiry — an in-flight transfer must not lose
         its blocks between chunks."""

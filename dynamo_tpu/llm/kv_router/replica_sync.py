@@ -28,6 +28,8 @@ from typing import TYPE_CHECKING
 
 import msgpack
 
+from dynamo_tpu.runtime.tasks import spawn_logged
+
 if TYPE_CHECKING:  # pragma: no cover
     from dynamo_tpu.llm.kv_router.router import KvRouter
 
@@ -58,9 +60,14 @@ class ReplicaSync:
         delta_sub = await self.store.subscribe(self._delta_subject)
         boot_sub = await self.store.subscribe(self._boot_subject)
         self._subs = [delta_sub, boot_sub]
+        # spawn_logged: if a loop dies on an unexpected message shape the
+        # failure is logged immediately, not discovered via a frozen
+        # replica view (the handles still go in _tasks for stop()).
         self._tasks = [
-            asyncio.create_task(self._delta_loop(delta_sub)),
-            asyncio.create_task(self._bootstrap_serve_loop(boot_sub)),
+            spawn_logged(self._delta_loop(delta_sub),
+                         name="replica-sync-delta-loop", logger=log),
+            spawn_logged(self._bootstrap_serve_loop(boot_sub),
+                         name="replica-sync-bootstrap-loop", logger=log),
         ]
         await self._bootstrap(bootstrap_timeout)
 
@@ -71,7 +78,7 @@ class ReplicaSync:
             try:
                 await s.unsubscribe()
             except Exception:  # noqa: BLE001 — store may already be gone
-                pass
+                log.debug("unsubscribe failed during stop", exc_info=True)
 
     # -- delta publication (called by KvRouter on every decision) ----------
 
@@ -104,7 +111,7 @@ class ReplicaSync:
             except Exception:  # noqa: BLE001 — sync is best-effort
                 log.warning("replica-sync publish failed", exc_info=True)
 
-        asyncio.ensure_future(_send())
+        spawn_logged(_send(), name="replica-sync-publish", logger=log)
 
     # -- delta application -------------------------------------------------
 
@@ -112,11 +119,19 @@ class ReplicaSync:
         async for msg in sub:
             try:
                 d = msgpack.unpackb(msg["p"], raw=False)
-            except Exception:  # noqa: BLE001
+            except (TypeError, ValueError, msgpack.UnpackException):
+                log.warning("dropping malformed replica-sync delta")
+                continue
+            if not isinstance(d, dict):
+                log.warning("dropping non-dict replica-sync delta %r", d)
                 continue
             if d.get("origin") == self.router_id:
                 continue
-            self._apply(d)
+            try:
+                self._apply(d)
+            except Exception:  # noqa: BLE001 — one bad delta must not kill sync
+                log.warning("dropping unapplicable replica-sync delta %r",
+                            d, exc_info=True)
 
     def _apply(self, d: dict) -> None:
         active = self.router.active
@@ -153,7 +168,11 @@ class ReplicaSync:
         async for msg in sub:
             try:
                 req = msgpack.unpackb(msg["p"], raw=False)
-            except Exception:  # noqa: BLE001
+            except (TypeError, ValueError, msgpack.UnpackException):
+                log.warning("dropping malformed bootstrap request")
+                continue
+            if not isinstance(req, dict):
+                log.warning("dropping non-dict bootstrap request %r", req)
                 continue
             if req.get("origin") == self.router_id:
                 continue

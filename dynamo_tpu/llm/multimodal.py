@@ -69,7 +69,7 @@ def image_bytes(ref: str) -> bytes:
         try:
             _, payload = ref.split(",", 1)
             return base64.b64decode(payload + "=" * (-len(payload) % 4))
-        except Exception:  # noqa: BLE001 — malformed data URL
+        except ValueError:  # malformed data URL (binascii.Error included)
             pass
     return image_ref_fingerprint(ref)
 

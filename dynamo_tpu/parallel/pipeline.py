@@ -187,7 +187,12 @@ def plan_microbatches(
         # kv through this chunk = total kv minus this seq's rows in LATER
         # chunks (rows are the seq's trailing tokens, kernel contract).
         after = np.maximum(0, cu[1:] - hi_c)
-        plan.kv_lens[m] = np.maximum(1, kv - after).astype(np.int32)
+        # A sequence with no query rows in this chunk would otherwise get
+        # a meaningless kv_len (e.g. prior_kv - offset for one that starts
+        # in a later chunk). The ragged kernel skips zero-length queries,
+        # but pin the value to the benign 1 so it can never be consumed.
+        kv_through = np.where(q_in_chunk > 0, np.maximum(1, kv - after), 1)
+        plan.kv_lens[m] = kv_through.astype(np.int32)
         plan.cu_q_lens[m, 1:] = np.cumsum(q_in_chunk).astype(np.int32)
         in_chunk = (last_rows >= lo_c) & (last_rows < hi_c)
         in_chunk[num_seqs:] = False

@@ -20,6 +20,7 @@ import asyncio
 import json
 import logging
 import sys
+from pathlib import Path
 
 import aiohttp
 
@@ -187,9 +188,12 @@ async def _amain(args) -> None:
                     print(await _chat_once(url, args.model_name, line, args.max_tokens))
         elif args.in_mode == "batch":
             _, url = await _frontend_url(front_rt, args)
-            with open(args.input) as fh:
-                prompts = [json.loads(ln) for ln in fh if ln.strip()]
-            out_fh = open(args.output, "w") if args.output else sys.stdout
+            raw = await asyncio.to_thread(Path(args.input).read_text)
+            prompts = [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
+            out_fh = (
+                await asyncio.to_thread(open, args.output, "w")
+                if args.output else sys.stdout
+            )
             for item in prompts:
                 text = item["prompt"] if isinstance(item, dict) else str(item)
                 reply = await _chat_once(url, args.model_name, text, args.max_tokens)
@@ -203,8 +207,8 @@ async def _amain(args) -> None:
             rt.signal_shutdown()
             try:
                 await rt.shutdown()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                log.debug("runtime shutdown raced", exc_info=True)
         if store is not None:
             await store.stop()
 

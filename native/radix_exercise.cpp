@@ -1,0 +1,185 @@
+// Sanitizer exercise for the native radix index (radix_tree.cpp).
+//
+// Built by `make test-native` with -fsanitize=address,undefined and run
+// directly; every code path of the C ABI is driven with deterministic
+// pseudo-random traffic plus the edge cases ctypes callers can produce
+// (zero-length batches, cap smaller than the result set, replayed event
+// ids, removes of unknown hashes, double worker removal). Asserts check
+// the same invariants tests/test_native_radix.py checks from Python, so
+// a sanitizer hit here means a real heap/UB bug, not a harness artifact.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+void* radix_new();
+void radix_free(void* t);
+void radix_apply_stored(void* tp, int64_t worker, int64_t event_id,
+                        const uint64_t* hashes, int32_t n, uint64_t parent,
+                        int32_t has_parent);
+void radix_apply_removed(void* tp, int64_t worker, int64_t event_id,
+                         const uint64_t* hashes, int32_t n);
+void radix_remove_worker(void* tp, int64_t worker);
+int32_t radix_find_matches(void* tp, const uint64_t* hashes, int32_t n,
+                           int64_t* out_workers, int32_t* out_depths,
+                           int32_t cap);
+int32_t radix_num_blocks(void* tp, int64_t worker);
+int32_t radix_dump_worker(void* tp, int64_t worker, uint64_t* out_hashes,
+                          uint64_t* out_parents, int32_t* out_has_parent,
+                          int32_t cap);
+}
+
+namespace {
+
+// Deterministic 64-bit LCG (no <random> so the run reproduces everywhere).
+uint64_t rng_state = 0x9e3779b97f4a7c15ULL;
+uint64_t next_u64() {
+    rng_state = rng_state * 6364136223846793005ULL + 1442695040888963407ULL;
+    return rng_state;
+}
+
+// Chained block hashes: hash[i] depends on hash[i-1], like dynamo_tpu/tokens.
+std::vector<uint64_t> chain(uint64_t seed, int n) {
+    std::vector<uint64_t> out;
+    uint64_t h = seed;
+    for (int i = 0; i < n; ++i) {
+        h = h * 0x100000001b3ULL ^ (seed + i);
+        out.push_back(h);
+    }
+    return out;
+}
+
+int find_depth(void* t, const std::vector<uint64_t>& hashes, int64_t worker) {
+    std::vector<int64_t> workers(4096);
+    std::vector<int32_t> depths(4096);
+    int32_t n = radix_find_matches(t, hashes.data(),
+                                   static_cast<int32_t>(hashes.size()),
+                                   workers.data(), depths.data(), 4096);
+    for (int32_t i = 0; i < n; ++i)
+        if (workers[i] == worker) return depths[i];
+    return 0;
+}
+
+void basic_lifecycle() {
+    void* t = radix_new();
+    auto c = chain(1, 8);
+
+    radix_apply_stored(t, /*worker=*/7, /*event=*/1, c.data(), 8, 0, 0);
+    assert(radix_num_blocks(t, 7) == 8);
+    assert(radix_num_blocks(t, -1) == 8);
+    assert(find_depth(t, c, 7) == 8);
+
+    // Replayed event id must deduplicate (no double insert, no UB).
+    radix_apply_stored(t, 7, 1, c.data(), 8, 0, 0);
+    assert(radix_num_blocks(t, 7) == 8);
+
+    // Second worker shares a prefix then diverges.
+    auto c2 = chain(1, 4);
+    auto tail = chain(2, 4);
+    radix_apply_stored(t, 8, 1, c2.data(), 4, 0, 0);
+    radix_apply_stored(t, 8, 2, tail.data(), 4, c2.back(), 1);
+    assert(find_depth(t, c, 8) == 4);
+
+    // Removing a mid-chain block prunes worker 7's orphaned suffix only.
+    radix_apply_removed(t, 7, 2, &c[4], 1);
+    assert(find_depth(t, c, 7) == 4);
+
+    // Remove of an unknown hash is a no-op, not a crash.
+    uint64_t bogus = 0xdeadbeefULL;
+    radix_apply_removed(t, 7, 3, &bogus, 1);
+
+    // Zero-length batches round-trip.
+    radix_apply_stored(t, 9, 1, c.data(), 0, 0, 0);
+    radix_apply_removed(t, 9, 2, c.data(), 0);
+    assert(radix_find_matches(t, c.data(), 0, nullptr, nullptr, 0) == 0);
+
+    // cap smaller than the result set truncates without writing past it.
+    int64_t one_worker[1];
+    int32_t one_depth[1];
+    int32_t n = radix_find_matches(t, c.data(), 4, one_worker, one_depth, 1);
+    assert(n == 1);
+
+    // Dump honors cap and reports parents consistently.
+    std::vector<uint64_t> hs(16), ps(16);
+    std::vector<int32_t> hp(16);
+    n = radix_dump_worker(t, 8, hs.data(), ps.data(), hp.data(), 16);
+    assert(n == 8);
+    n = radix_dump_worker(t, 8, hs.data(), ps.data(), hp.data(), 3);
+    assert(n == 3);
+
+    radix_remove_worker(t, 7);
+    assert(radix_num_blocks(t, 7) == 0);
+    radix_remove_worker(t, 7);  // double removal is a no-op
+    radix_remove_worker(t, 8);
+    assert(radix_num_blocks(t, -1) == 0);
+    radix_free(t);
+}
+
+void randomized_churn() {
+    void* t = radix_new();
+    const int WORKERS = 17;
+    const int ROUNDS = 400;
+    std::vector<int64_t> event_ids(WORKERS, 0);
+    std::vector<std::vector<uint64_t>> chains;
+    for (int w = 0; w < WORKERS; ++w)
+        chains.push_back(chain(100 + w % 5, 1 + static_cast<int>(next_u64() % 32)));
+
+    for (int r = 0; r < ROUNDS; ++r) {
+        int w = static_cast<int>(next_u64() % WORKERS);
+        const auto& c = chains[w];
+        switch (next_u64() % 4) {
+            case 0: {
+                int n = 1 + static_cast<int>(next_u64() % c.size());
+                radix_apply_stored(t, w, ++event_ids[w], c.data(), n, 0, 0);
+                break;
+            }
+            case 1: {
+                int off = static_cast<int>(next_u64() % c.size());
+                int n = 1 + static_cast<int>(next_u64() % (c.size() - off));
+                radix_apply_removed(t, w, ++event_ids[w], c.data() + off, n);
+                break;
+            }
+            case 2:
+                radix_remove_worker(t, w);
+                event_ids[w] = 0;
+                break;
+            default: {
+                int d = find_depth(t, c, w);
+                assert(d >= 0 && d <= static_cast<int>(c.size()));
+                // Depth is a contiguous prefix: every shallower block is
+                // held in one snapshot of the worker's dump.
+                std::vector<uint64_t> hs(4096), ps(4096);
+                std::vector<int32_t> hp(4096);
+                int32_t n = radix_dump_worker(t, w, hs.data(), ps.data(),
+                                              hp.data(), 4096);
+                for (int i = 0; i < d; ++i) {
+                    int held = 0;
+                    for (int32_t j = 0; j < n; ++j)
+                        if (hs[j] == c[i]) held = 1;
+                    assert(held);
+                }
+                break;
+            }
+        }
+        int total = radix_num_blocks(t, -1);
+        int per_worker_max = 0;
+        for (int w2 = 0; w2 < WORKERS; ++w2) {
+            int nb = radix_num_blocks(t, w2);
+            assert(nb >= 0);
+            if (nb > per_worker_max) per_worker_max = nb;
+        }
+        assert(per_worker_max <= total);
+    }
+    radix_free(t);
+}
+
+}  // namespace
+
+int main() {
+    basic_lifecycle();
+    randomized_churn();
+    std::puts("radix_exercise: OK");
+    return 0;
+}

@@ -1,0 +1,14 @@
+"""Seeded violations: synchronous work on the event loop."""
+
+import subprocess
+import time
+
+import requests  # noqa: F401 — fixture is parsed, never imported
+
+
+async def fetch(url: str) -> None:
+    time.sleep(1)                          # finding
+    subprocess.run(["ls"])                 # finding
+    requests.get(url)                      # finding
+    fh = open("/tmp/f")                    # finding
+    fh.close()

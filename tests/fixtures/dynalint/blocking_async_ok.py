@@ -1,0 +1,25 @@
+"""Negative cases: async-safe equivalents and sync-context calls."""
+
+import asyncio
+import subprocess
+import time
+
+
+async def fetch(url: str) -> None:
+    await asyncio.sleep(1)
+    await asyncio.to_thread(subprocess.run, ["ls"], check=True)
+    fh = await asyncio.to_thread(open, "/tmp/f")
+    fh.close()
+
+
+def sync_helper() -> None:
+    time.sleep(1)       # fine: not on the event loop
+    open("/tmp/f").close()
+
+
+async def outer() -> None:
+    def callback() -> None:
+        # fine: nested sync def — typically handed to to_thread/executor
+        subprocess.run(["ls"], check=True)
+
+    await asyncio.to_thread(callback)

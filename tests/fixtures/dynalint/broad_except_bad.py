@@ -1,0 +1,31 @@
+"""Seeded violations: broad handlers that swallow everything silently."""
+
+
+def swallow() -> None:
+    try:
+        raise RuntimeError("boom")
+    except Exception:                    # finding: silent
+        pass
+
+
+def bare() -> int:
+    try:
+        return 1
+    except:                              # finding: bare and silent  # noqa: E722
+        return 0
+
+
+def tupled() -> None:
+    try:
+        raise RuntimeError("boom")
+    except (ValueError, Exception):      # finding: Exception in tuple
+        return None
+
+
+def fake_logging(n: float) -> float:
+    import math
+
+    try:
+        raise RuntimeError("boom")
+    except Exception:                    # finding: math.log is not logging
+        return math.log(n)

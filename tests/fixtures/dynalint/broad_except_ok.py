@@ -1,0 +1,41 @@
+"""Negative cases: broad handlers that log, re-raise, surface, or opt out."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def logs() -> None:
+    try:
+        raise RuntimeError("boom")
+    except Exception:
+        log.warning("operation failed", exc_info=True)
+
+
+def reraises() -> None:
+    try:
+        raise RuntimeError("boom")
+    except Exception:
+        raise
+
+
+def surfaces() -> str:
+    try:
+        raise RuntimeError("boom")
+    except Exception as e:
+        return f"error: {e}"             # bound exception is reported
+
+
+def pragma_opt_out() -> None:
+    try:
+        raise RuntimeError("boom")
+    # dynalint: allow-broad-except(fixture demonstrating the pragma format)
+    except Exception:
+        pass
+
+
+def narrow() -> None:
+    try:
+        raise ValueError("boom")
+    except ValueError:                   # narrow excepts are never flagged
+        pass

@@ -1,0 +1,16 @@
+"""Seeded violations: every spawn here drops the Task on the floor."""
+
+import asyncio
+from asyncio import create_task
+
+
+async def work() -> None:
+    pass
+
+
+async def main() -> None:
+    asyncio.create_task(work())          # finding: bare statement
+    asyncio.ensure_future(work())        # finding: bare statement
+    loop = asyncio.get_event_loop()
+    loop.create_task(work())             # finding: loop receiver
+    create_task(work())                  # finding: bare imported name

@@ -1,0 +1,18 @@
+"""Negative cases: every spawned Task is kept, awaited, or supervised."""
+
+import asyncio
+
+
+async def work() -> None:
+    pass
+
+
+async def main() -> None:
+    t = asyncio.create_task(work())               # stored
+    await t
+    tasks = [asyncio.create_task(work())]         # stored in a list
+    supervised = asyncio.create_task(work())
+    supervised.add_done_callback(print)           # done-callback attached
+    await asyncio.gather(*tasks, supervised)
+    async with asyncio.TaskGroup() as tg:         # TaskGroup holds the ref
+        tg.create_task(work())

@@ -1,0 +1,39 @@
+"""Seeded violations: jax/jnp in hazardous contexts, impure traced fns."""
+
+import signal
+
+import jax
+import jax.numpy as jnp
+
+
+class Holder:
+    def __del__(self):
+        jnp.zeros(1)                     # finding: device work at gc time
+
+    @jax.jit
+    def step(self, x):                   # finding: jit over a bound method
+        return x + self.offset
+
+
+def _on_term(signum, frame):
+    jax.device_get(jnp.zeros(1))         # finding: jax in a signal handler
+
+
+signal.signal(signal.SIGTERM, _on_term)
+
+
+def traced(x):
+    print("tracing", x)                  # finding: trace-time print
+    return x * 2
+
+
+fast = jax.jit(traced)
+
+
+class Model:
+    def build(self):
+        def impure(x):
+            self.cache = x               # finding: self-mutation under trace
+            return x
+
+        return jax.jit(impure)

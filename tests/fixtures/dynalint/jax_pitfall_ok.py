@@ -1,0 +1,22 @@
+"""Negative cases: pure jitted functions, jax-free finalizers."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Holder:
+    def __del__(self):
+        self._handle = None              # fine: no device work
+
+
+def pure(x):
+    jax.debug.print("x={x}", x=x)        # fine: the traced-safe print
+    return jnp.sum(x)
+
+
+fast = jax.jit(pure)
+
+
+class Model:
+    def build(self):
+        return jax.jit(lambda p, x: p @ x)   # pure lambda

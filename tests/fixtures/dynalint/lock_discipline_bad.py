@@ -1,0 +1,39 @@
+"""Seeded violations: GUARDED_BY attributes mutated without the lock.
+
+tests/test_dynalint.py registers this file in the GUARDED_BY registry:
+Guarded._table and Guarded.count guarded by _lock; module global _handle
+guarded by _glock.
+"""
+
+import threading
+
+_glock = threading.Lock()
+_handle = None
+
+
+def load():
+    global _handle
+    _handle = object()                   # finding: _glock not held
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+        self.count = 0                   # fine: __init__ is exempt
+
+    def bad_set(self, k, v):
+        self._table[k] = v               # finding
+
+    def bad_incr(self):
+        self.count += 1                  # finding
+
+    def bad_clear(self):
+        self._table.clear()              # finding: mutator method
+
+    def bad_del(self, k):
+        del self._table[k]               # finding
+
+    def bad_global_from_method(self):
+        global _handle
+        _handle = object()               # finding: _glock not held

@@ -1,0 +1,38 @@
+"""Negative cases: mutations under the lock, holds-lock pragma, reads.
+
+Registered with the same GUARDED_BY entries as lock_discipline_bad.py.
+"""
+
+import threading
+
+_glock = threading.Lock()
+_handle = None
+
+
+def load():
+    global _handle
+    with _glock:
+        _handle = object()               # fine: module lock held
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+        self.count = 0
+
+    def good_set(self, k, v):
+        with self._lock:
+            self._table[k] = v           # fine: lock held lexically
+
+    # dynalint: holds-lock(_lock)
+    def good_annotated(self):
+        self.count += 1                  # fine: caller holds the lock
+
+    def reads_are_free(self):
+        return len(self._table) + self.count
+
+
+def shadowing_local_is_not_the_global():
+    _handle = object()                   # fine: local, no `global` decl
+    return _handle

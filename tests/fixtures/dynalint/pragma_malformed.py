@@ -1,0 +1,17 @@
+"""Seeded violations: pragmas the linter must reject, not silently obey."""
+
+
+def empty_reason() -> None:
+    try:
+        raise RuntimeError("boom")
+    # dynalint: allow-broad-except()
+    except Exception:
+        pass
+
+
+def unknown_rule() -> None:
+    pass  # dynalint: allow-frobnicate(not a rule)
+
+
+def unparseable() -> None:
+    pass  # dynalint: do something

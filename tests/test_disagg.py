@@ -106,6 +106,7 @@ class DisaggCluster:
         for rt in self.runtimes:
             try:
                 await rt.shutdown()
+            # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
             except Exception:
                 pass
         await self.store.stop()

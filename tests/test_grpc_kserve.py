@@ -93,6 +93,7 @@ async def test_kserve_grpc_end_to_end():
         for rt in (front_rt, worker_rt):
             try:
                 await rt.shutdown()
+            # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
             except Exception:
                 pass
         await store.stop()

@@ -99,7 +99,8 @@ async def test_responses_endpoint_matches_chat():
 async def test_tls_serves_https(tmp_path):
     cert = tmp_path / "cert.pem"
     key = tmp_path / "key.pem"
-    subprocess.run(
+    await asyncio.to_thread(
+        subprocess.run,
         [
             "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
             "-keyout", str(key), "-out", str(cert), "-days", "1",
@@ -144,6 +145,7 @@ async def test_tls_serves_https(tmp_path):
         task.cancel()
         try:
             await rt.shutdown()
+        # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
         except Exception:
             pass
         await store.stop()

@@ -1,0 +1,28 @@
+"""dynalint — project-native static analysis for dynamo-tpu.
+
+AST-based (stdlib ``ast`` + ``tokenize`` only, no third-party deps) lints
+tuned to the failure modes of a long-running async serving stack:
+
+- ``fire-and-forget-task``: ``asyncio.create_task`` whose Task is dropped
+  on the floor (exceptions vanish; the loop logs them only at gc time).
+- ``blocking-in-async``: synchronous sleeps / file / socket / subprocess
+  calls on the event loop.
+- ``broad-except``: ``except Exception`` / bare ``except`` that neither
+  logs, re-raises, nor carries an allow pragma with a reason.
+- ``lock-discipline``: attributes registered in ``config.GUARDED_BY``
+  mutated outside a ``with <lock>`` scope.
+- ``jax-pitfall``: jax/jnp work in ``__del__``/signal handlers, ``jit``
+  over bound-state closures, prints/self-mutation under trace.
+
+Run as ``python -m tools.dynalint dynamo_tpu/ tests/`` or through
+``tests/test_dynalint.py`` (tier-1).
+
+Suppression pragmas (reason required, enforced):
+
+    # dynalint: allow-<rule>(<reason>)      on the finding line or the line above
+    # dynalint: holds-lock(<lockname>)      on a def line: caller holds the lock
+"""
+
+from tools.dynalint.linter import Finding, Pragma, lint_file, lint_paths
+
+__all__ = ["Finding", "Pragma", "lint_file", "lint_paths"]

@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.dynalint dynamo_tpu/ tests/``.
+
+Exit 0 when the tree is clean, 1 when there are findings, 2 on usage
+errors. ``--rules`` narrows to a comma-separated subset; ``--pragmas``
+prints the in-source suppression inventory (what tests/test_dynalint.py
+pins in its grandfather table).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.dynalint import config as C
+from tools.dynalint.linter import lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynalint",
+        description="dynamo-tpu project-native static analysis",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument(
+        "--rules", default=None,
+        help=f"comma-separated subset of: {', '.join(C.ALL_RULES)}",
+    )
+    ap.add_argument(
+        "--pragmas", action="store_true",
+        help="also list every dynalint suppression pragma in the tree",
+    )
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(C.ALL_RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    paths = [Path(p) for p in args.paths]
+    for p in paths:
+        if not p.exists():
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    repo_root = Path(__file__).resolve().parents[2]
+    result = lint_paths(paths, repo_root)
+    findings = result.findings
+    if rules is not None:
+        # Pragma/syntax errors always surface: they mean the tree lies.
+        findings = [
+            f for f in findings
+            if f.rule in rules or f.rule in ("malformed-pragma", "syntax-error")
+        ]
+
+    for f in findings:
+        print(f)
+    if args.pragmas:
+        for p in sorted(result.pragmas, key=lambda p: (p.path, p.line)):
+            print(f"pragma: {p}")
+    n = len(findings)
+    print(f"dynalint: {n} finding{'s' if n != 1 else ''}, "
+          f"{len(result.pragmas)} pragma{'s' if len(result.pragmas) != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

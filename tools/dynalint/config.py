@@ -1,0 +1,125 @@
+"""dynalint configuration: rule tables and the GUARDED_BY registry.
+
+Everything here is data, not code — the linter (``linter.py``) is generic
+and this file pins it to the dynamo-tpu codebase.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Rule ids (used in pragmas: `# dynalint: allow-<rule>(<reason>)`)
+# ---------------------------------------------------------------------------
+
+RULE_FIRE_AND_FORGET = "fire-and-forget-task"
+RULE_BLOCKING_IN_ASYNC = "blocking-in-async"
+RULE_BROAD_EXCEPT = "broad-except"
+RULE_LOCK_DISCIPLINE = "lock-discipline"
+RULE_JAX_PITFALL = "jax-pitfall"
+
+ALL_RULES = (
+    RULE_FIRE_AND_FORGET,
+    RULE_BLOCKING_IN_ASYNC,
+    RULE_BROAD_EXCEPT,
+    RULE_LOCK_DISCIPLINE,
+    RULE_JAX_PITFALL,
+)
+
+# ---------------------------------------------------------------------------
+# blocking-in-async: dotted call names that block the event loop.
+# Key is the full dotted name as written at the call site (after resolving
+# the attribute chain textually — no import tracking; these modules are
+# conventionally imported under their own names in this repo).
+# ---------------------------------------------------------------------------
+
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop; use await asyncio.sleep()",
+    "subprocess.run": "subprocess.run() blocks; use asyncio.create_subprocess_exec or asyncio.to_thread",
+    "subprocess.call": "subprocess.call() blocks; use asyncio.create_subprocess_exec or asyncio.to_thread",
+    "subprocess.check_call": "subprocess.check_call() blocks; use asyncio.to_thread",
+    "subprocess.check_output": "subprocess.check_output() blocks; use asyncio.to_thread",
+    "os.system": "os.system() blocks; use asyncio.create_subprocess_shell",
+    "socket.create_connection": "sync socket connect blocks; use asyncio.open_connection",
+    "socket.getaddrinfo": "sync DNS resolution blocks; use loop.getaddrinfo",
+    "urllib.request.urlopen": "sync HTTP blocks; use an async client or asyncio.to_thread",
+}
+
+# Any call rooted at `requests.` (requests.get/post/Session()...) blocks.
+BLOCKING_ROOTS = {
+    "requests": "requests.* is synchronous HTTP; use asyncio.to_thread or an async client",
+}
+
+# ---------------------------------------------------------------------------
+# lock-discipline: the GUARDED_BY registry.
+#
+# Maps repo-relative file -> {(scope, attr): lock}.
+#   scope  — class name owning the attribute, or None for module globals.
+#   lock   — name of the lock attribute (`self.<lock>` for class scopes,
+#            bare `<lock>` for module scope) that must be held (lexically
+#            inside `with`/`async with`, or declared via a
+#            `# dynalint: holds-lock(<lock>)` pragma on the enclosing def)
+#            when the attribute is MUTATED. Reads are not checked.
+#            The sentinel EXTERNAL documents attributes synchronized by a
+#            lock the owning object cannot see (checked by convention and
+#            review, not by this linter).
+#
+# `__init__` (and module top level for module globals' initial binding) is
+# exempt: nothing else can hold a reference during construction.
+# ---------------------------------------------------------------------------
+
+EXTERNAL = "<external>"
+
+GUARDED_BY = {
+    "dynamo_tpu/engine/core.py": {
+        # add_request() is documented as callable from any thread.
+        ("EngineCore", "_req_counter"): "_lock",
+        # Held-block bookkeeping is touched by the disagg transfer
+        # endpoints (server thread) and by step() (engine thread).
+        ("EngineCore", "_held"): "_step_lock",
+        ("EngineCore", "_held_deadline"): "_step_lock",
+        ("EngineCore", "transfer_stats"): "_step_lock",
+    },
+    "dynamo_tpu/engine/block_allocator.py": {
+        # DeviceBlockAllocator is externally synchronized: every caller
+        # reaches it through EngineCore under _step_lock (engine/core.py).
+        ("DeviceBlockAllocator", "_free"): EXTERNAL,
+        ("DeviceBlockAllocator", "_by_hash"): EXTERNAL,
+        ("DeviceBlockAllocator", "_inactive"): EXTERNAL,
+        ("DeviceBlockAllocator", "_partials"): EXTERNAL,
+    },
+    "dynamo_tpu/llm/kv_router/native_radix.py": {
+        # One-shot lazy .so build+load, raced by every router thread.
+        (None, "_lib"): "_lock",
+        (None, "_load_failed"): "_lock",
+    },
+}
+
+# Mutating method names: `x.<name>(...)` counts as a mutation of `x`.
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "appendleft", "rotate", "sort", "reverse",
+}
+
+# ---------------------------------------------------------------------------
+# jax-pitfall: module roots whose use is flagged in __del__/signal handlers.
+# ---------------------------------------------------------------------------
+
+JAX_ROOTS = {"jax", "jnp"}
+
+# Call names that register a signal handler (first arg: signum, second: fn).
+SIGNAL_REGISTRARS = {"signal.signal", "loop.add_signal_handler"}
+
+# Call/decorator names that enter a traced context.
+JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "shard_map", "jax.shard_map"}
+
+# ---------------------------------------------------------------------------
+# File selection.
+# ---------------------------------------------------------------------------
+
+# Directories skipped entirely (relative path fragments).
+EXCLUDE_PARTS = {
+    "__pycache__",
+    ".git",
+    # Lint fixtures intentionally contain violations.
+    "tests/fixtures/dynalint",
+}

@@ -1,0 +1,647 @@
+"""dynalint core: AST visitors for the five detector classes.
+
+Stdlib only (``ast`` + ``tokenize``). One pass per file; rule config and
+the GUARDED_BY registry live in :mod:`tools.dynalint.config`.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.dynalint import config as C
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Pragma:
+    path: str
+    line: int
+    kind: str          # "allow" | "holds-lock"
+    arg: str           # rule name for allow, lock name for holds-lock
+    reason: str        # required for allow, empty for holds-lock
+
+    def __str__(self) -> str:
+        detail = f"({self.reason})" if self.reason else ""
+        return f"{self.path}:{self.line}: {self.kind}-{self.arg}{detail}"
+
+
+_ALLOW_RE = re.compile(r"dynalint:\s*allow-([a-z][a-z0-9-]*)\s*\(\s*([^)]*?)\s*\)")
+_HOLDS_RE = re.compile(r"dynalint:\s*holds-lock\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\)")
+# A pragma must START the comment (`# dynalint: ...`); "dynalint:"
+# mid-comment is prose about the tool, not a directive.
+_ANY_PRAGMA_RE = re.compile(r"^#+\s*dynalint:")
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_excluding_defs(body: list[ast.stmt]):
+    """Yield nodes in ``body`` without descending into nested function /
+    class definitions (their code does not run in the enclosing scope)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _jit_decorator(dec: ast.expr) -> bool:
+    """True for ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``."""
+    d = dotted_name(dec)
+    if d in C.JIT_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        f = dotted_name(dec.func)
+        if f in C.JIT_WRAPPERS:
+            return True
+        if f in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in C.JIT_WRAPPERS
+    return False
+
+
+def _uses_jax(body: list[ast.stmt]) -> ast.AST | None:
+    """First node in body rooted at jax/jnp (not descending into defs)."""
+    for node in _walk_excluding_defs(body):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            d = dotted_name(node)
+            if d and d.split(".")[0] in C.JAX_ROOTS:
+                return node
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-file pass
+# ---------------------------------------------------------------------------
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module, pragmas: list[Pragma]):
+        self.path = path
+        self.tree = tree
+        self.findings: list[Finding] = []
+        # Suppression lookup: (line, rule) from allow pragmas.
+        self._allow: dict[int, set[str]] = {}
+        # holds-lock pragma lines -> lock names.
+        self._holds: dict[int, set[str]] = {}
+        for p in pragmas:
+            if p.kind == "allow":
+                self._allow.setdefault(p.line, set()).add(p.arg)
+            else:
+                self._holds.setdefault(p.line, set()).add(p.arg)
+
+        # Context stacks.
+        self._class_stack: list[str] = []
+        self._func_stack: list[ast.AST] = []     # FunctionDef/AsyncFunctionDef/Lambda
+        self._async_stack: list[bool] = []       # effective "on the event loop"
+        self._held_locks: list[str] = []         # dotted lock exprs held lexically
+        self._holds_pragma_stack: list[set[str]] = []
+        self._global_decls: list[set[str]] = []  # per-function `global` names
+
+        # GUARDED_BY registry slice for this file.
+        self._registry: dict[tuple[str | None, str], str] = {}
+        for suffix, entries in C.GUARDED_BY.items():
+            if path.endswith(suffix):
+                self._registry.update(entries)
+
+        # jax-pitfall bookkeeping (filled by _prescan).
+        self._signal_handlers: set[str] = set()
+        self._module_defs: dict[str, ast.AST] = {}
+        self._jit_scanned: set[int] = set()      # id() of defs already scanned
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        for probe in (line, line - 1):
+            if rule in self._allow.get(probe, ()):  # suppressed by pragma
+                return
+        self.findings.append(
+            Finding(self.path, line, getattr(node, "col_offset", 0), rule, message)
+        )
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._prescan()
+        self.visit(self.tree)
+        return self.findings
+
+    def _prescan(self) -> None:
+        """Collect module-level defs and signal-handler registrations."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._module_defs.setdefault(node.name, node)
+            elif isinstance(node, ast.Call):
+                f = dotted_name(node.func)
+                is_registrar = f in C.SIGNAL_REGISTRARS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_signal_handler"
+                )
+                if is_registrar:
+                    for arg in node.args[1:]:
+                        if isinstance(arg, ast.Name):
+                            self._signal_handlers.add(arg.id)
+
+    # -- scope tracking ----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _enter_function(self, node, is_async: bool) -> None:
+        holds = set()
+        for probe in (node.lineno, node.lineno - 1):
+            holds |= self._holds.get(probe, set())
+        # Decorator lines shift lineno; also probe the first decorator line.
+        if getattr(node, "decorator_list", None):
+            dline = node.decorator_list[0].lineno
+            holds |= self._holds.get(dline - 1, set())
+        globals_declared: set[str] = set()
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for sub in _walk_excluding_defs(body):
+            if isinstance(sub, ast.Global):
+                globals_declared.update(sub.names)
+        self._func_stack.append(node)
+        self._async_stack.append(is_async)
+        self._holds_pragma_stack.append(holds)
+        self._global_decls.append(globals_declared)
+
+    def _exit_function(self) -> None:
+        self._func_stack.pop()
+        self._async_stack.pop()
+        self._holds_pragma_stack.pop()
+        self._global_decls.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_jax_def(node, is_async=False)
+        self._enter_function(node, is_async=False)
+        self.generic_visit(node)
+        self._exit_function()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_jax_def(node, is_async=True)
+        self._enter_function(node, is_async=True)
+        self.generic_visit(node)
+        self._exit_function()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_function(node, is_async=False)
+        self.generic_visit(node)
+        self._exit_function()
+
+    def _in_async(self) -> bool:
+        return bool(self._async_stack) and self._async_stack[-1]
+
+    def _current_func_name(self) -> str | None:
+        for f in reversed(self._func_stack):
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return f.name
+        return None
+
+    # -- with-lock tracking ------------------------------------------------
+
+    def _visit_with(self, node) -> None:
+        added = 0
+        for item in node.items:
+            d = dotted_name(item.context_expr)
+            if d is not None:
+                self._held_locks.append(d)
+                added += 1
+        self.generic_visit(node)
+        if added:
+            del self._held_locks[len(self._held_locks) - added:]
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node)
+
+    # -- rule 1: fire-and-forget tasks ------------------------------------
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call) and self._is_task_spawn(call):
+            self.report(
+                node, C.RULE_FIRE_AND_FORGET,
+                "task result is discarded: exceptions are lost and the task "
+                "can be garbage-collected mid-flight; store it, await it, or "
+                "attach a done-callback",
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_task_spawn(call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name):
+            # `from asyncio import create_task/ensure_future` call sites.
+            return func.id in ("create_task", "ensure_future")
+        if isinstance(func, ast.Attribute) and func.attr in ("create_task", "ensure_future"):
+            root = dotted_name(func.value)
+            # asyncio.create_task / loop.create_task / get_event_loop().
+            # TaskGroup.create_task holds its own reference — not matched
+            # (receivers named tg/group by convention).
+            if root is None:
+                return isinstance(func.value, ast.Call)  # get_event_loop().create_task
+            return root == "asyncio" or root.endswith("loop")
+        return False
+
+    # -- rule 2 dispatch + rule 5(c) on calls ------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_async():
+            self._check_blocking(node)
+        self._check_jit_call(node)
+        self._check_mutator_call(node)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        d = dotted_name(node.func)
+        if d is None:
+            return
+        if d == "open":
+            self.report(
+                node, C.RULE_BLOCKING_IN_ASYNC,
+                "sync file I/O (open) inside async def blocks the event "
+                "loop; use asyncio.to_thread",
+            )
+            return
+        if d in C.BLOCKING_CALLS:
+            self.report(node, C.RULE_BLOCKING_IN_ASYNC, C.BLOCKING_CALLS[d])
+            return
+        root = d.split(".")[0]
+        if root in C.BLOCKING_ROOTS:
+            self.report(node, C.RULE_BLOCKING_IN_ASYNC, C.BLOCKING_ROOTS[root])
+
+    # -- rule 3: broad except ---------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._is_broad(node.type) and not self._handler_is_hygienic(node):
+            what = "bare except" if node.type is None else "except Exception"
+            self.report(
+                node, C.RULE_BROAD_EXCEPT,
+                f"{what} that neither logs, re-raises, nor carries a "
+                "`# dynalint: allow-broad-except(<reason>)` pragma silently "
+                "swallows real failures",
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_broad(type_node: ast.expr | None) -> bool:
+        if type_node is None:
+            return True
+        names = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+        return any(
+            isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+            for n in names
+        )
+
+    @staticmethod
+    def _handler_is_hygienic(node: ast.ExceptHandler) -> bool:
+        log_attrs = {
+            "debug", "info", "warning", "error", "exception", "critical", "log",
+        }
+        for sub in _walk_excluding_defs(node.body):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                d = dotted_name(sub.func)
+                if d in ("traceback.print_exc", "warnings.warn"):
+                    return True
+                if isinstance(sub.func, ast.Attribute) and sub.func.attr in log_attrs:
+                    # Only count it as logging when the receiver looks like
+                    # a logger (log/logger/_log/self.log/lg...) — otherwise
+                    # math.log(x) or stats.update(...) would legitimize a
+                    # swallowing handler.
+                    recv = dotted_name(sub.func.value)
+                    last = recv.split(".")[-1] if recv else ""
+                    if "log" in last.lower() or last == "lg":
+                        return True
+            # `except Exception as e:` where the body references `e` is
+            # surfacing the error somewhere (str(e) into a reply, a status
+            # line, ...), not swallowing it.
+            if (
+                node.name
+                and isinstance(sub, ast.Name)
+                and sub.id == node.name
+                and isinstance(sub.ctx, ast.Load)
+            ):
+                return True
+        return False
+
+    # -- rule 4: lock discipline ------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_mutation_target(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_mutation_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._check_mutation_target(target, node)
+        self.generic_visit(node)
+
+    def _check_mutator_call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in C.MUTATOR_METHODS:
+            self._check_mutation_target(func.value, node)
+
+    def _base_attr(self, target: ast.expr) -> tuple[str | None, str] | None:
+        """Registry key for the object a mutation lands on.
+
+        ``self.X...`` -> (class, X); bare module global ``G...`` -> (None, G).
+        Peels subscripts: ``self.X[k] = v`` mutates X.
+        """
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class_stack
+        ):
+            return (self._class_stack[-1], target.attr)
+        if isinstance(target, ast.Name):
+            if not self._func_stack:
+                return (None, target.id)  # module top level (exempted later)
+            # A Store/Del on a bare name inside a function hits the module
+            # global only under a `global` declaration — without one it's a
+            # local, including locals that shadow a registered name.
+            if self._global_decls and target.id in self._global_decls[-1]:
+                return (None, target.id)
+            # Load context (mutator method call, e.g. `_free.append(x)`):
+            # no `global` needed to mutate through the name.
+            if isinstance(target.ctx, ast.Load):
+                return (None, target.id)
+        return None
+
+    def _check_mutation_target(self, target: ast.expr, site: ast.AST) -> None:
+        if not self._registry:
+            return
+        key = self._base_attr(target)
+        if key is None or key not in self._registry:
+            return
+        lock = self._registry[key]
+        if lock == C.EXTERNAL:
+            return
+        scope, attr = key
+        fname = self._current_func_name()
+        if fname is None:
+            return  # module top level: initial binding
+        if scope is not None and fname == "__init__":
+            return  # construction precedes sharing
+        want = f"self.{lock}" if scope is not None else lock
+        if want in self._held_locks:
+            return
+        if self._holds_pragma_stack and lock in self._holds_pragma_stack[-1]:
+            return
+        owner = f"{scope}.{attr}" if scope else attr
+        self.report(
+            site, C.RULE_LOCK_DISCIPLINE,
+            f"{owner} is registered GUARDED_BY({lock}) but is mutated "
+            f"outside `with {want}` (add the lock, or annotate the enclosing "
+            f"def with `# dynalint: holds-lock({lock})` if the caller holds it)",
+        )
+
+    # -- rule 5: jax pitfalls ---------------------------------------------
+
+    def _check_jax_def(self, node, is_async: bool) -> None:
+        # (a) jax/jnp inside __del__ or a registered signal handler.
+        hazard = None
+        if node.name == "__del__":
+            hazard = "__del__ runs at gc time, possibly during interpreter teardown"
+        elif node.name in self._signal_handlers:
+            hazard = "signal handlers run reentrantly at arbitrary points"
+        if hazard:
+            use = _uses_jax(node.body)
+            if use is not None:
+                self.report(
+                    use, C.RULE_JAX_PITFALL,
+                    f"jax/jnp call inside {node.name}: {hazard}; dispatching "
+                    "device work here can deadlock or crash the runtime",
+                )
+        # (b) @jax.jit over a function that touches bound mutable state.
+        for dec in node.decorator_list:
+            if _jit_decorator(dec):
+                args = node.args.posonlyargs + node.args.args
+                is_method = bool(args) and args[0].arg == "self" and self._class_stack
+                refs_self = any(
+                    isinstance(n, ast.Name) and n.id == "self"
+                    for n in ast.walk(node)
+                )
+                if is_method or refs_self:
+                    self.report(
+                        dec, C.RULE_JAX_PITFALL,
+                        f"@jit on {node.name!r} captures `self`: bound mutable "
+                        "state is baked in at trace time (stale closures, "
+                        "silent retraces); jit a pure function of arrays instead",
+                    )
+                self._scan_traced_body(node)
+
+    def _check_jit_call(self, node: ast.Call) -> None:
+        # (c) side effects in functions handed to jax.jit(f)/shard_map(f).
+        if dotted_name(node.func) not in C.JIT_WRAPPERS or not node.args:
+            return
+        target = node.args[0]
+        # jax.jit(partial(f, ...)) — unwrap to f.
+        if isinstance(target, ast.Call) and dotted_name(target.func) in (
+            "partial", "functools.partial",
+        ) and target.args:
+            target = target.args[0]
+        fn = None
+        if isinstance(target, ast.Name):
+            fn = self._module_defs.get(target.id)
+        elif isinstance(target, ast.Lambda):
+            fn = target
+        if fn is not None:
+            self._scan_traced_body(fn)
+
+    def _scan_traced_body(self, fn) -> None:
+        if id(fn) in self._jit_scanned:
+            return
+        self._jit_scanned.add(id(fn))
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for sub in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(sub, ast.Call):
+                d = dotted_name(sub.func)
+                if d == "print":
+                    self.report(
+                        sub, C.RULE_JAX_PITFALL,
+                        "print() inside a jitted/shard_mapped function runs "
+                        "only at trace time (and re-runs on every retrace); "
+                        "use jax.debug.print",
+                    )
+            elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+                self.report(
+                    sub, C.RULE_JAX_PITFALL,
+                    "global/nonlocal mutation inside a traced function is a "
+                    "trace-time side effect: it will not re-run per call",
+                )
+            elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                for t in targets:
+                    while isinstance(t, ast.Subscript):
+                        t = t.value
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.report(
+                            sub, C.RULE_JAX_PITFALL,
+                            f"mutation of self.{t.attr} inside a traced "
+                            "function happens at trace time only — the jitted "
+                            "executable will never update it again",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# Pragma extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_pragmas(path: str, source: str) -> tuple[list[Pragma], list[Finding]]:
+    pragmas: list[Pragma] = []
+    errors: list[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [], []
+    for line, text in comments:
+        if not _ANY_PRAGMA_RE.search(text):
+            continue
+        matched = False
+        for m in _ALLOW_RE.finditer(text):
+            rule, reason = m.group(1), m.group(2).strip()
+            matched = True
+            if rule not in C.ALL_RULES:
+                errors.append(Finding(
+                    path, line, 0, "malformed-pragma",
+                    f"allow pragma names unknown rule {rule!r} "
+                    f"(known: {', '.join(C.ALL_RULES)})",
+                ))
+            elif not reason:
+                errors.append(Finding(
+                    path, line, 0, "malformed-pragma",
+                    f"allow-{rule} pragma requires a non-empty reason",
+                ))
+            else:
+                pragmas.append(Pragma(path, line, "allow", rule, reason))
+        for m in _HOLDS_RE.finditer(text):
+            matched = True
+            pragmas.append(Pragma(path, line, "holds-lock", m.group(1), ""))
+        if not matched:
+            errors.append(Finding(
+                path, line, 0, "malformed-pragma",
+                "unparseable dynalint pragma; expected "
+                "`dynalint: allow-<rule>(<reason>)` or "
+                "`dynalint: holds-lock(<lock>)`",
+            ))
+    return pragmas, errors
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    pragmas: list[Pragma] = field(default_factory=list)
+
+
+def lint_file(path: Path, repo_root: Path | None = None) -> LintResult:
+    rel = path.resolve()
+    if repo_root is not None:
+        try:
+            rel = rel.relative_to(repo_root.resolve())
+        except ValueError:
+            pass
+    rel_str = rel.as_posix()
+    source = path.read_text(encoding="utf-8", errors="replace")
+    pragmas, errors = extract_pragmas(rel_str, source)
+    result = LintResult(findings=list(errors), pragmas=pragmas)
+    try:
+        tree = ast.parse(source, filename=rel_str)
+    except SyntaxError as e:
+        result.findings.append(
+            Finding(rel_str, e.lineno or 0, e.offset or 0, "syntax-error", e.msg or "syntax error")
+        )
+        return result
+    result.findings.extend(_FileLinter(rel_str, tree, pragmas).run())
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result
+
+
+def _excluded(rel: str) -> bool:
+    return any(part in rel for part in C.EXCLUDE_PARTS)
+
+
+def iter_py_files(paths: list[Path], repo_root: Path) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                try:
+                    rel = f.resolve().relative_to(repo_root.resolve()).as_posix()
+                except ValueError:
+                    rel = f.as_posix()
+                if not _excluded(rel):
+                    out.append(f)
+    return out
+
+
+def lint_paths(paths: list[Path], repo_root: Path | None = None) -> LintResult:
+    root = repo_root or Path.cwd()
+    total = LintResult()
+    for f in iter_py_files(paths, root):
+        r = lint_file(f, root)
+        total.findings.extend(r.findings)
+        total.pragmas.extend(r.pragmas)
+    total.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return total
