@@ -30,6 +30,8 @@ import argparse
 import asyncio
 import dataclasses
 import logging
+import math
+import time
 import uuid
 
 import msgpack
@@ -240,6 +242,20 @@ def _model_card(model_name: str, tokenizer: str, core) -> ModelDeploymentCard:
     )
 
 
+def _pp_prefill_buckets(
+    prefill_buckets: tuple[int, ...], pp: int, block_size: int
+) -> tuple[int, ...]:
+    """Prefill buckets usable under ``--pp``: every bucket must split into
+    pp microbatch groups (EngineCore validates). Keeps the divisible
+    subset; when none survives, synthesizes one bucket divisible by both
+    pp and block_size, near the largest requested."""
+    kept = tuple(b for b in prefill_buckets if b % pp == 0)
+    if kept:
+        return kept
+    step = math.lcm(pp, block_size)
+    return (step * max(1, prefill_buckets[-1] // step),)
+
+
 def build_engine(
     preset: str,
     engine_overrides: dict[str, Any] | None = None,
@@ -322,9 +338,28 @@ def build_engine(
         from dynamo_tpu.parallel.pipeline import make_pp_mesh
 
         pp_mesh = make_pp_mesh(pp)
+        # Fail fast with CLI-pointed errors: these used to surface as a
+        # late EngineCore construction failure deep inside shard setup.
+        if model_cfg.num_layers % pp:
+            raise ValueError(
+                f"--pp {pp} must divide the model's num_layers="
+                f"{model_cfg.num_layers} (layers stage evenly over the pp "
+                "mesh); pick a pp that divides the layer count"
+            )
+        if model_cfg.vocab_size % pp:
+            raise ValueError(
+                f"--pp {pp} must divide the model's vocab_size="
+                f"{model_cfg.vocab_size} (the lm head splits over stages)"
+            )
         # Prefill buckets and decode widths must split into pp microbatch
-        # groups (EngineCore validates; pre-trim decode widths here the
-        # same way dp does below).
+        # groups (EngineCore validates; pre-trim BOTH here the same way
+        # dp trims decode widths below — prefill buckets used to slip
+        # through and die at EngineCore construction).
+        pbuckets = _pp_prefill_buckets(
+            engine_cfg.prefill_buckets, pp, engine_cfg.block_size
+        )
+        if pbuckets != engine_cfg.prefill_buckets:
+            engine_cfg = dataclasses.replace(engine_cfg, prefill_buckets=pbuckets)
         buckets = tuple(b for b in engine_cfg.decode_buckets if b % pp == 0)
         if buckets != engine_cfg.decode_buckets:
             if not buckets:
@@ -560,7 +595,14 @@ async def run_jax_worker(
         async def _serve_queued(task: dict) -> None:
             try:
                 req = task["request"]
-                ctx = Context(req.get("request_id") or f"qprefill-{uuid.uuid4().hex[:8]}")
+                # The queued task carries the decode side's traceparent:
+                # spans this worker records (engine prefill phase) stitch
+                # into the originating request's trace.
+                tp = task.get("traceparent")
+                ctx = Context(
+                    req.get("request_id") or f"qprefill-{uuid.uuid4().hex[:8]}",
+                    headers={"traceparent": tp} if tp else None,
+                )
                 last: dict | None = None
                 async for out in engine.generate(req, ctx):
                     last = out
@@ -678,7 +720,10 @@ async def run_jax_worker(
                     depth = disagg.config.max_prefill_queue_size + 1
             if (
                 prefill_client.instance_ids()
-                and disagg.should_remote_prefill(uncached, depth)
+                and disagg.decide(
+                    uncached, depth,
+                    headers=context.headers, request_id=pre.request_id,
+                )
             ):
                 # Track what already reached the client: a mid-stream
                 # failure must resume by token replay (migration.py
@@ -687,7 +732,7 @@ async def run_jax_worker(
                 try:
                     async for out in _remote_prefill_then_decode(
                         core, engine, pre, context, runtime.store, qname,
-                        transfer_client, emitted,
+                        transfer_client, emitted, tracer=disagg.tracer,
                     ):
                         yield out
                     return
@@ -891,7 +936,7 @@ async def _run_multihost(
 async def _remote_prefill_then_decode(
     core, engine, pre: PreprocessedRequest, context: Context,
     store, qname: str, transfer_client, emitted: list[int] | None = None,
-    reply_timeout: float = 120.0,
+    tracer=None, reply_timeout: float = 120.0,
 ) -> AsyncIterator[Any]:
     """Decode-first disaggregation: queued remote prefill, block pull,
     local continuation by token replay (reference handlers.py:113-151;
@@ -910,14 +955,22 @@ async def _remote_prefill_then_decode(
     reply_key = f"/dynamo/prefill-reply/{pre.request_id}-{uuid.uuid4().hex[:8]}"
     sub = await store.kv_watch(reply_key, with_initial=False)
     first: dict | None = None
+    t_handoff = time.time()
     try:
         # msgpack, not json: multimodal requests carry raw embedding
         # bytes which json cannot represent (and the data plane is
         # msgpack everywhere else).
+        # The traceparent rides the queue task so the prefill worker's
+        # spans (its engine prefill phase) join this request's trace even
+        # though the work queue, unlike the dataplane, has no header map.
         await store.queue_push(
             qname,
             msgpack.packb(
-                {"request": prefill_req.to_wire(), "reply_key": reply_key},
+                {
+                    "request": prefill_req.to_wire(),
+                    "reply_key": reply_key,
+                    "traceparent": (context.headers or {}).get("traceparent"),
+                },
                 use_bin_type=True,
             ),
         )
@@ -928,6 +981,16 @@ async def _remote_prefill_then_decode(
     finally:
         await sub.unsubscribe()
         await store.kv_del(reply_key)
+        if tracer is not None:
+            tracer.record(
+                "prefill_handoff", t_handoff, time.time(),
+                headers=context.headers,
+                attrs={
+                    "request_id": pre.request_id,
+                    "prefill_tokens": len(pre.token_ids),
+                    "ok": first is not None and "error" not in (first or {}),
+                },
+            )
     if first is None:
         raise ConnectionError("prefill worker returned no output")
     if "error" in first:
@@ -940,6 +1003,7 @@ async def _remote_prefill_then_decode(
     if prefill_worker is not None and rid is not None:
         descs: list[dict] | None = None
         imported = total = dropped = 0
+        t_xfer = time.time()
         bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
         async for frame in bstream:
             if "error" in frame:
@@ -973,6 +1037,17 @@ async def _remote_prefill_then_decode(
             )
         else:
             log.debug("imported %d/%d transferred blocks for %s", imported, total, rid)
+        if tracer is not None:
+            tracer.record(
+                "kv_transfer", t_xfer, time.time(), headers=context.headers,
+                attrs={
+                    "request_id": pre.request_id,
+                    "prefill_worker": prefill_worker,
+                    "blocks": total,
+                    "imported": imported,
+                    "dropped": dropped,
+                },
+            )
 
     token1 = out1.token_ids[0]
     first_chunk = LLMEngineOutput(

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu import tracing
 from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.model import (
@@ -608,6 +609,10 @@ class EngineCore:
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         self.iterations = 0
+        # Step-level spans (engine_prefill_step / engine_decode_step with
+        # token counts). record() on a disabled tracer is a no-op, and the
+        # collector's deque.append is atomic — safe from the engine thread.
+        self._tracer = tracing.get_tracer("engine")
         self._req_counter = 0
         self._lock = threading.Lock()
         # Serializes step() against cross-thread cache surgery
@@ -1227,11 +1232,17 @@ class EngineCore:
 
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
+            t_wave = time.time()
             ring_out = self._maybe_ring_prefill(prefills)
             if ring_out is not None:
                 outputs.extend(ring_out)
+                self._tracer.record(
+                    "engine_prefill_step", t_wave, time.time(),
+                    attrs={"seqs": len(prefills), "ring": True}, stat=True,
+                )
                 return outputs
-            for seq, _chunk, tok, lp in self._run_prefill_wave(prefills):
+            wave = self._run_prefill_wave(prefills)
+            for seq, _chunk, tok, lp in wave:
                 if tok is None:
                     continue  # prompt not finished this wave
                 seq.pending = tok
@@ -1239,6 +1250,14 @@ class EngineCore:
                 outputs.append((seq, self._emit(seq, tok, lp)))
                 if seq.finish is not None:
                     self._finish(seq)
+            self._tracer.record(
+                "engine_prefill_step", t_wave, time.time(),
+                attrs={
+                    "seqs": len(wave),
+                    "tokens": sum(chunk for _, chunk, _, _ in wave),
+                },
+                stat=True,
+            )
             return outputs
 
         decoding = [s for s in self.running if s.pending is not None]
@@ -1262,6 +1281,8 @@ class EngineCore:
         if not ready:
             return outputs
 
+        t_decode = time.time()
+        emitted_total = 0
         chained, lps = self._run_decode(ready, n_steps)  # [n_steps, len(ready)]
         for i, seq in enumerate(ready):
             toks = chained[:, i]
@@ -1285,11 +1306,17 @@ class EngineCore:
                     for j in range(k)
                 ]
             outputs.append((seq, self._emit_chunk(seq, emitted, lp_entries, finish)))
+            emitted_total += len(emitted)
             if finish is not None:
                 seq.finish = finish
                 self._finish(seq)
             else:
                 seq.pending = emitted[-1]
+        self._tracer.record(
+            "engine_decode_step", t_decode, time.time(),
+            attrs={"seqs": len(ready), "chain": n_steps, "tokens": emitted_total},
+            stat=True,
+        )
         return outputs
 
     def _scan_stop(self, seq: Sequence, toks: np.ndarray) -> tuple[int, str | None]:

@@ -15,8 +15,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+import uuid
 from typing import Any, AsyncIterator
 
+from dynamo_tpu import tracing
 from dynamo_tpu.engine.core import EngineCore, Sequence
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
 from dynamo_tpu.runtime.engine import Context
@@ -33,6 +36,7 @@ class TpuEngine:
         self._seqs: dict[str, Sequence] = {}
         self._wakeup = asyncio.Event()
         self._loop_task: asyncio.Task | None = None
+        self._tracer = tracing.get_tracer("engine")
 
     async def generate(self, request: dict, context: Context) -> AsyncIterator[dict]:
         if request.get("clear_kv_blocks"):
@@ -51,6 +55,13 @@ class TpuEngine:
             return
         pre = PreprocessedRequest.from_wire(request)
         pre.request_id = pre.request_id or context.id
+        if pre.request_id in self._queues:
+            # Client-supplied ids (adopted by the frontend) are not
+            # guaranteed unique across frontends; engine state is keyed
+            # by id, so uniquify locally rather than clobber a live stream.
+            pre.request_id = f"{pre.request_id}#{uuid.uuid4().hex[:6]}"
+        t_submit = time.time()
+        t_first = t_last = 0.0
         seq = self.core.add_request(pre)
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[seq.request_id] = queue
@@ -62,6 +73,9 @@ class TpuEngine:
                 item = await queue.get()
                 if item is _FINISHED:
                     return
+                t_last = time.time()
+                if not t_first:
+                    t_first = t_last
                 yield item
                 if context.is_stopped:
                     self.core.cancel_request(seq)
@@ -70,6 +84,23 @@ class TpuEngine:
             self.core.cancel_request(seq)
             self._queues.pop(seq.request_id, None)
             self._seqs.pop(seq.request_id, None)
+            # Per-request phase attribution: prefill ends at the first
+            # emitted chunk (prompt processed + first sampled token),
+            # decode covers the rest of the stream. Parented through the
+            # dataplane headers so spans stitch under the frontend root.
+            if t_first:
+                self._tracer.record(
+                    "prefill", t_submit, t_first, headers=context.headers,
+                    attrs={
+                        "request_id": seq.request_id,
+                        "prompt_tokens": seq.prompt_len,
+                        "cached_tokens": seq.num_cached_tokens,
+                    },
+                )
+                self._tracer.record(
+                    "decode", t_first, t_last, headers=context.headers,
+                    attrs={"request_id": seq.request_id, "tokens": seq.generated},
+                )
 
     def metrics(self):
         return self.core.metrics()

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from dataclasses import dataclass
+
+from dynamo_tpu import tracing
 
 log = logging.getLogger("dynamo_tpu.disagg")
 
@@ -33,6 +36,10 @@ class DisaggConfig:
 class DisaggRouter:
     def __init__(self, config: DisaggConfig | None = None):
         self.config = config or DisaggConfig()
+        # Disagg-phase spans (the decision here; prefill_handoff /
+        # kv_transfer recorded by the decode worker around the actual
+        # queue round-trip and block pull) share this tracer.
+        self.tracer = tracing.get_tracer("disagg")
 
     def should_remote_prefill(
         self, prefill_length: int, queue_depth: int = 0
@@ -45,6 +52,28 @@ class DisaggRouter:
             and prefill_length > c.max_local_prefill_length
             and queue_depth <= c.max_prefill_queue_size
         )
+
+    def decide(
+        self,
+        prefill_length: int,
+        queue_depth: int = 0,
+        headers: dict[str, str] | None = None,
+        request_id: str | None = None,
+    ) -> bool:
+        """`should_remote_prefill` + a span attributing the decision (and
+        its inputs) to the request's trace."""
+        t0 = time.time()
+        remote = self.should_remote_prefill(prefill_length, queue_depth)
+        self.tracer.record(
+            "disagg_decision", t0, time.time(), headers=headers,
+            attrs={
+                "request_id": request_id,
+                "prefill_length": prefill_length,
+                "queue_depth": queue_depth,
+                "remote": remote,
+            },
+        )
+        return remote
 
     async def watch_store(self, store, namespace: str) -> None:
         """Follow config updates at DISAGG_CONFIG_KEY (hot reload)."""

@@ -20,11 +20,13 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import re
 import time
 
 from aiohttp import web
 from pydantic import ValidationError
 
+from dynamo_tpu import tracing
 from dynamo_tpu.llm.model_manager import ModelManager, ServedModel
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionRequest,
@@ -44,6 +46,11 @@ log = logging.getLogger("dynamo_tpu.http")
 
 _TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 _ITL_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+# Inbound x-request-id values must be shaped like ids before we adopt them
+# (they land in logs, traces, and the control-plane store): conservative
+# charset, bounded length. Anything else gets a freshly minted id.
+_CLIENT_RID_RE = re.compile(r"^[A-Za-z0-9._:\-]{1,128}$")
 
 
 class HttpService:
@@ -72,7 +79,15 @@ class HttpService:
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.prometheus)
+        self.app.router.add_get("/traces", self.traces)
         self._runner: web.AppRunner | None = None
+        # Client-supplied request ids currently in flight (duplicates get
+        # a fresh mint; see _request_id).
+        self._inflight_rids: set[str] = set()
+        self._tracer = tracing.get_tracer("frontend")
+        # Per-phase latency histograms (dynamo_trace_phase_duration_seconds)
+        # land on the same registry the planner observer scrapes.
+        tracing.get_collector().bind_metrics(self.metrics)
 
     async def start(self) -> None:
         ssl_ctx = None
@@ -124,11 +139,36 @@ class HttpService:
     def _lookup(self, model: str) -> ServedModel | None:
         return self.manager.get(model)
 
-    def _headers_for(self, request: web.Request, request_id: str) -> dict[str, str]:
-        return {
+    def _headers_for(
+        self, request: web.Request, request_id: str, span=None
+    ) -> dict[str, str]:
+        """Downstream dataplane headers: the request id plus a traceparent.
+        With a live root span, downstream spans parent to IT; otherwise
+        the pre-tracing behavior (a child of the client's traceparent, or
+        a fresh trace) keeps log correlation working."""
+        headers = {
             TRACEPARENT_HEADER: child_traceparent(request.headers.get(TRACEPARENT_HEADER)),
             "x-request-id": request_id,
         }
+        if span is not None:
+            tracing.inject_headers(span, headers)
+        return headers
+
+    def _request_id(self, request: web.Request, prefix: str) -> str:
+        """Honor a well-formed inbound ``x-request-id`` (so client-side and
+        server-side traces correlate); mint one otherwise. An adopted id
+        that is still in flight gets a fresh mint instead — downstream
+        state (engine queues, KV pulls) is keyed by request id, so two
+        concurrent requests must never share one. Handlers release the id
+        via :meth:`_release_request_id` when the request finishes."""
+        client_rid = request.headers.get("x-request-id", "").strip()
+        if _CLIENT_RID_RE.match(client_rid) and client_rid not in self._inflight_rids:
+            self._inflight_rids.add(client_rid)
+            return client_rid
+        return new_request_id(prefix)
+
+    def _release_request_id(self, rid: str) -> None:
+        self._inflight_rids.discard(rid)
 
     # -- handlers ----------------------------------------------------------
 
@@ -141,6 +181,11 @@ class HttpService:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.status_server import render_traces
+
+        return web.json_response(render_traces(request))
 
     async def list_models(self, request: web.Request) -> web.Response:
         out = ModelList(
@@ -300,7 +345,7 @@ class HttpService:
         tok = served.preprocessor.tokenizer
         data = []
         total_tokens = 0
-        rid = new_request_id("embd")
+        rid = self._request_id(request, "embd")
         headers = self._headers_for(request, rid)
         try:
             for i, item in enumerate(inputs):
@@ -319,6 +364,8 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             log.exception("embeddings request %s failed", rid)
             return self._error(500, str(e), "internal_error")
+        finally:
+            self._release_request_id(rid)
         return web.json_response(
             {
                 "object": "list",
@@ -365,7 +412,7 @@ class HttpService:
         if served is None:
             return self._error(404, f"model {model!r} not found", "model_not_found")
 
-        rid = new_request_id("resp")
+        rid = self._request_id(request, "resp")
         pre = served.preprocessor.preprocess_chat(body)
         pre.request_id = rid
         chunks = served.preprocessor.postprocess_chat_stream(
@@ -386,6 +433,8 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             log.exception("responses request %s failed", rid)
             return self._error(500, str(e), "internal_error")
+        finally:
+            self._release_request_id(rid)
         return web.json_response(
             {
                 "id": rid,
@@ -428,27 +477,44 @@ class HttpService:
         if served is None:
             return self._error(404, f"model {body.model!r} not found", "model_not_found")
 
-        rid = new_request_id(rid_prefix)
+        rid = self._request_id(request, rid_prefix)
         m = self.metrics.scoped(service="frontend", model=body.model, endpoint=endpoint)
         m.counter("frontend_requests_total").inc()
         inflight = m.gauge("frontend_inflight_requests")
         inflight.inc()
         started = time.monotonic()
+        # Root span of the request's trace: every downstream phase
+        # (tokenize here; route/prefill/decode in other processes) parents
+        # to it through the headers built below.
+        root = self._tracer.span(
+            "http",
+            headers=request.headers,
+            attrs={"request_id": rid, "endpoint": endpoint, "model": body.model},
+        )
         try:
-            chunks = make_stream(served, body, rid, self._headers_for(request, rid), m)
+            with self._tracer.span("tokenize", parent=root):
+                # make_stream runs the synchronous preprocess (chat
+                # template + tokenize) before returning the lazy stream.
+                chunks = make_stream(
+                    served, body, rid, self._headers_for(request, rid, root), m
+                )
             if body.stream:
                 return await self._stream_sse(request, chunks, started, m)
             return await aggregate(rid, body, chunks)
         except asyncio.CancelledError:
+            root.set("error", "cancelled")
             raise
         except Exception as e:  # noqa: BLE001 — surface engine errors as 500s
             log.exception("%s request %s failed", endpoint, rid)
+            root.set("error", type(e).__name__)
             return self._error(500, str(e), "internal_error")
         finally:
+            self._release_request_id(rid)
             inflight.dec()
             m.histogram("frontend_request_duration_seconds").observe(
                 time.monotonic() - started
             )
+            root.finish()
 
     # -- response shaping --------------------------------------------------
 

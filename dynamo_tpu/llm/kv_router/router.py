@@ -17,6 +17,7 @@ import dataclasses
 import logging
 from typing import Any, AsyncIterator
 
+from dynamo_tpu import tracing
 from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer, KvIndexer
 from dynamo_tpu.llm.kv_router.protocols import RouterConfig, kv_events_subject
 from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector, SelectionResult
@@ -140,6 +141,7 @@ class KvPushRouter:
         # routing when the config sets a busy_threshold; its aggregator
         # also feeds ProcessedEndpoints snapshots to observers.
         self.monitor = monitor
+        self._tracer = tracing.get_tracer("router")
         client.on_instance_removed.append(self._on_worker_gone)
 
     def _on_worker_gone(self, worker_id: int) -> None:
@@ -159,31 +161,52 @@ class KvPushRouter:
         exclude: set[int] | None = None,
     ) -> AsyncIterator[Any]:
         overrides = router_overrides or {}
-        workers = self.client.instance_ids()
-        if exclude:
-            # Migration retries must not re-dial a worker that just failed —
-            # its cached prefix makes it the router's top pick otherwise.
-            workers = [w for w in workers if w not in exclude] or workers
-        if self.monitor is not None and self.router.config.busy_threshold is not None:
-            workers = self.monitor.eligible(workers)
-        if not workers:
-            raise NoInstancesError(self.client.endpoint.path)
-        pinned = overrides.get("backend_instance_id")
-        if pinned is not None:
-            selection = SelectionResult(
-                worker_id=pinned, overlap_blocks=0, required_prefill_tokens=len(token_ids), costs={}
-            )
-            self.router.note_pinned(request_id, pinned, len(token_ids))
-        else:
-            config = self.router.config
-            if "overlap_weight" in overrides or "router_temperature" in overrides:
-                config = RouterConfig(
-                    overlap_weight=overrides.get("overlap_weight", config.overlap_weight),
-                    temperature=overrides.get("router_temperature", config.temperature),
-                    use_kv_events=config.use_kv_events,
-                    block_size=config.block_size,
+        # Route-decision span: closed before dispatch, so the routing cost
+        # never overlaps the worker's prefill phase in the waterfall.
+        with self._tracer.span(
+            "route", headers=headers, attrs={"request_id": request_id}
+        ) as route_span:
+            workers = self.client.instance_ids()
+            if exclude:
+                # Migration retries must not re-dial a worker that just failed —
+                # its cached prefix makes it the router's top pick otherwise.
+                workers = [w for w in workers if w not in exclude] or workers
+            if self.monitor is not None and self.router.config.busy_threshold is not None:
+                workers = self.monitor.eligible(workers)
+            if not workers:
+                raise NoInstancesError(self.client.endpoint.path)
+            pinned = overrides.get("backend_instance_id")
+            if pinned is not None:
+                selection = SelectionResult(
+                    worker_id=pinned, overlap_blocks=0, required_prefill_tokens=len(token_ids), costs={}
                 )
-            selection = self.router.find_best_match(request_id, token_ids, workers, config)
+                self.router.note_pinned(request_id, pinned, len(token_ids))
+            else:
+                config = self.router.config
+                if "overlap_weight" in overrides or "router_temperature" in overrides:
+                    config = RouterConfig(
+                        overlap_weight=overrides.get("overlap_weight", config.overlap_weight),
+                        temperature=overrides.get("router_temperature", config.temperature),
+                        use_kv_events=config.use_kv_events,
+                        block_size=config.block_size,
+                    )
+                selection = self.router.find_best_match(request_id, token_ids, workers, config)
+                if route_span.recording and selection.score_end_s > selection.score_start_s:
+                    # The selector has no trace context; it stamps the
+                    # scoring-pass bounds and we file them here, parented
+                    # to the route span.
+                    self._tracer.record(
+                        "overlap_score",
+                        selection.score_start_s,
+                        selection.score_end_s,
+                        parent=route_span,
+                        attrs={"workers": len(workers)},
+                    )
+            route_span.set("worker_id", selection.worker_id)
+            route_span.set("overlap_blocks", selection.overlap_blocks)
+            route_span.set("required_prefill_tokens", selection.required_prefill_tokens)
+            if selection.costs:
+                route_span.set("cost", selection.costs.get(selection.worker_id))
         payload = dict(payload)
         payload.setdefault("meta", {})["overlap_blocks"] = selection.overlap_blocks
         # Cross-worker prefix pull (reference KVBM-distributed semantics,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import random
+import time
 from dataclasses import dataclass
 from typing import Protocol
 
@@ -36,6 +37,12 @@ class SelectionResult:
     # selection time — lets the caller detect a better-overlapping PEER
     # than the chosen worker (cross-worker prefix pull).
     overlaps: dict[int, int] = None  # type: ignore[assignment]
+    # Wall-clock bounds of the scoring pass (cost function + softmax),
+    # stamped by the selector. The router files these as an
+    # ``overlap_score`` child span of its route span — the selector
+    # itself has no trace context, so the span is recorded upstream.
+    score_start_s: float = 0.0
+    score_end_s: float = 0.0
 
 
 class WorkerSelector(Protocol):
@@ -90,6 +97,7 @@ class DefaultWorkerSelector:
     ) -> SelectionResult:
         if not workers:
             raise ValueError("no live workers")
+        t_score = time.time()
         block_size = active.block_size
         prompt_blocks = math.ceil(prompt_tokens / block_size) if prompt_tokens else 0
         costs: dict[int, float] = {}
@@ -107,4 +115,6 @@ class DefaultWorkerSelector:
             overlap_blocks=overlap,
             required_prefill_tokens=max(0, prompt_tokens - overlap * block_size),
             costs=costs,
+            score_start_s=t_score,
+            score_end_s=time.time(),
         )

@@ -24,9 +24,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import replace
 from typing import AsyncIterator
 
+from dynamo_tpu import tracing
 from dynamo_tpu.llm.kv_router.router import KvPushRouter
 from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
@@ -91,6 +93,7 @@ class MigrationOperator:
 
     def __init__(self, limit: int = 3):
         self.limit = limit
+        self._tracer = tracing.get_tracer("migration")
 
     async def generate(
         self, pre: PreprocessedRequest, context: Context, next: NextFn
@@ -99,17 +102,39 @@ class MigrationOperator:
         generated: list[int] = []
         failed_workers: set[int] = set()
         current = pre
+
+        def trace_attempt(start_s: float, outcome: str) -> None:
+            # Per-attempt spans only once a migration actually happened:
+            # the unmigrated fast path records nothing (span names stay a
+            # small fixed set; the attempt index is an attribute).
+            if attempts == 0 and outcome != "failed":
+                return
+            self._tracer.record(
+                "migration_attempt", start_s, time.time(),
+                headers=context.headers,
+                attrs={
+                    "request_id": pre.request_id,
+                    "attempt": attempts,
+                    "replayed_tokens": len(current.token_ids) - len(pre.token_ids),
+                    "outcome": outcome,
+                },
+            )
+
         while True:
             attempt_ctx = context.child()
             attempt_ctx.meta["exclude_instances"] = failed_workers
+            t_attempt = time.time()
             try:
                 async for out in next(current, attempt_ctx):
                     generated.extend(out.token_ids)
                     yield out
                     if out.finish_reason is not None:
+                        trace_attempt(t_attempt, "completed")
                         return
+                trace_attempt(t_attempt, "completed")
                 return
             except (ConnectionError, NoInstancesError) as e:
+                trace_attempt(t_attempt, "failed")
                 attempts += 1
                 failed = getattr(e, "worker_id", None)
                 if failed is not None:

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
+from dynamo_tpu import tracing
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError, MockKvManager
 from dynamo_tpu.llm.protocols.common import (
@@ -63,6 +65,12 @@ class _Seq:
     generated: int = 0
     cancelled: bool = False
     stop: StopConditions = field(default_factory=StopConditions)
+    # Phase timestamps for the tracer (0.0 = not reached yet). The spans
+    # are emitted retroactively when the stream closes so the sim loop's
+    # hot path only ever stamps a float.
+    t_submit: float = 0.0
+    t_prefill_done: float = 0.0
+    t_last_token: float = 0.0
 
     @property
     def prefill_done(self) -> bool:
@@ -92,6 +100,7 @@ class MockTpuEngine:
         self._wakeup = asyncio.Event()
         self._loop_task: asyncio.Task | None = None
         self._iterations = 0
+        self._tracer = tracing.get_tracer("engine")
 
     # -- public engine surface --------------------------------------------
 
@@ -129,6 +138,7 @@ class MockTpuEngine:
             prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
             stop=pre.stop,
         )
+        seq.t_submit = time.time()
         self._waiting.append(seq)
         self._ensure_loop()
         self._wakeup.set()
@@ -143,6 +153,27 @@ class MockTpuEngine:
                     return
         finally:
             seq.cancelled = True
+            self._trace_phases(seq, context)
+
+    def _trace_phases(self, seq: _Seq, context: Context) -> None:
+        """Emit the request's prefill/decode spans from the timestamps the
+        sim loop stamped; parented through the dataplane headers so they
+        stitch under the frontend's root span."""
+        headers = context.headers
+        if seq.t_prefill_done:
+            self._tracer.record(
+                "prefill", seq.t_submit, seq.t_prefill_done, headers=headers,
+                attrs={
+                    "request_id": seq.request_id,
+                    "prompt_tokens": len(seq.prompt),
+                    "cached_tokens": seq.cached_blocks * self.args.block_size,
+                },
+            )
+        if seq.generated and seq.t_last_token and seq.t_prefill_done:
+            self._tracer.record(
+                "decode", seq.t_prefill_done, seq.t_last_token, headers=headers,
+                attrs={"request_id": seq.request_id, "tokens": seq.generated},
+            )
 
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
@@ -210,6 +241,8 @@ class MockTpuEngine:
             seq.pinned = list(seq.prompt_hashes[:cached])
             seq.partials_held = need
             seq.prefilled = cached * self.args.block_size
+            if seq.prefill_done:  # fully prefix-cached: no prefill phase
+                seq.t_prefill_done = time.time()
             self._running.append(seq)
 
     def _step(self) -> tuple[int, int]:
@@ -239,6 +272,8 @@ class MockTpuEngine:
                     self.kv.commit_block(h, parent)
                     seq.partials_held -= 1
                     seq.pinned.append(h)
+                if seq.prefill_done:
+                    seq.t_prefill_done = time.time()
                 continue
 
             # Decode: one token per iteration.
@@ -264,6 +299,7 @@ class MockTpuEngine:
                     "cached_tokens": seq.cached_blocks * self.args.block_size,
                     "iteration": self._iterations,
                 }
+            seq.t_last_token = time.time()
             finish = self._check_stop(seq, token)
             if finish is not None:
                 out.finish_reason = finish

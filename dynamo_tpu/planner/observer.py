@@ -15,6 +15,7 @@ adjustment window's :class:`Observation`.
 from __future__ import annotations
 
 import logging
+import re
 import time
 
 import aiohttp
@@ -29,10 +30,17 @@ _TTFT = "dynamo_frontend_time_to_first_token_seconds"
 _ITL = "dynamo_frontend_inter_token_latency_seconds"
 _ISL = "dynamo_frontend_input_sequence_tokens"
 _OSL = "dynamo_frontend_output_sequence_tokens"
+# Per-phase latency histograms from the tracer (dynamo_tpu/tracing):
+# the measured TTFT/ITL decomposition (tokenize/route/prefill/decode...).
+_PHASE = "dynamo_trace_phase_duration_seconds"
+_PHASE_LABEL_RE = re.compile(r'phase="([^"]+)"')
 
 
 def parse_prometheus(text: str) -> dict[str, float]:
-    """Sum every sample of each metric family (labels collapsed)."""
+    """Sum every sample of each metric family (labels collapsed) — except
+    the tracer's per-phase histograms, whose ``_sum``/``_count`` series
+    are *also* kept per phase label (keyed ``{family}_sum{{phase}}``) so
+    :meth:`MetricsObserver.observe` can decompose TTFT/ITL by phase."""
     totals: dict[str, float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -43,9 +51,15 @@ def parse_prometheus(text: str) -> dict[str, float]:
             continue
         name = name_part.split("{", 1)[0]
         try:
-            totals[name] = totals.get(name, 0.0) + float(value)
+            v = float(value)
         except ValueError:
             continue
+        totals[name] = totals.get(name, 0.0) + v
+        if name.startswith(_PHASE) and name != f"{_PHASE}_bucket":
+            m = _PHASE_LABEL_RE.search(name_part)
+            if m:
+                key = f"{name}{{{m.group(1)}}}"
+                totals[key] = totals.get(key, 0.0) + v
     return totals
 
 
@@ -90,10 +104,25 @@ class MetricsObserver:
         self._last_means = (isl, osl)
         ttft_c = delta(f"{_TTFT}_count")
         itl_c = delta(f"{_ITL}_count")
+
+        # Measured per-phase decomposition over the window: mean seconds
+        # spent in each tracer phase (tokenize/route/prefill/decode/...),
+        # from the dynamo_trace_phase_duration_seconds{phase=...} series.
+        phase_means: dict[str, float] = {}
+        prefix = f"{_PHASE}_count{{"
+        for key in cur:
+            if not key.startswith(prefix):
+                continue
+            phase = key[len(prefix):-1]
+            c = delta(key)
+            if c > 0:
+                phase_means[phase] = delta(f"{_PHASE}_sum{{{phase}}}") / c
+
         return Observation(
             request_rate=rate,
             mean_isl=isl,
             mean_osl=osl,
             observed_ttft_s=(delta(f"{_TTFT}_sum") / ttft_c) if ttft_c else None,
             observed_itl_s=(delta(f"{_ITL}_sum") / itl_c) if itl_c else None,
+            phase_means=phase_means or None,
         )

@@ -46,6 +46,11 @@ class Observation:
     mean_osl: float          # output tokens/request
     observed_ttft_s: float | None = None
     observed_itl_s: float | None = None
+    # Measured TTFT/ITL decomposition from the tracer's per-phase
+    # histograms ({phase: mean seconds} over the window) — lets the
+    # planner tell a routing regression from a prefill regression instead
+    # of reasoning from totals alone.
+    phase_means: dict[str, float] | None = None
 
 
 @dataclass
@@ -101,10 +106,14 @@ class Planner:
     # -- planning math -----------------------------------------------------
 
     def _update_corrections(self, obs: Observation) -> None:
-        if obs.observed_ttft_s:
+        # Prefer the tracer's measured prefill-phase mean over total TTFT:
+        # totals fold tokenize/route/queue time into the prefill correction,
+        # so a routing regression would wrongly scale up prefill replicas.
+        ttft_signal = (obs.phase_means or {}).get("prefill") or obs.observed_ttft_s
+        if ttft_signal:
             expected = self.prefill_interp.ttft_at(obs.mean_isl)
             if expected > 0:
-                self.correction_prefill = max(0.1, min(10.0, obs.observed_ttft_s / expected))
+                self.correction_prefill = max(0.1, min(10.0, ttft_signal / expected))
         if obs.observed_itl_s:
             conc = self.decode_interp.max_concurrency_within(self.sla.itl_s)
             expected = self.decode_interp.itl_at(conc)

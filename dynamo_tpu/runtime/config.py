@@ -35,6 +35,10 @@ class RuntimeConfig:
     # Logging
     logging_jsonl: bool = False
     log_level: str = "INFO"
+    # Request tracing (dynamo_tpu/tracing): DYN_TRACE_* prefix
+    trace_enabled: bool = True
+    trace_sample: float = 1.0
+    trace_buffer: int = 4096
 
     @classmethod
     def from_env(cls, config_file: str | None = None) -> "RuntimeConfig":
@@ -52,4 +56,7 @@ class RuntimeConfig:
         cfg.system_port = _env("DYN_SYSTEM_PORT", cfg.system_port)
         cfg.logging_jsonl = _env("DYN_LOGGING_JSONL", cfg.logging_jsonl)
         cfg.log_level = _env("DYN_LOG_LEVEL", cfg.log_level)
+        cfg.trace_enabled = _env("DYN_TRACE_ENABLED", cfg.trace_enabled)
+        cfg.trace_sample = _env("DYN_TRACE_SAMPLE", cfg.trace_sample)
+        cfg.trace_buffer = _env("DYN_TRACE_BUFFER", cfg.trace_buffer)
         return cfg

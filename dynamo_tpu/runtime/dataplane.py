@@ -19,6 +19,16 @@ Frames (framing.py codec):
 
 Backpressure: response writes go through ``drain()``; a slow client
 throttles the producing engine naturally through TCP flow control.
+
+Header contract: the ``h`` map on a request frame carries per-request
+metadata end to end — at minimum ``x-request-id`` (log/trace correlation)
+and ``traceparent`` (W3C ``00-<32 hex trace id>-<16 hex span id>-01``).
+The server hands ``h`` to the handler as ``Context.headers`` untouched;
+dynamo_tpu/tracing parses ``traceparent`` there so spans recorded in the
+receiving process parent to the sender's span and the whole request
+stitches into one trace across disagg and migration hops. Intermediaries
+must forward both keys verbatim (mint a child traceparent only when
+starting a new span of their own).
 """
 
 from __future__ import annotations

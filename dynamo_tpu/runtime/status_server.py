@@ -1,9 +1,16 @@
-"""Per-worker system status server: /health, /live, /metrics.
+"""Per-worker system status server: /health, /live, /metrics, /traces.
 
 Capability parity: reference `lib/runtime/src/system_status_server.rs:31-712`
 (axum server per process; per-endpoint health states; uptime gauge;
 Prometheus text). Enabled through `DYN_SYSTEM_ENABLED` / `DYN_SYSTEM_PORT`
 (`config.rs` DYN_SYSTEM_* prefix).
+
+``/traces`` serves the process-local tracing ring buffer
+(dynamo_tpu/tracing) as JSON: recent traces with per-phase waterfalls.
+Spans recorded in *other* processes of the same deployment share trace
+ids (traceparent propagation over the dataplane), so an operator stitches
+a full request by querying each process's ``/traces`` for one trace id —
+or, in single-process/frontends, reads the whole waterfall in one place.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ import time
 
 from aiohttp import web
 
+from dynamo_tpu import tracing
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo_tpu.status")
@@ -35,7 +43,11 @@ class SystemStatusServer:
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.prometheus)
+        self.app.router.add_get("/traces", self.traces)
         self._runner: web.AppRunner | None = None
+        # Per-phase latency histograms ride this registry (scraped by the
+        # planner observer alongside the frontend series).
+        tracing.get_collector().bind_metrics(self.metrics)
 
     def set_endpoint_health(self, path: str, ready: bool) -> None:
         self.endpoint_health[path] = "ready" if ready else "notready"
@@ -77,3 +89,28 @@ class SystemStatusServer:
             self.uptime_s
         )
         return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        return web.json_response(render_traces(request))
+
+
+def render_traces(request: web.Request) -> dict:
+    """Shared ``/traces`` payload (status server + HTTP frontend):
+    ``?limit=N`` recent traces, ``?trace_id=...`` to pin one."""
+    collector = tracing.get_collector()
+    trace_id = request.query.get("trace_id")
+    if trace_id:
+        traces = collector.traces(trace_id=trace_id)
+    else:
+        try:
+            limit = max(1, min(200, int(request.query.get("limit", "20"))))
+        except ValueError:
+            limit = 20
+        traces = collector.traces(limit=limit)
+    return {
+        "enabled": tracing.trace_enabled(),
+        "buffered_spans": len(collector),
+        "stat_spans": len(collector.stats()),
+        "capacity": collector.capacity,
+        "traces": traces,
+    }

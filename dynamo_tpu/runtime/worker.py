@@ -40,6 +40,13 @@ def dynamo_worker(
 
 
 async def _run(fn: Callable[..., Awaitable[Any]], cfg: RuntimeConfig, *args, **kwargs) -> Any:
+    from dynamo_tpu import tracing
+
+    # Config-file overlays can differ from the env the tracing module
+    # read at import — re-apply the resolved values.
+    tracing.configure(
+        enabled=cfg.trace_enabled, sample=cfg.trace_sample, buffer=cfg.trace_buffer
+    )
     runtime = await DistributedRuntime.create(
         cfg.store_address, lease_ttl=cfg.lease_ttl_s, ingress_host=cfg.ingress_host
     )

@@ -1,0 +1,27 @@
+"""Seeded violations: every span here leaks open (never reaches the collector)."""
+
+from dynamo_tpu import tracing
+
+tracer = tracing.get_tracer("fixture")
+
+
+def bare_statement() -> None:
+    tracer.span("phase")                       # finding: result discarded
+
+
+def assigned_never_finished() -> None:
+    s = tracer.span("phase")                   # finding: no s.finish() in scope
+    s.set("k", 1)
+
+
+def direct_chain() -> None:
+    tracing.get_tracer("svc").span("phase")    # finding: get_tracer(...).span chain
+
+
+class Worker:
+    def __init__(self) -> None:
+        self._tracer = tracing.get_tracer("worker")
+
+    def handle(self) -> None:
+        span = self._tracer.span("handle")     # finding: attribute receiver, unfinished
+        span.set("k", 2)

@@ -1,0 +1,38 @@
+"""Negative cases: every span closes — with-statement or explicit finish()."""
+
+from dynamo_tpu import tracing
+
+tracer = tracing.get_tracer("fixture")
+
+
+def with_statement() -> None:
+    with tracer.span("phase") as s:
+        s.set("k", 1)
+
+
+def finished_on_every_path() -> None:
+    # Root-span shape (llm/http_service.py): bound to a name, closed in
+    # a finally so error paths still record.
+    root = tracer.span("http")
+    try:
+        root.set("k", 2)
+    finally:
+        root.finish()
+
+
+class Worker:
+    def __init__(self) -> None:
+        self._tracer = tracing.get_tracer("worker")
+
+    def handle(self) -> None:
+        with self._tracer.span("handle"):
+            pass
+
+
+class Row:
+    def span(self, width: int) -> int:
+        return width
+
+
+def not_a_tracer(row: Row) -> None:
+    row.span(3)  # unrelated .span() method on a non-tracer receiver

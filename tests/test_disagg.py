@@ -230,3 +230,44 @@ async def test_clear_kv_blocks_reaches_disagg_fleet():
             # Caches actually dropped on both engines.
             assert len(c.prefill_core.allocator._by_hash) == 0
             assert len(c.decode_core.allocator._by_hash) == 0
+
+
+async def test_disagg_prefill_and_decode_spans_share_root_trace():
+    """The tracing acceptance for disagg (ISSUE 2): spans recorded by the
+    prefill fleet (queued remote prefill) and by the decode worker stitch
+    into ONE trace under the frontend's root span — the traceparent rides
+    the dataplane headers and the prefill work-queue task."""
+    from dynamo_tpu import tracing
+
+    tracing.configure(enabled=True, sample=1.0)
+    async with DisaggCluster() as c:
+        tracing.get_collector().clear()
+        async with aiohttp.ClientSession() as s:
+            await _chat(s, c.base_url, LONG_PROMPT + " span stitch", max_tokens=4)
+
+        # Engine-side spans land when streams close; poll briefly.
+        trace = []
+        for _ in range(40):
+            spans = tracing.get_collector().spans()
+            roots = [sp for sp in spans if sp.name == "http"]
+            if roots:
+                tid = roots[-1].trace_id
+                trace = [sp for sp in spans if sp.trace_id == tid]
+                if {"prefill", "decode"} <= {sp.name for sp in trace}:
+                    break
+            await asyncio.sleep(0.05)
+
+        names = {sp.name for sp in trace}
+        assert {"http", "tokenize", "route", "disagg_decision", "prefill_handoff",
+                "prefill", "decode"} <= names, names
+        # The decision actually went remote, and both engine phases are in
+        # the SAME trace even though prefill ran on the other worker.
+        decision = next(sp for sp in trace if sp.name == "disagg_decision")
+        assert decision.attrs["remote"] is True
+        services = {sp.service for sp in trace}
+        assert {"frontend", "router", "disagg", "engine"} <= services, services
+        root = next(sp for sp in trace if sp.name == "http")
+        assert root.parent_id is None
+        for sp in trace:
+            assert sp.trace_id == root.trace_id
+        tracing.get_collector().clear()

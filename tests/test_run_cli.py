@@ -39,3 +39,46 @@ def test_text_mocker_oneshot():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "abcdef" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# --pp pre-validation (ISSUE 2 satellite): prefill buckets and model
+# divisibility are checked up front with CLI-pointed errors instead of a
+# late EngineCore construction failure.
+# ---------------------------------------------------------------------------
+
+
+def test_pp_prefill_buckets_trim_and_fallback():
+    from dynamo_tpu.backends.jax.main import _pp_prefill_buckets
+
+    # Already divisible: untouched.
+    assert _pp_prefill_buckets((32, 64, 128), 2, 8) == (32, 64, 128)
+    # Indivisible entries are trimmed the way dp trims decode widths.
+    assert _pp_prefill_buckets((33, 64), 2, 8) == (64,)
+    # Nothing survives: one synthesized bucket divisible by pp AND
+    # block_size (both EngineCore checks), near the largest requested.
+    assert _pp_prefill_buckets((33, 65), 2, 8) == (64,)
+    for b in _pp_prefill_buckets((7,), 4, 8):
+        assert b % 4 == 0 and b % 8 == 0
+
+
+def test_pp_rejects_indivisible_num_layers():
+    from dynamo_tpu.backends.jax.main import build_engine
+
+    with pytest.raises(ValueError, match="num_layers"):
+        build_engine("tiny", pp=3)  # tiny has 2 layers
+
+
+def test_pp_rejects_indivisible_vocab(monkeypatch):
+    import dataclasses
+
+    from dynamo_tpu import engine as eng
+    from dynamo_tpu.backends.jax.main import build_engine
+    from dynamo_tpu.engine.config import tiny_model
+
+    monkeypatch.setitem(
+        eng.PRESETS, "tiny-oddvocab",
+        lambda: dataclasses.replace(tiny_model(), num_layers=4, vocab_size=383),
+    )
+    with pytest.raises(ValueError, match="vocab_size"):
+        build_engine("tiny-oddvocab", pp=4)

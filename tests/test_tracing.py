@@ -1,0 +1,585 @@
+"""Distributed request tracing (dynamo_tpu/tracing): span model, ring
+buffer, W3C propagation, the disabled-tracer no-op bound, and the e2e
+stitched waterfall over the mocker-backed frontend.
+
+Acceptance (ISSUE 2): one request through the full stack yields a single
+trace containing at least {http, tokenize, route, prefill, decode} spans
+with monotonic, non-overlapping phase timestamps; with tracing disabled
+the same path records zero spans and a span call costs < 1 µs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import tracing
+from dynamo_tpu.runtime.logging_setup import (
+    TRACEPARENT_HEADER,
+    make_traceparent,
+    parse_traceparent,
+)
+
+pytestmark = [pytest.mark.pre_merge]
+
+
+@pytest.fixture(autouse=True)
+def clean_tracing():
+    """Tracing state is process-global: pin config and drain the buffer
+    around every test so cluster tests elsewhere can't bleed spans in."""
+    tracing.configure(enabled=True, sample=1.0, buffer=4096)
+    tracing.get_collector().clear()
+    tracing.get_collector()._metrics.clear()
+    yield
+    tracing.configure(enabled=True, sample=1.0, buffer=4096)
+    tracing.get_collector().clear()
+    tracing.get_collector()._metrics.clear()
+
+
+# ---------------------------------------------------------------------------
+# Span model + collector
+# ---------------------------------------------------------------------------
+
+
+def test_span_context_manager_records_duration_and_attrs():
+    tracer = tracing.get_tracer("unit")
+    with tracer.span("phase", attrs={"k": 1}) as s:
+        s.set("tokens", 7)
+        time.sleep(0.001)
+    spans = tracing.get_collector().spans()
+    assert len(spans) == 1
+    (rec,) = spans
+    assert rec.name == "phase" and rec.service == "unit"
+    assert rec.attrs == {"k": 1, "tokens": 7}
+    assert rec.end_s > rec.start_s
+    assert len(rec.trace_id) == 32 and len(rec.span_id) == 16
+    assert rec.parent_id is None  # root
+
+
+def test_span_finish_is_idempotent_and_exception_sets_error():
+    tracer = tracing.get_tracer("unit")
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom") as s:
+            raise RuntimeError("x")
+    s.finish()  # double-finish must not double-record
+    spans = tracing.get_collector().spans()
+    assert len(spans) == 1
+    assert spans[0].attrs["error"] == "RuntimeError"
+
+
+def test_explicit_parent_links_build_one_trace():
+    tracer = tracing.get_tracer("unit")
+    with tracer.span("root") as root:
+        with tracer.span("child", parent=root) as child:
+            pass
+    spans = {s.name: s for s in tracing.get_collector().spans()}
+    assert spans["child"].trace_id == spans["root"].trace_id
+    assert spans["child"].parent_id == spans["root"].span_id
+
+
+def test_ring_buffer_evicts_oldest():
+    tracing.configure(buffer=8)
+    tracer = tracing.get_tracer("unit")
+    for i in range(20):
+        with tracer.span(f"s{i}"):
+            pass
+    collector = tracing.get_collector()
+    assert len(collector) == collector.capacity == 8
+    assert [s.name for s in collector.spans()] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_record_files_retroactive_phase():
+    tracer = tracing.get_tracer("unit")
+    t0 = time.time() - 0.5
+    tracer.record("prefill", t0, t0 + 0.25, attrs={"tokens": 128})
+    (rec,) = tracing.get_collector().spans()
+    assert rec.start_s == t0
+    assert abs(rec.duration_s - 0.25) < 1e-9
+
+
+def test_traces_payload_groups_and_waterfalls():
+    tracer = tracing.get_tracer("unit")
+    with tracer.span("http") as root:
+        with tracer.span("tokenize", parent=root):
+            pass
+        with tracer.span("decode", parent=root):
+            pass
+    with tracer.span("other"):
+        pass
+    collector = tracing.get_collector()
+    payloads = collector.traces(limit=10)
+    assert len(payloads) == 2
+    assert payloads[0]["trace_id"] != payloads[1]["trace_id"]
+    pinned = collector.traces(trace_id=root.trace_id)
+    assert len(pinned) == 1
+    phases = [w["phase"] for w in pinned[0]["waterfall"]]
+    assert phases == ["http", "tokenize", "decode"]
+    for w in pinned[0]["waterfall"]:
+        assert w["offset_ms"] >= 0.0
+    assert tracing.phase_order(pinned[0]["spans"]) == phases
+
+
+# ---------------------------------------------------------------------------
+# Propagation + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_header_roundtrip_stitches_across_processes():
+    """inject_headers → extract over the dataplane header map produces
+    child spans in the same trace with correct parent links."""
+    frontend = tracing.get_tracer("frontend")
+    engine = tracing.get_tracer("engine")
+    with frontend.span("http") as root:
+        headers = {"x-request-id": "r-1"}
+        tracing.inject_headers(root, headers)
+        assert parse_traceparent(headers[TRACEPARENT_HEADER]) == (
+            root.trace_id,
+            root.span_id,
+        )
+        # "Other process": only the headers cross the wire.
+        with engine.span("prefill", headers=headers) as child:
+            pass
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert tracing.extract_context({}) is None
+    assert tracing.extract_context({"traceparent": "garbage"}) is None
+
+
+def test_noop_span_leaves_headers_untouched():
+    tracing.configure(enabled=False)
+    headers = {"x-request-id": "r-1"}
+    tracing.inject_headers(tracing.NOOP_SPAN, headers)
+    assert TRACEPARENT_HEADER not in headers
+
+
+def test_sampling_is_deterministic_on_trace_id():
+    """Every process keeps or drops the SAME traces: a span created from
+    a sampled-out parent context must also be dropped, with no
+    coordination beyond the trace id itself."""
+    tracing.configure(sample=0.5)
+    a = tracing.get_tracer("svc-a")
+    b = tracing.get_tracer("svc-b")
+    kept = dropped = 0
+    for _ in range(200):
+        root = a.span("root")
+        if root.recording:
+            kept += 1
+            headers = tracing.inject_headers(root, {})
+            child = b.span("child", headers=headers)
+            assert child.recording, "child of a kept trace must be kept"
+            child.finish()
+            root.finish()
+        else:
+            dropped += 1
+            # A sampled-out root propagates nothing; a child built from a
+            # made-up context with the same (unsampled) id also drops.
+    assert kept and dropped, f"0.5 sampling degenerate: kept={kept}"
+    tracing.configure(sample=0.0)
+    assert not a.span("x").recording
+    tracing.configure(sample=1.0)
+
+
+def test_sampled_out_parent_drops_children_too():
+    """A NOOP parent (sampled-out trace) must propagate the drop — a
+    child span minting a fresh trace would orphan-pollute /traces."""
+    tracer = tracing.get_tracer("unit")
+    tracing.configure(sample=0.0)
+    root = tracer.span("http")
+    tracing.configure(sample=1.0)  # children would now sample in...
+    child = tracer.span("tokenize", parent=root)
+    assert child is tracing.NOOP_SPAN  # ...but inherit the parent's drop
+    tracer.record("route", time.time() - 0.1, time.time(), parent=root)
+    child.finish()
+    root.finish()
+    assert len(tracing.get_collector()) == 0
+
+
+def test_stat_spans_stay_out_of_traces_but_feed_histograms():
+    """High-frequency step spans (stat=True) must not evict request spans
+    from the trace ring or show up as one-span traces in /traces."""
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    collector = tracing.get_collector()
+    collector.bind_metrics(registry)
+    tracer = tracing.get_tracer("engine")
+    with tracer.span("prefill"):
+        pass
+    t0 = time.time()
+    for _ in range(50):
+        tracer.record("engine_decode_step", t0, t0 + 0.001, stat=True)
+    assert len(collector) == 1  # request ring untouched
+    assert len(collector.stats()) == 50
+    assert len(collector.traces(limit=100)) == 1  # no step-span "traces"
+    text = registry.render().decode()
+    assert 'phase="engine_decode_step"' in text  # histograms still fed
+    collector.clear()
+    assert not collector.stats()
+
+
+def test_bound_registries_are_held_weakly():
+    """A dead service's registry must unbind itself — bind_metrics has no
+    explicit unbind, so liveness rides the weakref."""
+    import gc
+
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    collector = tracing.get_collector()
+    registry = MetricsRegistry()
+    collector.bind_metrics(registry)
+    assert len(collector._metrics) == 1
+    del registry
+    gc.collect()
+    tracer = tracing.get_tracer("unit")
+    tracer.record("phase", time.time() - 0.01, time.time())  # prunes dead refs
+    assert collector._metrics == []
+
+
+# ---------------------------------------------------------------------------
+# Disabled tracer: hard no-op, micro-benched
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_tracer_records_nothing():
+    tracing.configure(enabled=False)
+    tracer = tracing.get_tracer("unit")
+    with tracer.span("phase") as s:
+        s.set("k", 1)
+    tracer.record("phase", time.time() - 1, time.time())
+    assert s is tracing.NOOP_SPAN
+    assert s.context is None
+    assert len(tracing.get_collector()) == 0
+    assert not tracing.trace_enabled()
+
+
+def test_noop_span_call_is_under_one_microsecond():
+    """Acceptance bound: a disabled tracer's span() is one attribute
+    check + one return. Best-of-5 over 20k calls to shrug off CI noise."""
+    tracing.configure(enabled=False)
+    tracer = tracing.get_tracer("bench")
+    n = 20_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            # dynalint: allow-unclosed-span(disabled-tracer bench: span() returns the shared NOOP_SPAN)
+            tracer.span("phase")
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, f"no-op span call took {best * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------------------------------
+# Metrics + planner feed
+# ---------------------------------------------------------------------------
+
+
+def test_bound_registry_gets_per_phase_histograms():
+    from dynamo_tpu.planner.observer import parse_prometheus
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    collector = tracing.get_collector()
+    collector.bind_metrics(registry)
+    collector.bind_metrics(registry)  # idempotent
+    tracer = tracing.get_tracer("engine")
+    t0 = time.time()
+    tracer.record("prefill", t0 - 0.2, t0 - 0.1)
+    tracer.record("prefill", t0 - 0.1, t0)
+    tracer.record("decode", t0 - 0.1, t0)
+    text = registry.render().decode()
+    assert 'phase="prefill"' in text and 'phase="decode"' in text
+    totals = parse_prometheus(text)
+    base = "dynamo_trace_phase_duration_seconds"
+    assert totals[f"{base}_count{{prefill}}"] == 2
+    assert abs(totals[f"{base}_sum{{prefill}}"] - 0.2) < 1e-6
+    assert totals[f"{base}_count{{decode}}"] == 1
+
+
+async def test_observer_decomposes_ttft_by_phase():
+    from dynamo_tpu.planner.observer import MetricsObserver, parse_prometheus
+
+    def scrape_text(reqs, prefill_sum, prefill_n, route_sum, route_n):
+        return "\n".join([
+            f"dynamo_frontend_requests_total {reqs}",
+            'dynamo_trace_phase_duration_seconds_sum{service="engine",phase="prefill"} '
+            + str(prefill_sum),
+            'dynamo_trace_phase_duration_seconds_count{service="engine",phase="prefill"} '
+            + str(prefill_n),
+            'dynamo_trace_phase_duration_seconds_sum{phase="route",service="router"} '
+            + str(route_sum),
+            'dynamo_trace_phase_duration_seconds_count{phase="route",service="router"} '
+            + str(route_n),
+        ])
+
+    windows = [
+        scrape_text(10, 1.0, 10, 0.05, 10),
+        scrape_text(30, 5.0, 30, 0.25, 30),
+    ]
+
+    obs = MetricsObserver("http://unused")
+
+    async def fake_scrape():
+        return parse_prometheus(windows.pop(0))
+
+    obs._scrape = fake_scrape
+    first = await obs.observe()
+    assert first.phase_means is None  # no previous window yet
+    second = await obs.observe()
+    # Window delta: prefill (5.0-1.0)/(30-10)=0.2s, route 0.01s.
+    assert abs(second.phase_means["prefill"] - 0.2) < 1e-9
+    assert abs(second.phase_means["route"] - 0.01) < 1e-9
+
+
+def test_planner_prefers_measured_prefill_phase_over_total_ttft():
+    from dynamo_tpu.planner.planner_core import Observation, Planner, RecordingConnector
+
+    class PrefillInterp:
+        def ttft_at(self, isl):
+            return 0.1
+
+        def max_isl_within(self, s):
+            return 4096.0
+
+        def throughput_at(self, isl):
+            return 10_000.0
+
+    class DecodeInterp:
+        def max_concurrency_within(self, s):
+            return 8.0
+
+        def itl_at(self, c):
+            return 0.01
+
+        def throughput_at(self, c):
+            return 10_000.0
+
+    def plan_with(obs):
+        p = Planner(PrefillInterp(), DecodeInterp(), RecordingConnector())
+        p._update_corrections(obs)
+        return p.correction_prefill
+
+    # Totals say TTFT is 4x the profile — but the tracer shows prefill
+    # itself is on-profile (the regression is upstream: route/queue).
+    decomposed = Observation(
+        request_rate=1.0, mean_isl=256.0, mean_osl=64.0,
+        observed_ttft_s=0.4, phase_means={"prefill": 0.1, "route": 0.28},
+    )
+    totals_only = Observation(
+        request_rate=1.0, mean_isl=256.0, mean_osl=64.0, observed_ttft_s=0.4,
+    )
+    assert plan_with(decomposed) == pytest.approx(1.0)
+    assert plan_with(totals_only) == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------------
+# Frontend satellites: client x-request-id adoption
+# ---------------------------------------------------------------------------
+
+
+def test_inbound_request_id_sanitized_and_length_capped():
+    from types import SimpleNamespace
+
+    from dynamo_tpu.llm.http_service import HttpService
+
+    class Req:
+        def __init__(self, headers):
+            self.headers = headers
+
+    svc = SimpleNamespace(_inflight_rids=set())
+
+    def rid_for(headers):
+        return HttpService._request_id(svc, Req(headers), "chat")
+
+    assert rid_for({"x-request-id": "client-abc.123:7"}) == "client-abc.123:7"
+    # Malformed / oversized / missing values get a freshly minted id.
+    for bad in ("", "x" * 129, "sp ace", "new\nline", "emoji-⚡", "a;b"):
+        minted = rid_for({"x-request-id": bad})
+        assert minted != bad and minted.startswith("chat-")
+    # A duplicate id while the first request is still in flight gets a
+    # fresh mint (engine queues / KV pulls are keyed by request id);
+    # after release the client id is adoptable again.
+    dup = rid_for({"x-request-id": "client-abc.123:7"})
+    assert dup != "client-abc.123:7" and dup.startswith("chat-")
+    HttpService._release_request_id(svc, "client-abc.123:7")
+    assert rid_for({"x-request-id": "client-abc.123:7"}) == "client-abc.123:7"
+
+
+# ---------------------------------------------------------------------------
+# Migration: one request id / trace id across replayed attempts
+# ---------------------------------------------------------------------------
+
+
+async def test_migrated_stream_keeps_one_trace_across_attempts():
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.llm.protocols.common import (
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    class FlakyClient:
+        """First worker dies mid-stream; the retry lands on worker 2."""
+
+        def pick_instance(self, mode, exclude):
+            return 2 if 1 in exclude else 1
+
+        async def direct(self, worker_id, payload, headers=None):
+            async def stream():
+                yield LLMEngineOutput(token_ids=[100]).to_wire()
+                if worker_id == 1:
+                    raise ConnectionError("conn reset")
+                yield LLMEngineOutput(token_ids=[101], finish_reason="stop").to_wire()
+
+            return stream()
+
+    parent = make_traceparent()
+    trace_id = parse_traceparent(parent)[0]
+    m = Migration(client=FlakyClient(), push_router=None, mode="round_robin", limit=2)
+    pre = PreprocessedRequest(
+        model="t", token_ids=[1, 2, 3], request_id="req-1",
+        sampling=SamplingOptions(), stop=StopConditions(max_tokens=8),
+    )
+    out = [
+        o async for o in m.generate(pre, headers={TRACEPARENT_HEADER: parent})
+    ]
+    assert [t for o in out for t in o.token_ids] == [100, 100, 101]
+
+    attempts = [
+        s for s in tracing.get_collector().spans() if s.name == "migration_attempt"
+    ]
+    assert [s.attrs["outcome"] for s in attempts] == ["failed", "completed"]
+    # ONE request id and ONE trace id across the replayed attempt.
+    assert {s.attrs["request_id"] for s in attempts} == {"req-1"}
+    assert {s.trace_id for s in attempts} == {trace_id}
+    assert attempts[1].attrs["attempt"] == 1
+    assert attempts[1].attrs["replayed_tokens"] == 1  # token 100 replayed
+
+
+async def test_unmigrated_stream_records_no_attempt_spans():
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.llm.protocols.common import (
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    class HealthyClient:
+        def pick_instance(self, mode, exclude):
+            return 1
+
+        async def direct(self, worker_id, payload, headers=None):
+            async def stream():
+                yield LLMEngineOutput(token_ids=[7], finish_reason="stop").to_wire()
+
+            return stream()
+
+    m = Migration(client=HealthyClient(), push_router=None, mode="round_robin")
+    pre = PreprocessedRequest(
+        model="t", token_ids=[1], request_id="req-2",
+        sampling=SamplingOptions(), stop=StopConditions(max_tokens=4),
+    )
+    assert [o async for o in m.generate(pre)]
+    names = [s.name for s in tracing.get_collector().spans()]
+    assert "migration_attempt" not in names  # fast path stays span-free
+
+
+# ---------------------------------------------------------------------------
+# E2E: mocker-backed frontend → /traces stitched waterfall
+# ---------------------------------------------------------------------------
+
+REQUIRED_PHASES = ("http", "tokenize", "route", "prefill", "decode")
+
+
+async def _one_chat(base_url: str, rid: str | None = None) -> dict:
+    body = {
+        "model": "mock",
+        "messages": [{"role": "user", "content": "trace this request end to end"}],
+        "max_tokens": 8,
+        "stream": False,
+    }
+    headers = {"x-request-id": rid} if rid else {}
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{base_url}/v1/chat/completions", json=body, headers=headers
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+
+@pytest.mark.e2e
+async def test_e2e_traces_endpoint_serves_stitched_waterfall():
+    from tests.test_e2e_frontend import Cluster
+
+    async with Cluster(num_workers=1) as cluster:
+        tracing.get_collector().clear()
+        resp = await _one_chat(cluster.base_url, rid="client-rid-1")
+        assert resp["id"] == "client-rid-1"  # inbound x-request-id honored
+
+        # The engine-side spans are filed in the stream's finally block,
+        # which can land a beat after the HTTP response — poll briefly.
+        target = None
+        for _ in range(40):
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{cluster.base_url}/traces?limit=50") as r:
+                    assert r.status == 200
+                    payload = await r.json()
+            assert payload["enabled"] is True
+            for trace in payload["traces"]:
+                spans = {sp["name"]: sp for sp in trace["spans"]}
+                if (
+                    spans.get("http", {}).get("attrs", {}).get("request_id")
+                    == "client-rid-1"
+                    and all(p in spans for p in REQUIRED_PHASES)
+                ):
+                    target = trace
+                    break
+            if target:
+                break
+            await asyncio.sleep(0.05)
+        assert target is not None, f"no stitched trace for request: {payload}"
+
+        spans = {sp["name"]: sp for sp in target["spans"]}
+        for phase in REQUIRED_PHASES:
+            assert phase in spans, f"missing {phase!r}: {sorted(spans)}"
+        # One stitched trace: every phase shares the root's trace id, and
+        # the cross-process phases parent back to the frontend root.
+        assert {sp["trace_id"] for sp in spans.values()} == {target["trace_id"]}
+        root = spans["http"]
+        assert root["parent_id"] is None
+        for phase in ("tokenize", "route", "prefill", "decode"):
+            assert spans[phase]["parent_id"] == root["span_id"], phase
+
+        # Monotonic, non-overlapping phase sequence inside the root.
+        seq = [spans[p] for p in ("tokenize", "route", "prefill", "decode")]
+        for prev, cur in zip(seq, seq[1:]):
+            assert cur["start_s"] >= prev["end_s"] - 1e-6, (
+                f"{cur['name']} overlaps {prev['name']}"
+            )
+            assert cur["end_s"] >= cur["start_s"]
+        assert root["start_s"] <= seq[0]["start_s"]
+        assert root["end_s"] >= seq[-1]["end_s"] - 1e-6
+        assert spans["decode"]["attrs"]["tokens"] >= 1
+        assert spans["prefill"]["attrs"]["prompt_tokens"] >= 1
+
+        # The waterfall view mirrors span order with root-relative offsets.
+        phases_in_waterfall = [w["phase"] for w in target["waterfall"]]
+        assert phases_in_waterfall[0] == "http"
+        assert all(w["offset_ms"] >= 0 for w in target["waterfall"])
+
+        # Disabled tracer: the SAME path records zero spans.
+        tracing.configure(enabled=False)
+        try:
+            tracing.get_collector().clear()
+            await _one_chat(cluster.base_url)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{cluster.base_url}/traces") as r:
+                    off = await r.json()
+            assert off["enabled"] is False
+            assert off["buffered_spans"] == 0 and off["traces"] == []
+        finally:
+            tracing.configure(enabled=True)

@@ -15,6 +15,7 @@ RULE_BLOCKING_IN_ASYNC = "blocking-in-async"
 RULE_BROAD_EXCEPT = "broad-except"
 RULE_LOCK_DISCIPLINE = "lock-discipline"
 RULE_JAX_PITFALL = "jax-pitfall"
+RULE_UNCLOSED_SPAN = "unclosed-span"
 
 ALL_RULES = (
     RULE_FIRE_AND_FORGET,
@@ -22,6 +23,7 @@ ALL_RULES = (
     RULE_BROAD_EXCEPT,
     RULE_LOCK_DISCIPLINE,
     RULE_JAX_PITFALL,
+    RULE_UNCLOSED_SPAN,
 )
 
 # ---------------------------------------------------------------------------
@@ -111,6 +113,18 @@ SIGNAL_REGISTRARS = {"signal.signal", "loop.add_signal_handler"}
 
 # Call/decorator names that enter a traced context.
 JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "shard_map", "jax.shard_map"}
+
+# ---------------------------------------------------------------------------
+# unclosed-span: receivers whose `.span(...)` result must be closed.
+# A dotted receiver matching one of these suffixes (tracer, self._tracer,
+# disagg.tracer, ...) — or a direct `get_tracer(...).span(...)` chain — is
+# treated as a dynamo_tpu.tracing Tracer. The span must be used as a
+# context manager, or be bound to a name that is `.finish()`ed in the same
+# scope; anything else leaks an open span (it never reaches the collector,
+# and its phase silently vanishes from the waterfall).
+# ---------------------------------------------------------------------------
+
+TRACER_RECEIVER_SUFFIXES = ("tracer",)
 
 # ---------------------------------------------------------------------------
 # File selection.

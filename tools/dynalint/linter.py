@@ -160,6 +160,7 @@ class _FileLinter(ast.NodeVisitor):
     def run(self) -> list[Finding]:
         self._prescan()
         self.visit(self.tree)
+        self._check_unclosed_spans()
         return self.findings
 
     def _prescan(self) -> None:
@@ -534,6 +535,79 @@ class _FileLinter(ast.NodeVisitor):
                             "function happens at trace time only — the jitted "
                             "executable will never update it again",
                         )
+
+    # -- rule 6: unclosed spans -------------------------------------------
+
+    def _check_unclosed_spans(self) -> None:
+        """A ``tracer.span(...)`` must be used as a context manager, or be
+        bound to a name that is ``.finish()``ed in the same scope. An open
+        span never reaches the collector — its phase silently vanishes
+        from every waterfall."""
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and self._is_span_call(node):
+                self._classify_span_use(node, parents)
+
+    @staticmethod
+    def _is_span_call(call: ast.Call) -> bool:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "span"):
+            return False
+        d = dotted_name(func.value)
+        if d is not None:
+            return d.lower().endswith(C.TRACER_RECEIVER_SUFFIXES)
+        # Direct chain: get_tracer("svc").span(...)
+        if isinstance(func.value, ast.Call):
+            g = dotted_name(func.value.func)
+            return g is not None and g.rsplit(".", 1)[-1] == "get_tracer"
+        return False
+
+    def _classify_span_use(
+        self, call: ast.Call, parents: dict[ast.AST, ast.AST]
+    ) -> None:
+        parent = parents.get(call)
+        # `with tracer.span(...) as s:` — the blessed form.
+        if isinstance(parent, ast.withitem) and parent.context_expr is call:
+            return
+        # `s = tracer.span(...)` escapes the with-shape only if `s.finish()`
+        # is called somewhere in the same scope (e.g. a root span closed in
+        # a `finally`).
+        if (
+            isinstance(parent, ast.Assign)
+            and parent.value is call
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)
+        ):
+            name = parent.targets[0].id
+            scope: ast.AST | None = parent
+            while scope is not None and not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+            ):
+                scope = parents.get(scope)
+            for sub in ast.walk(scope or self.tree):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "finish"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == name
+                ):
+                    return
+            self.report(
+                call, C.RULE_UNCLOSED_SPAN,
+                f"span bound to {name!r} is never finished: use "
+                "`with tracer.span(...) as ...:` or call "
+                f"`{name}.finish()` on every exit path",
+            )
+            return
+        self.report(
+            call, C.RULE_UNCLOSED_SPAN,
+            "span result is not used as a context manager (and not bound "
+            "to a finished name): the span never reaches the collector",
+        )
 
 
 # ---------------------------------------------------------------------------

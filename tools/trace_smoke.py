@@ -1,0 +1,148 @@
+"""Trace smoke: boot a mocker-backed frontend, send one request, serve /traces.
+
+CI usage (`.github/workflows/ci.yml` trace-smoke step):
+
+    python tools/trace_smoke.py --url-file /tmp/smoke_url --hold &
+    # ... wait for the url file, then:
+    curl -sf "$(cat /tmp/smoke_url)/traces" | python tools/trace_smoke.py --verify-stdin
+
+Local one-shot (boots, requests, self-checks /traces, exits):
+
+    python tools/trace_smoke.py
+
+The verify step asserts the stitched-waterfall contract: one trace
+containing at least {http, tokenize, route, prefill, decode} spans that
+all share the root's trace id.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+REQUIRED_PHASES = ("http", "tokenize", "route", "prefill", "decode")
+
+
+def verify_payload(payload: dict) -> str:
+    """Assert the /traces contract; returns the stitched trace id."""
+    assert payload.get("enabled"), "tracing reported disabled"
+    for trace in payload.get("traces", []):
+        spans = {sp["name"]: sp for sp in trace["spans"]}
+        if all(p in spans for p in REQUIRED_PHASES):
+            tids = {sp["trace_id"] for sp in trace["spans"]}
+            assert tids == {trace["trace_id"]}, f"unstitched trace ids: {tids}"
+            return trace["trace_id"]
+    raise AssertionError(
+        "no trace with phases "
+        f"{REQUIRED_PHASES}: {[list({s['name'] for s in t['spans']}) for t in payload.get('traces', [])]}"
+    )
+
+
+async def run(url_file: str | None, hold: bool) -> None:
+    import aiohttp
+
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    served = asyncio.Event()
+    worker = asyncio.create_task(
+        run_mocker(
+            worker_rt,
+            model_name="mock",
+            engine_args=MockEngineArgs(
+                num_kv_blocks=2048, block_size=8, speedup_ratio=200.0
+            ),
+            served_event=served,
+        )
+    )
+    await asyncio.wait_for(served.wait(), 30)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+        body = {
+            "model": "mock",
+            "messages": [{"role": "user", "content": "trace smoke request"}],
+            "max_tokens": 4,
+            "stream": False,
+        }
+        async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+            assert r.status == 200, await r.text()
+
+        if url_file:
+            await asyncio.to_thread(Path(url_file).write_text, base)
+        print(f"trace-smoke frontend up at {base}", flush=True)
+
+        if hold:
+            # Serve until killed (CI curls /traces from the shell).
+            await asyncio.Event().wait()
+        else:
+            # One-shot self-check (engine spans land when streams close).
+            payload = None
+            for _ in range(40):
+                async with s.get(f"{base}/traces?limit=20") as r:
+                    assert r.status == 200
+                    payload = await r.json()
+                try:
+                    tid = verify_payload(payload)
+                    print(f"stitched trace OK: {tid}")
+                    break
+                except AssertionError:
+                    await asyncio.sleep(0.05)
+            else:
+                verify_payload(payload)  # raise with the real diagnostic
+
+    for rt in (worker_rt, front_rt):
+        rt.signal_shutdown()
+    for t in (worker, frontend):
+        t.cancel()
+    await store.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url-file", help="write the frontend base url here once ready")
+    ap.add_argument(
+        "--hold", action="store_true",
+        help="keep serving after the smoke request (CI curls from outside)",
+    )
+    ap.add_argument(
+        "--verify-stdin", action="store_true",
+        help="read a /traces JSON payload from stdin and assert the contract",
+    )
+    args = ap.parse_args(argv)
+    if args.verify_stdin:
+        tid = verify_payload(json.load(sys.stdin))
+        print(f"stitched trace OK: {tid}")
+        return 0
+    asyncio.run(run(args.url_file, args.hold))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
