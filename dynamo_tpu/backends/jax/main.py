@@ -527,6 +527,13 @@ async def run_jax_worker(
     )
     await metrics_pub.start()
 
+    # Scheduler gauges on this worker's /metrics (queue depth, budget
+    # utilization, chunked prefills in flight, preemptions) — evaluated
+    # at scrape time against the live core.
+    from dynamo_tpu.runtime.status_server import bind_scheduler_gauges
+
+    bind_scheduler_gauges(runtime.status, core.scheduler_stats)
+
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
     encode_client = await (
@@ -1107,6 +1114,23 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=None)
     ap.add_argument("--max-num-seqs", type=int, default=None)
     ap.add_argument("--max-model-len", type=int, default=None)
+    ap.add_argument(
+        "--scheduling", default=None, choices=["waves", "chunked"],
+        help="step scheduler: 'waves' = monolithic prefill waves before "
+             "decode (default); 'chunked' = mixed prefill-chunk + decode "
+             "steps under a per-step token budget (cuts saturated TTFT "
+             "and decode stalls)",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=None,
+        help="prompt chunk size for --scheduling chunked (block-aligned; "
+             "0/unset = auto from the prefill buckets)",
+    )
+    ap.add_argument(
+        "--max-num-batched-tokens", type=int, default=None,
+        help="per-step token budget for mixed prefill+decode steps "
+             "(0/unset = the largest prefill bucket)",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
@@ -1167,6 +1191,9 @@ def main() -> None:
             "max_num_seqs": args.max_num_seqs,
             "max_model_len": args.max_model_len,
             "ring_prefill_threshold": args.ring_prefill_threshold,
+            "scheduling": args.scheduling,
+            "prefill_chunk": args.prefill_chunk,
+            "max_num_batched_tokens": args.max_num_batched_tokens,
         }.items()
         if v is not None
     }

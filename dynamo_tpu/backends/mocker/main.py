@@ -57,6 +57,12 @@ async def run_mocker(
     )
     await metrics_pub.start()
 
+    # Same scheduler gauges as the real worker (mock fleets exercise the
+    # scheduling policy CPU-only; dashboards see identical series).
+    from dynamo_tpu.runtime.status_server import bind_scheduler_gauges
+
+    bind_scheduler_gauges(runtime.status, engine.scheduler_stats)
+
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
     async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
@@ -95,6 +101,13 @@ def main() -> None:
     ap.add_argument("--max-num-seqs", type=int, default=256)
     ap.add_argument("--speedup-ratio", type=float, default=1.0)
     ap.add_argument("--context-length", type=int, default=16384)
+    ap.add_argument("--scheduling", default="chunked",
+                    choices=["waves", "chunked"],
+                    help="mixed prefill-chunk+decode steps (chunked) or "
+                         "monolithic prefill-priority waves")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="per-step prompt chunk cap (0 = budget-bound)")
+    ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
     args = ap.parse_args()
 
     engine_args = MockEngineArgs(
@@ -102,6 +115,9 @@ def main() -> None:
         block_size=args.block_size,
         max_num_seqs=args.max_num_seqs,
         speedup_ratio=args.speedup_ratio,
+        scheduling=args.scheduling,
+        prefill_chunk=args.prefill_chunk,
+        max_num_batched_tokens=args.max_num_batched_tokens,
     )
 
     @dynamo_worker()

@@ -142,6 +142,26 @@ class EngineConfig:
     # the engine's sp mesh instead of chunked paged waves. 0 = off.
     ring_prefill_threshold: int = 0
 
+    # -- scheduling policy (admission shaping, PERF.md r5) ------------------
+    # "waves": monolithic prefill waves run strictly before decode (the
+    #   classic prefill-priority scheduler — every in-flight decode stalls
+    #   for a whole wave when a prompt arrives).
+    # "chunked": each step is assembled from all runnable decode sequences
+    #   (q_len=1 rows) plus prefill CHUNKS of waiting prompts, under a
+    #   shared max_num_batched_tokens budget — long prompts stream through
+    #   several steps instead of monopolizing one, so decodes keep
+    #   emitting and new arrivals stop queueing behind whole waves.
+    scheduling: str = "waves"
+    # Chunk size for streaming a long prompt under chunked scheduling
+    # (block-aligned; non-final chunks split at block boundaries so both
+    # schedulers commit identical block layouts). 0 = auto: the largest
+    # prefill bucket <= max_num_batched_tokens // 4, floored at the
+    # smallest bucket.
+    prefill_chunk: int = 0
+    # Per-step batched-token budget for mixed prefill+decode steps (each
+    # decode row costs 1 token). 0 = the largest prefill bucket.
+    max_num_batched_tokens: int = 0
+
     # Disaggregation: a remote-decode prefill's held blocks are released
     # if no decode worker pulls them within this window (a decode-side
     # timeout would otherwise pin them forever). 0 = never expire.
@@ -150,6 +170,20 @@ class EngineConfig:
     @property
     def max_blocks_per_seq(self) -> int:
         return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    @property
+    def token_budget(self) -> int:
+        """Resolved per-step batched-token budget (chunked scheduling)."""
+        return self.max_num_batched_tokens or self.prefill_buckets[-1]
+
+    @property
+    def chunk_size(self) -> int:
+        """Resolved prefill chunk size (block-aligned by validation)."""
+        if self.prefill_chunk:
+            return self.prefill_chunk
+        target = max(self.token_budget // 4, self.prefill_buckets[0])
+        fitting = [b for b in self.prefill_buckets if b <= target]
+        return fitting[-1] if fitting else self.prefill_buckets[0]
 
     @property
     def total_slots(self) -> int:

@@ -95,6 +95,9 @@ class Sequence:
     # positions ([n_total, h] f32) and their [start, count] spans.
     mm_embeds: Any = None
     mm_positions: list | None = None
+    # -- scheduling attribution (sched_admit span endpoints) --
+    t_queued: float = 0.0       # wall-clock at enqueue into the scheduler
+    t_first_sched: float = 0.0  # first chunk dispatched to the device
 
     @property
     def prompt_len(self) -> int:
@@ -103,6 +106,15 @@ class Sequence:
     @property
     def prefill_done(self) -> bool:
         return self.prefilled >= self.prompt_len
+
+    @property
+    def num_computed_tokens(self) -> int:
+        """Chunked-prefill cursor: tokens whose K/V is written (cached
+        prefix + prompt chunks run so far + generated tokens) — the
+        vLLM-vocabulary alias of ``processed``; carries prefill progress
+        across mixed steps so a long prompt streams instead of
+        monopolizing one."""
+        return self.processed
 
 
 def _check_fuse_tp(params, tp: int) -> None:
@@ -399,6 +411,44 @@ class EngineCore:
         for b in engine_cfg.prefill_buckets:
             if b % bs:
                 raise ValueError(f"prefill bucket {b} not a multiple of block_size {bs}")
+        if engine_cfg.scheduling not in ("waves", "chunked"):
+            raise ValueError(
+                f"unknown scheduling policy {engine_cfg.scheduling!r} "
+                "(expected 'waves' or 'chunked')"
+            )
+        self._sched_chunked = engine_cfg.scheduling == "chunked"
+        if engine_cfg.prefill_chunk and engine_cfg.prefill_chunk % bs:
+            raise ValueError(
+                f"prefill_chunk {engine_cfg.prefill_chunk} not a multiple "
+                f"of block_size {bs} (chunk boundaries must respect block "
+                "granularity so both schedulers commit identical layouts)"
+            )
+        if engine_cfg.max_num_batched_tokens > engine_cfg.prefill_buckets[-1]:
+            raise ValueError(
+                f"max_num_batched_tokens {engine_cfg.max_num_batched_tokens} "
+                f"exceeds the largest prefill bucket "
+                f"{engine_cfg.prefill_buckets[-1]} (mixed steps bucket their "
+                "total tokens)"
+            )
+        if engine_cfg.prefill_chunk > engine_cfg.token_budget:
+            raise ValueError(
+                f"prefill_chunk {engine_cfg.prefill_chunk} exceeds the "
+                f"per-step token budget {engine_cfg.token_budget}"
+            )
+        if self._sched_chunked and (
+            engine_cfg.token_budget < engine_cfg.decode_buckets[-1] + bs
+        ):
+            raise ValueError(
+                f"max_num_batched_tokens {engine_cfg.token_budget} cannot fit "
+                f"the decode width {engine_cfg.decode_buckets[-1]} plus one "
+                f"{bs}-token prefill chunk; raise the budget or shrink "
+                "decode_buckets"
+            )
+        if self._sched_chunked and (pp_mesh is not None or sp_mesh is not None):
+            raise ValueError(
+                "scheduling='chunked' is not wired for pp/sp meshes yet; "
+                "those engines keep 'waves'"
+            )
         self.cfg = model_cfg
         self.engine = engine_cfg
         self.eos_token_ids = set(eos_token_ids)
@@ -613,6 +663,10 @@ class EngineCore:
         # token counts). record() on a disabled tracer is a no-op, and the
         # collector's deque.append is atomic — safe from the engine thread.
         self._tracer = tracing.get_tracer("engine")
+        # Queue-wait stat spans live under their own service so the
+        # request-waterfall sched_admit twin (TpuEngine, service
+        # "engine") doesn't double-count the histogram series.
+        self._sched_tracer = tracing.get_tracer("sched")
         self._req_counter = 0
         self._lock = threading.Lock()
         # Serializes step() against cross-thread cache surgery
@@ -634,6 +688,16 @@ class EngineCore:
         # prefill blocks forever. Touched by the transfer endpoints, swept
         # at the top of each step (before admission needs the blocks).
         self._held_deadline: dict[str, float] = {}
+        # Scheduler observability (status-server gauges + bench
+        # attribution): the chunked-vs-waves decision needs visible queue
+        # depth, per-step budget utilization, and preemption counts.
+        self.sched_stats = {
+            "preemptions": 0,
+            "mixed_steps": 0,
+            "last_step_batched_tokens": 0,
+            "last_step_budget_utilization": 0.0,
+            "chunked_prefills_in_flight": 0,
+        }
 
         self._prefill = jax.jit(
             partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
@@ -746,6 +810,7 @@ class EngineCore:
                 )
             seq.mm_embeds = embeds
             seq.mm_positions = positions
+        seq.t_queued = time.time()
         self._enqueue(seq)
         return seq
 
@@ -777,6 +842,28 @@ class EngineCore:
             if b >= n:
                 return b
         return self.engine.decode_buckets[-1]
+
+    def _mark_first_sched(self, seq: Sequence, now: float) -> None:
+        """First chunk of this sequence is being dispatched: close the
+        admit→first-chunk-start window as a ``sched_admit`` stat span
+        (queue-wait attribution — bench and the /metrics histograms read
+        it). Recorded under service "sched", NOT "engine": TpuEngine
+        files a request-waterfall twin under "engine" with the dataplane
+        headers, and sharing a (service, phase) key would double-observe
+        every request in the phase-duration histogram."""
+        if seq.t_first_sched:
+            return
+        seq.t_first_sched = now
+        if seq.t_queued:
+            self._sched_tracer.record(
+                "sched_admit", seq.t_queued, now,
+                attrs={
+                    "request_id": seq.request_id,
+                    "prompt_tokens": seq.prompt_len,
+                    "cached_tokens": seq.num_cached_tokens,
+                },
+                stat=True,
+            )
 
     def _admit(self) -> None:
         while self._inbox:
@@ -886,27 +973,21 @@ class EngineCore:
             seq.pinned_hashes.append(blk.block_hash)
             seq.committed_blocks += 1
 
-    def _run_prefill_wave(self, seqs: list[Sequence]):
-        """One ragged dispatch prefills up to ``prefill_batch`` sequences
-        under a shared token budget (largest prefill bucket) — different
-        chunk lengths pack into one token buffer with no per-lane padding.
-        First-token sampling is fused into the same program; returns
-        [(seq, chunk, sampled_or_None)] with the sampled token for every
-        sequence that completed its prompt this wave."""
-        S = self.engine.prefill_batch
+    def _dispatch_ragged(
+        self, rows: list[tuple[Sequence, list[int], int, int]], S: int
+    ):
+        """Assemble and run ONE ragged forward + fused sampling over
+        arbitrary rows. Each row is ``(seq, tokens, pos_start, kv_len)``:
+        a prefill chunk (tokens sliced from the prompt) or a decode row
+        (the single pending token at position ``processed``). Prefill
+        waves and chunked mixed steps both funnel here — mixed batches are
+        exactly what the unified ragged forward was built for (a decode
+        row is q_len=1). Programs compile per (token bucket, S,
+        sampling-variant); S is the caller's static row width. Returns
+        host-side (sampled [S], logprob arrays or None)."""
         P = self.engine.max_blocks_per_seq
         bs = self.engine.block_size
-        budget = self.engine.prefill_buckets[-1]
-        chosen: list[tuple[Sequence, int]] = []
-        total = 0
-        for seq in seqs:
-            if len(chosen) == S or total >= budget:
-                break
-            chunk = min(seq.prompt_len - seq.prefilled, budget - total)
-            if chunk <= 0:
-                continue
-            chosen.append((seq, chunk))
-            total += chunk
+        total = sum(len(tl) for _, tl, _, _ in rows)
         T = self._bucket_for(total)
 
         tokens = np.zeros(T, np.int32)
@@ -924,14 +1005,15 @@ class EngineCore:
         top_p = np.ones(S, np.float32)
 
         t = 0
-        for i, (seq, chunk) in enumerate(chosen):
-            pos = np.arange(seq.prefilled, seq.prefilled + chunk, dtype=np.int32)
-            tokens[t : t + chunk] = seq.prompt[seq.prefilled : seq.prefilled + chunk]
+        for i, (seq, toks_list, pos0, kv_len) in enumerate(rows):
+            chunk = len(toks_list)
+            pos = np.arange(pos0, pos0 + chunk, dtype=np.int32)
+            tokens[t : t + chunk] = toks_list
             positions[t : t + chunk] = pos
             ids = np.asarray(seq.block_ids, np.int32)
             write_pages[t : t + chunk] = ids[pos // bs]
             write_offs[t : t + chunk] = pos % bs
-            kv_lens[i] = seq.prefilled + chunk
+            kv_lens[i] = kv_len
             tables[i, : len(ids)] = ids
             last_rows[i] = t + chunk - 1
             seeds[i] = seq.seed
@@ -940,25 +1022,27 @@ class EngineCore:
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
             t += chunk
-        cu[1 : len(chosen) + 1] = np.cumsum([c for _, c in chosen])
-        cu[len(chosen) + 1 :] = cu[len(chosen)]
+        cu[1 : len(rows) + 1] = np.cumsum([len(tl) for _, tl, _, _ in rows])
+        cu[len(rows) + 1 :] = cu[len(rows)]
         need_mask = any(
-            s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s, _ in chosen
+            s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s, _, _, _ in rows
         )
-        want_lp = any(s.logprobs is not None for s, _ in chosen)
-        all_greedy = all(s.sampling.temperature == 0.0 for s, _ in chosen)
+        want_lp = any(s.logprobs is not None for s, _, _, _ in rows)
+        all_greedy = all(s.sampling.temperature == 0.0 for s, _, _, _ in rows)
 
         # Multimodal splice (separate compiled variant): override rows
         # whose prompt position falls inside an image span with the
-        # encoder's embedding for that patch.
-        want_mm = any(s.mm_embeds is not None for s, _ in chosen)
+        # encoder's embedding for that patch. Decode rows sit past the
+        # prompt, so the span check never selects them.
+        want_mm = any(s.mm_embeds is not None for s, _, _, _ in rows)
         if want_mm:
             mm_embeds = np.zeros((T, self.cfg.hidden_size), np.float32)
             mm_mask = np.zeros(T, bool)
             t0 = 0
-            for seq, chunk in chosen:
+            for seq, toks_list, pos0, _ in rows:
+                chunk = len(toks_list)
                 if seq.mm_embeds is not None:
-                    lo, hi = seq.prefilled, seq.prefilled + chunk
+                    lo, hi = pos0, pos0 + chunk
                     row = 0
                     for start, cnt in seq.mm_positions:
                         for j in range(cnt):
@@ -979,7 +1063,7 @@ class EngineCore:
 
             plan = plan_microbatches(
                 tokens, positions, write_pages, write_offs, kv_lens, cu,
-                len(chosen), last_rows, self._pp_micro,
+                len(rows), last_rows, self._pp_micro,
                 self.engine.garbage_block,
             )
             toks, lps, self.cache = self._prefill_pp(
@@ -992,7 +1076,7 @@ class EngineCore:
                 jnp.asarray(plan.kv_lens),
                 jnp.asarray(tables),
                 jnp.asarray(plan.cu_q_lens),
-                jnp.asarray(np.array([len(chosen)], np.int32)),
+                jnp.asarray(np.array([len(rows)], np.int32)),
                 jnp.asarray(plan.last_local),
                 jnp.asarray(plan.last_mask),
                 jnp.asarray(seeds),
@@ -1015,7 +1099,7 @@ class EngineCore:
                 jnp.asarray(kv_lens),
                 jnp.asarray(tables),
                 jnp.asarray(cu),
-                jnp.asarray(np.array([len(chosen)], np.int32)),
+                jnp.asarray(np.array([len(rows)], np.int32)),
                 jnp.asarray(last_rows),
                 jnp.asarray(seeds),
                 jnp.asarray(counters),
@@ -1031,20 +1115,80 @@ class EngineCore:
             )
         toks = fetch_replicated(toks)
         lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
+        return toks, lps
+
+    def _run_prefill_wave(self, seqs: list[Sequence]):
+        """One ragged dispatch prefills up to ``prefill_batch`` sequences
+        under a shared token budget (largest prefill bucket) — different
+        chunk lengths pack into one token buffer with no per-lane padding.
+        First-token sampling is fused into the same program; returns
+        [(seq, chunk, sampled_or_None)] with the sampled token for every
+        sequence that completed its prompt this wave."""
+        S = self.engine.prefill_batch
+        budget = self.engine.prefill_buckets[-1]
+        chosen: list[tuple[Sequence, int]] = []
+        total = 0
+        for seq in seqs:
+            if len(chosen) == S or total >= budget:
+                break
+            chunk = min(seq.prompt_len - seq.prefilled, budget - total)
+            if chunk <= 0:
+                continue
+            chosen.append((seq, chunk))
+            total += chunk
+        t_disp = time.time()
+        rows: list[tuple[Sequence, list[int], int, int]] = []
+        for seq, chunk in chosen:
+            self._mark_first_sched(seq, t_disp)
+            rows.append((
+                seq,
+                seq.prompt[seq.prefilled : seq.prefilled + chunk],
+                seq.prefilled,
+                seq.prefilled + chunk,
+            ))
+        toks, lps = self._dispatch_ragged(rows, S)
 
         out = []
+        now = time.time()
         for i, (seq, chunk) in enumerate(chosen):
-            completed = seq.hashed.extend(
-                seq.prompt[seq.prefilled : seq.prefilled + chunk]
+            tok, lp = self._advance_prefill_chunk(
+                seq, chunk, toks, lps, i, t_disp, now
             )
-            self._commit_completed(seq, completed)
-            seq.prefilled += chunk
-            seq.processed = seq.prefilled
-            lp = None
-            if seq.prefill_done and lps is not None and seq.logprobs is not None:
-                lp = _lp_entry(int(toks[i]), lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
-            out.append((seq, chunk, int(toks[i]) if seq.prefill_done else None, lp))
+            out.append((seq, chunk, tok, lp))
         return out
+
+    def _advance_prefill_chunk(
+        self, seq: Sequence, chunk: int, toks, lps, i: int,
+        t0: float, now: float,
+    ) -> tuple[int | None, dict | None]:
+        """Commit one prefill chunk's bookkeeping — block commits, cursor
+        advance, per-chunk trace span. ONE implementation shared by the
+        wave and mixed steps so the identical-block-layout and
+        greedy-parity guarantees cannot diverge between schedulers.
+        Returns (sampled_token, lp_entry); the token is real only when
+        this chunk completes the prompt (the ragged program samples every
+        row's last-token logits, but mid-prompt samples are noise)."""
+        completed = seq.hashed.extend(
+            seq.prompt[seq.prefilled : seq.prefilled + chunk]
+        )
+        self._commit_completed(seq, completed)
+        seq.prefilled += chunk
+        seq.processed = seq.prefilled
+        self._tracer.record(
+            "engine_prefill_chunk", t0, now,
+            attrs={
+                "request_id": seq.request_id, "tokens": chunk,
+                "prefilled": seq.prefilled,
+                "prompt_tokens": seq.prompt_len,
+            },
+            stat=True,
+        )
+        if not seq.prefill_done:
+            return None, None
+        lp = None
+        if lps is not None and seq.logprobs is not None:
+            lp = _lp_entry(int(toks[i]), lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
+        return int(toks[i]), lp
 
     def _maybe_ring_prefill(self, prefills: list[Sequence]):
         """Dispatch one eligible long prompt to the sequence-parallel ring
@@ -1072,6 +1216,7 @@ class EngineCore:
         return None
 
     def _run_ring_prefill(self, seq: Sequence, T: int):
+        self._mark_first_sched(seq, time.time())
         bs = self.engine.block_size
         P_len = seq.prompt_len
         tokens = np.zeros(T, np.int32)
@@ -1121,6 +1266,30 @@ class EngineCore:
             self._finish(seq)
         return [(seq, out)]
 
+    def _grow_or_preempt(
+        self, decoding: list[Sequence], n_tokens: int
+    ) -> list[Sequence]:
+        """Ensure every decode lane has blocks for its next ``n_tokens``
+        writes, preempting the youngest neighbor under pressure. Shared by
+        the fused-chain decode step (n_tokens = chain length) and the
+        mixed chunked step (n_tokens = 1) so the two schedulers' victim
+        selection can never diverge."""
+        ready: list[Sequence] = []
+        for seq in decoding:
+            if seq not in self.running:
+                continue  # preempted by an earlier lane in this loop
+            if self._grow_blocks(seq, n_tokens):
+                ready.append(seq)
+                continue
+            victim = next((s for s in reversed(self.running) if s is not seq), None)
+            if victim is not None:
+                self._preempt(victim)
+                if victim in ready:
+                    ready.remove(victim)
+                if self._grow_blocks(seq, n_tokens):
+                    ready.append(seq)
+        return ready
+
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
         """Ensure physical blocks exist for the next ``n_tokens`` decode
         writes (positions processed .. processed+n_tokens-1)."""
@@ -1138,16 +1307,23 @@ class EngineCore:
         return True
 
     def _preempt(self, seq: Sequence) -> None:
-        """Token-replay preemption: free everything, re-prefill later."""
+        """Token-replay preemption: free everything, re-prefill later.
+
+        A mid-prefill (chunked-scheduling) victim keeps its ORIGINAL
+        prompt — its hashed view covers only the chunks already run, and
+        replacing the prompt with that truncated prefix would silently
+        drop the unprefilled tail. Its committed chunks re-match through
+        the prefix cache at re-admission."""
         log.info("preempting %s (generated=%d)", seq.request_id, seq.generated)
+        self.sched_stats["preemptions"] += 1
         self._release_blocks(seq)
-        new_prompt = seq.hashed.all_tokens()
-        if seq.pending is not None:
-            new_prompt.append(seq.pending)
-        seq.prompt = new_prompt
+        if seq.prefill_done:
+            new_prompt = seq.hashed.all_tokens()
+            if seq.pending is not None:
+                new_prompt.append(seq.pending)
+            seq.prompt = new_prompt
         seq.pending = None
         seq.block_ids = []
-        seq.pinned_hashes = []
         seq.committed_blocks = 0
         seq.prefilled = seq.processed = 0
         seq.hashed = None
@@ -1155,10 +1331,17 @@ class EngineCore:
         self.waiting.appendleft(seq)
 
     def _release_blocks(self, seq: Sequence) -> None:
+        """Release a sequence's block refs EXACTLY once: uncommitted
+        partials back to the free list, pinned hashes unpinned. Clearing
+        ``pinned_hashes`` makes a second call a no-op — a half-prefilled
+        sequence hit by both preemption and a cancel/hold sweep must not
+        decrement refcounts twice (that frees blocks other sequences
+        still pin)."""
         for bid in seq.block_ids[seq.committed_blocks :]:
             self.allocator.free_partial(bid)
         self.allocator.release(seq.pinned_hashes)
         seq.block_ids = seq.block_ids[: seq.committed_blocks]
+        seq.pinned_hashes = []
 
     def _run_decode(self, seqs: list[Sequence], n_steps: int) -> Any:
         B = self._decode_width(len(seqs))
@@ -1220,7 +1403,6 @@ class EngineCore:
             return self._step_locked()
 
     def _step_locked(self) -> list[tuple[Sequence, LLMEngineOutput]]:
-        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
         self.iterations += 1
         self._sweep_expired_holds()
 
@@ -1230,6 +1412,19 @@ class EngineCore:
 
         self._admit()
 
+        if self._sched_chunked:
+            prefills = [s for s in self.running if not s.prefill_done]
+            if prefills:
+                return self._step_mixed(prefills)
+            # No prefill work: pure decode rides the fused chains — chunked
+            # scheduling only reshapes steps that mix the two phases.
+            return self._step_decode([])
+        return self._step_waves()
+
+    def _step_waves(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """Prefill-priority scheduling: one monolithic prefill wave
+        strictly before any decode (the classic vLLM-default shape)."""
+        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
         prefills = [s for s in self.running if not s.prefill_done]
         if prefills:
             t_wave = time.time()
@@ -1259,25 +1454,17 @@ class EngineCore:
                 stat=True,
             )
             return outputs
+        return self._step_decode(outputs)
 
+    def _step_decode(
+        self, outputs: list[tuple[Sequence, LLMEngineOutput]]
+    ) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """One fused decode+sample chain over every runnable sequence."""
         decoding = [s for s in self.running if s.pending is not None]
         if not decoding:
             return outputs
         n_steps = self._chain_length(decoding)
-        ready: list[Sequence] = []
-        for seq in decoding:
-            if seq not in self.running:
-                continue  # preempted by an earlier seq in this loop
-            if self._grow_blocks(seq, n_steps):
-                ready.append(seq)
-                continue
-            victim = next((s for s in reversed(self.running) if s is not seq), None)
-            if victim is not None:
-                self._preempt(victim)
-                if victim in ready:
-                    ready.remove(victim)
-                if self._grow_blocks(seq, n_steps):
-                    ready.append(seq)
+        ready = self._grow_or_preempt(decoding, n_steps)
         if not ready:
             return outputs
 
@@ -1315,6 +1502,124 @@ class EngineCore:
         self._tracer.record(
             "engine_decode_step", t_decode, time.time(),
             attrs={"seqs": len(ready), "chain": n_steps, "tokens": emitted_total},
+            stat=True,
+        )
+        return outputs
+
+    def _step_mixed(
+        self, prefills: list[Sequence]
+    ) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """One chunked-scheduling step: every runnable decode sequence
+        rides as a q_len=1 row NEXT TO prefill chunks in the same ragged
+        program, under the ``max_num_batched_tokens`` budget. A long
+        prompt streams through ceil(P/chunk) steps while in-flight
+        decodes keep emitting one token per step — prefill waves no
+        longer stall decodes, and new arrivals stop queueing behind whole
+        waves (PERF.md r5: saturated TTFT is admission shaping, not a
+        kernel gap)."""
+        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+        t_step = time.time()
+        budget = self.engine.token_budget
+        chunk_cap = self.engine.chunk_size
+        bs = self.engine.block_size
+        S_max = self.engine.decode_buckets[-1]
+
+        decoding = [
+            s for s in self.running if s.prefill_done and s.pending is not None
+        ]
+        # Reserve one row + headroom for a prefill chunk so a full decode
+        # batch can never starve admission; rotate which decode lanes sit
+        # out so no single stream stalls repeatedly.
+        cap = min(S_max - 1, budget - 1)
+        if len(decoding) > cap:
+            off = self.iterations % len(decoding)
+            decoding = (decoding + decoding)[off : off + cap]
+        # Block growth first (a preemption re-queues its victim — possibly
+        # a mid-prefill one, which keeps its full prompt; see _preempt).
+        ready = self._grow_or_preempt(decoding, 1)
+
+        rows: list[tuple[Sequence, list[int], int, int]] = []
+        kinds: list[str] = []
+        total = 0
+        for seq in ready:
+            cursor = seq.num_computed_tokens
+            rows.append((seq, [seq.pending], cursor, cursor + 1))
+            kinds.append("d")
+            total += 1
+        n_decode = len(rows)
+        for seq in prefills:
+            if seq not in self.running:
+                continue  # preempted above
+            if len(rows) >= S_max:
+                break
+            room = min(budget - total, chunk_cap)
+            if room <= 0:
+                break
+            remaining = seq.prompt_len - seq.num_computed_tokens
+            chunk = min(remaining, room)
+            if chunk < remaining:
+                # Non-final chunks split on block boundaries so both
+                # schedulers commit identical block layouts (disagg
+                # export/import and prefix-cache hashes line up).
+                chunk -= chunk % bs
+                if chunk <= 0:
+                    continue
+            self._mark_first_sched(seq, t_step)
+            rows.append((
+                seq,
+                seq.prompt[seq.prefilled : seq.prefilled + chunk],
+                seq.prefilled,
+                seq.prefilled + chunk,
+            ))
+            kinds.append("p")
+            total += chunk
+        if not rows:
+            return outputs
+
+        toks, lps = self._dispatch_ragged(rows, self._decode_width(len(rows)))
+        now = time.time()
+        for i, ((seq, toks_list, _pos0, _kv), kind) in enumerate(zip(rows, kinds)):
+            if kind == "d":
+                # The row wrote the pending token's K/V and sampled the
+                # next token — the 1-step unrolling of the decode chain's
+                # bookkeeping.
+                completed = seq.hashed.extend([seq.pending])
+                self._commit_completed(seq, completed)
+                seq.processed += 1
+                seq.generated += 1
+                tok = int(toks[i])
+                lp = None
+                if lps is not None and seq.logprobs is not None:
+                    lp = _lp_entry(tok, lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
+                outputs.append((seq, self._emit(seq, tok, lp)))
+                if seq.finish is not None:
+                    self._finish(seq)
+                else:
+                    seq.pending = tok
+                continue
+            tok, lp = self._advance_prefill_chunk(
+                seq, len(toks_list), toks, lps, i, t_step, now
+            )
+            if tok is not None:  # this chunk completed the prompt
+                seq.pending = tok
+                seq.generated += 1
+                outputs.append((seq, self._emit(seq, tok, lp)))
+                if seq.finish is not None:
+                    self._finish(seq)
+
+        st = self.sched_stats
+        st["mixed_steps"] += 1
+        st["last_step_batched_tokens"] = total
+        st["last_step_budget_utilization"] = total / budget if budget else 0.0
+        st["chunked_prefills_in_flight"] = sum(
+            1 for s in self.running if not s.prefill_done and s.t_first_sched
+        )
+        self._tracer.record(
+            "engine_mixed_step", t_step, now,
+            attrs={
+                "seqs": len(rows), "decode_rows": n_decode,
+                "prefill_tokens": total - n_decode, "budget": budget,
+            },
             stat=True,
         )
         return outputs
@@ -1830,6 +2135,17 @@ class EngineCore:
             return len(self.allocator.clear_cache())
 
     # -- observability -----------------------------------------------------
+
+    def scheduler_stats(self) -> dict:
+        """Point-in-time scheduler gauges (status-server /metrics export):
+        queue depth, last mixed-step token-budget utilization, chunked
+        prefills in flight, preemption count."""
+        st = dict(self.sched_stats)
+        st["waiting"] = len(self.waiting) + len(self._inbox)
+        st["running"] = len(self.running)
+        st["chunked_scheduling"] = 1 if self._sched_chunked else 0
+        st["token_budget"] = self.engine.token_budget
+        return st
 
     def metrics(self) -> ForwardPassMetrics:
         alloc = self.allocator

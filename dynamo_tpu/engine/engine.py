@@ -89,6 +89,19 @@ class TpuEngine:
             # decode covers the rest of the stream. Parented through the
             # dataplane headers so spans stitch under the frontend root.
             if t_first:
+                if seq.t_first_sched:
+                    # Queue-wait attribution: submit -> first chunk
+                    # dispatched (the sched_admit window). Nested inside
+                    # the prefill phase, so the /traces waterfall shows
+                    # queue-wait vs compute directly.
+                    self._tracer.record(
+                        "sched_admit", t_submit, seq.t_first_sched,
+                        headers=context.headers,
+                        attrs={
+                            "request_id": seq.request_id,
+                            "prompt_tokens": seq.prompt_len,
+                        },
+                    )
                 self._tracer.record(
                     "prefill", t_submit, t_first, headers=context.headers,
                     attrs={

@@ -42,6 +42,15 @@ class MockEngineArgs:
     watermark: float = 0.01
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
+    # Step scheduler, mirroring EngineConfig.scheduling: "chunked" mixes
+    # prefill chunks with decode rows under max_num_batched_tokens (the
+    # mocker's historical shape); "waves" runs monolithic prefill
+    # iterations strictly before decode — in-flight decodes stall while
+    # any prompt prefills, like the real engine's wave scheduler.
+    scheduling: str = "chunked"
+    # Chunk cap for streaming one prompt per mixed step; 0 = budget-bound
+    # only (mirrors EngineConfig.prefill_chunk).
+    prefill_chunk: int = 0
     speedup_ratio: float = 1.0
     # Cost model (pre-speedup): iteration = base + prefill_tokens*prefill
     #                            + decoding_seqs*decode
@@ -69,6 +78,7 @@ class _Seq:
     # are emitted retroactively when the stream closes so the sim loop's
     # hot path only ever stamps a float.
     t_submit: float = 0.0
+    t_first_sched: float = 0.0   # first prefill chunk entered a step
     t_prefill_done: float = 0.0
     t_last_token: float = 0.0
 
@@ -89,6 +99,11 @@ class MockTpuEngine:
         eos_token_ids: tuple[int, ...] = (),
     ):
         self.args = args or MockEngineArgs()
+        if self.args.scheduling not in ("waves", "chunked"):
+            raise ValueError(
+                f"unknown scheduling policy {self.args.scheduling!r} "
+                "(expected 'waves' or 'chunked')"
+            )
         self.eos_token_ids = set(eos_token_ids)
         self.kv = kv_manager or MockKvManager(
             num_blocks=self.args.num_kv_blocks,
@@ -101,6 +116,24 @@ class MockTpuEngine:
         self._loop_task: asyncio.Task | None = None
         self._iterations = 0
         self._tracer = tracing.get_tracer("engine")
+        # Queue-wait stat spans under their own service (the waterfall
+        # sched_admit twin in _trace_phases is service "engine"; sharing
+        # the key would double-observe the histogram — same split as
+        # EngineCore._mark_first_sched).
+        self._sched_tracer = tracing.get_tracer("sched")
+        # Scheduler gauges, mirroring EngineCore.sched_stats (the status
+        # server exports the same series for real and mock workers).
+        # The mocker never truly preempts (release + re-queue) — a decode
+        # blocked on allocation just stalls one iteration — so stalls are
+        # counted separately, not as preemptions.
+        self.sched_stats = {
+            "preemptions": 0,
+            "decode_stalls": 0,
+            "mixed_steps": 0,
+            "last_step_batched_tokens": 0,
+            "last_step_budget_utilization": 0.0,
+            "chunked_prefills_in_flight": 0,
+        }
 
     # -- public engine surface --------------------------------------------
 
@@ -160,6 +193,16 @@ class MockTpuEngine:
         sim loop stamped; parented through the dataplane headers so they
         stitch under the frontend's root span."""
         headers = context.headers
+        if seq.t_first_sched:
+            # Queue-wait attribution (admit -> first chunk), mirroring the
+            # real engine's sched_admit span.
+            self._tracer.record(
+                "sched_admit", seq.t_submit, seq.t_first_sched, headers=headers,
+                attrs={
+                    "request_id": seq.request_id,
+                    "prompt_tokens": len(seq.prompt),
+                },
+            )
         if seq.t_prefill_done:
             self._tracer.record(
                 "prefill", seq.t_submit, seq.t_prefill_done, headers=headers,
@@ -174,6 +217,16 @@ class MockTpuEngine:
                 "decode", seq.t_prefill_done, seq.t_last_token, headers=headers,
                 attrs={"request_id": seq.request_id, "tokens": seq.generated},
             )
+
+    def scheduler_stats(self) -> dict:
+        """Point-in-time scheduler gauges (status-server /metrics export);
+        same keys as EngineCore.scheduler_stats."""
+        st = dict(self.sched_stats)
+        st["waiting"] = len(self._waiting)
+        st["running"] = len(self._running)
+        st["chunked_scheduling"] = 1 if self.args.scheduling == "chunked" else 0
+        st["token_budget"] = self.args.max_num_batched_tokens
+        return st
 
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
@@ -242,12 +295,39 @@ class MockTpuEngine:
             seq.partials_held = need
             seq.prefilled = cached * self.args.block_size
             if seq.prefill_done:  # fully prefix-cached: no prefill phase
-                seq.t_prefill_done = time.time()
+                self._mark_first_sched(seq)
+                seq.t_prefill_done = seq.t_first_sched
             self._running.append(seq)
 
+    def _mark_first_sched(self, seq: _Seq) -> None:
+        """Close the admit→first-schedule window as a sched_admit stat
+        span (cache hits included — the queue-wait histogram must cover
+        the fast cohort too, mirroring EngineCore._mark_first_sched)."""
+        if seq.t_first_sched:
+            return
+        seq.t_first_sched = time.time()
+        self._sched_tracer.record(
+            "sched_admit", seq.t_submit, seq.t_first_sched,
+            attrs={
+                "request_id": seq.request_id,
+                "prompt_tokens": len(seq.prompt),
+            },
+            stat=True,
+        )
+
     def _step(self) -> tuple[int, int]:
-        """One engine iteration; returns (prefill tokens, decoding seqs)."""
+        """One engine iteration; returns (prefill tokens, decoding seqs).
+
+        scheduling='chunked': prefill chunks (capped at prefill_chunk) and
+        decode rows share the max_num_batched_tokens budget in the same
+        iteration. scheduling='waves': while ANY prompt is prefilling,
+        the iteration is prefill-only (monolithic, budget-bound) and every
+        in-flight decode stalls — the real engine's wave scheduler."""
         budget = self.args.max_num_batched_tokens
+        chunk_cap = self.args.prefill_chunk or budget
+        prefill_only = self.args.scheduling == "waves" and any(
+            not s.prefill_done and not s.cancelled for s in self._running
+        )
         prefill_tokens = 0
         decode_seqs = 0
         finished: list[_Seq] = []
@@ -260,8 +340,11 @@ class MockTpuEngine:
                 if not self.args.enable_chunked_prefill and prefill_tokens:
                     continue  # one prefill at a time without chunking
                 chunk = min(len(seq.prompt) - seq.prefilled, budget - prefill_tokens)
+                if not prefill_only:
+                    chunk = min(chunk, chunk_cap)  # chunked: stream the prompt
                 if chunk <= 0:
                     continue
+                self._mark_first_sched(seq)
                 start_block = seq.prefilled // self.args.block_size
                 seq.prefilled += chunk
                 prefill_tokens += chunk
@@ -275,6 +358,8 @@ class MockTpuEngine:
                 if seq.prefill_done:
                     seq.t_prefill_done = time.time()
                 continue
+            if prefill_only:
+                continue  # waves: decodes stall for the whole wave
 
             # Decode: one token per iteration.
             decode_seqs += 1
@@ -286,6 +371,7 @@ class MockTpuEngine:
                     seq.partials_held += 1
                 except InsufficientBlocksError:
                     decode_seqs -= 1
+                    self.sched_stats["decode_stalls"] += 1
                     continue  # stalled this iteration (preemption-lite)
             completed = seq.seq.append(token)
             if completed is not None:
@@ -313,6 +399,16 @@ class MockTpuEngine:
         for seq in finished:
             self._running.remove(seq)
             self._finish(seq, emit=True)
+        st = self.sched_stats
+        if prefill_tokens and decode_seqs:
+            st["mixed_steps"] += 1
+        st["last_step_batched_tokens"] = prefill_tokens + decode_seqs
+        st["last_step_budget_utilization"] = (
+            (prefill_tokens + decode_seqs) / budget if budget else 0.0
+        )
+        st["chunked_prefills_in_flight"] = sum(
+            1 for s in self._running if not s.prefill_done and s.t_first_sched
+        )
         return prefill_tokens, decode_seqs
 
     def _check_stop(self, seq: _Seq, token: int) -> str | None:

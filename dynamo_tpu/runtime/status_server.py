@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import time
+from typing import Callable
 
 from aiohttp import web
 
@@ -24,6 +25,64 @@ from dynamo_tpu import tracing
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo_tpu.status")
+
+# Scheduler gauge export: stats-dict key -> (metric name, doc). Shared by
+# the real engine and the mocker (both expose scheduler_stats() dicts with
+# these keys), so every worker's /metrics carries the same series.
+SCHEDULER_GAUGES: dict[str, tuple[str, str]] = {
+    "waiting": (
+        "scheduler_waiting_seqs",
+        "Sequences queued for admission (inbox + waiting)",
+    ),
+    "running": (
+        "scheduler_running_seqs",
+        "Sequences admitted and running",
+    ),
+    "preemptions": (
+        "scheduler_preemptions_total",
+        "Sequences preempted (released + re-queued) since start",
+    ),
+    "decode_stalls": (
+        "scheduler_decode_stalls_total",
+        "Decode iterations skipped waiting on a free block (mocker's "
+        "preemption-lite; always 0 on the real engine, which preempts)",
+    ),
+    "last_step_batched_tokens": (
+        "scheduler_last_step_batched_tokens",
+        "Tokens batched into the most recent mixed step",
+    ),
+    "last_step_budget_utilization": (
+        "scheduler_token_budget_utilization",
+        "Most recent mixed step's batched tokens / max_num_batched_tokens",
+    ),
+    "chunked_prefills_in_flight": (
+        "scheduler_chunked_prefills_in_flight",
+        "Sequences mid-prefill (first chunk run, prompt not finished)",
+    ),
+    "chunked_scheduling": (
+        "scheduler_chunked_enabled",
+        "1 when the chunked token-budget scheduler is active",
+    ),
+    "token_budget": (
+        "scheduler_token_budget",
+        "Resolved per-step batched-token budget",
+    ),
+}
+
+
+def bind_scheduler_gauges(
+    status: "SystemStatusServer | None", scheduler_stats: Callable[[], dict]
+) -> None:
+    """Export a worker's scheduler gauges on its status-server /metrics,
+    evaluated at scrape time (prometheus set_function — no polling task).
+    No-op when the status server is disabled."""
+    if status is None:
+        return
+    scoped = status.metrics.scoped(service="engine")
+    for key, (name, doc) in SCHEDULER_GAUGES.items():
+        scoped.gauge(name, doc).set_function(
+            lambda k=key: float(scheduler_stats().get(k, 0) or 0)
+        )
 
 
 class SystemStatusServer:
