@@ -527,12 +527,16 @@ async def run_jax_worker(
     )
     await metrics_pub.start()
 
-    # Scheduler gauges on this worker's /metrics (queue depth, budget
-    # utilization, chunked prefills in flight, preemptions) — evaluated
-    # at scrape time against the live core.
-    from dynamo_tpu.runtime.status_server import bind_scheduler_gauges
+    # Scheduler + speculation gauges on this worker's /metrics (queue
+    # depth, budget utilization, acceptance rate, ...) — evaluated at
+    # scrape time against the live core.
+    from dynamo_tpu.runtime.status_server import (
+        bind_scheduler_gauges,
+        bind_spec_gauges,
+    )
 
     bind_scheduler_gauges(runtime.status, core.scheduler_stats)
+    bind_spec_gauges(runtime.status, core.spec_decode_stats)
 
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
@@ -1131,6 +1135,17 @@ def main() -> None:
         help="per-step token budget for mixed prefill+decode steps "
              "(0/unset = the largest prefill bucket)",
     )
+    ap.add_argument(
+        "--spec-decode", default=None, choices=["off", "ngram"],
+        help="speculative decoding: 'ngram' drafts via prompt-lookup and "
+             "batch-verifies pending+draft as one ragged row (greedy and "
+             "seeded-sampling output stay bit-identical to 'off')",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=None,
+        help="max draft tokens per verify step (also clamps per-request "
+             "dyn.spec_decode k)",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
@@ -1194,6 +1209,8 @@ def main() -> None:
             "scheduling": args.scheduling,
             "prefill_chunk": args.prefill_chunk,
             "max_num_batched_tokens": args.max_num_batched_tokens,
+            "spec_decode": args.spec_decode,
+            "spec_k": args.spec_k,
         }.items()
         if v is not None
     }

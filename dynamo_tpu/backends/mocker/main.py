@@ -57,11 +57,15 @@ async def run_mocker(
     )
     await metrics_pub.start()
 
-    # Same scheduler gauges as the real worker (mock fleets exercise the
-    # scheduling policy CPU-only; dashboards see identical series).
-    from dynamo_tpu.runtime.status_server import bind_scheduler_gauges
+    # Same scheduler + speculation gauges as the real worker (mock fleets
+    # exercise the policies CPU-only; dashboards see identical series).
+    from dynamo_tpu.runtime.status_server import (
+        bind_scheduler_gauges,
+        bind_spec_gauges,
+    )
 
     bind_scheduler_gauges(runtime.status, engine.scheduler_stats)
+    bind_spec_gauges(runtime.status, engine.spec_decode_stats)
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
@@ -108,6 +112,15 @@ def main() -> None:
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="per-step prompt chunk cap (0 = budget-bound)")
     ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
+    ap.add_argument("--spec-decode", default="off", choices=["off", "ngram"],
+                    help="simulate speculative decoding: decode rows emit "
+                         "1 + accepted tokens per step at "
+                         "--spec-acceptance-rate (stream stays bit-"
+                         "identical to off)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per verify step")
+    ap.add_argument("--spec-acceptance-rate", type=float, default=0.6,
+                    help="per-draft-token acceptance probability")
     args = ap.parse_args()
 
     engine_args = MockEngineArgs(
@@ -118,6 +131,9 @@ def main() -> None:
         scheduling=args.scheduling,
         prefill_chunk=args.prefill_chunk,
         max_num_batched_tokens=args.max_num_batched_tokens,
+        spec_decode=args.spec_decode,
+        spec_k=args.spec_k,
+        spec_acceptance_rate=args.spec_acceptance_rate,
     )
 
     @dynamo_worker()

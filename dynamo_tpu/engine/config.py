@@ -167,6 +167,23 @@ class EngineConfig:
     # timeout would otherwise pin them forever). 0 = never expire.
     held_block_ttl_s: float = 180.0
 
+    # -- speculative decoding (dynamo_tpu/spec) -----------------------------
+    # "off": every decode row is q_len=1. "ngram": decode rows draft up to
+    #   spec_k tokens via prompt-lookup and verify pending+draft as ONE
+    #   q_len<=spec_k+1 ragged row; accepted tokens emit in one step.
+    #   Output is bit-identical to spec off (greedy AND seeded sampling) —
+    #   verification replays the target's own per-lane counter-keyed
+    #   choices. Requests may override per-call via dyn.spec_decode.
+    spec_decode: str = "off"
+    # Max draft tokens per verify step; also the clamp for per-request k
+    # (the verify program's sample-gather width is static: spec_k + 1).
+    spec_k: int = 4
+    # Prompt-lookup suffix lengths tried (longest first) and the history
+    # window searched.
+    spec_ngram_min: int = 1
+    spec_ngram_max: int = 3
+    spec_window: int = 1024
+
     @property
     def max_blocks_per_seq(self) -> int:
         return (self.max_model_len + self.block_size - 1) // self.block_size

@@ -51,6 +51,7 @@ from dynamo_tpu.engine.model import (
 )
 from dynamo_tpu.engine.sampler import LOGPROBS_K, sample, token_logprobs
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.spec import SpecConfig, SpecStats, propose_ngram, resolve_spec_config
 from dynamo_tpu.parallel.multihost import fetch_replicated
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
@@ -98,6 +99,13 @@ class Sequence:
     # -- scheduling attribution (sched_admit span endpoints) --
     t_queued: float = 0.0       # wall-clock at enqueue into the scheduler
     t_first_sched: float = 0.0  # first chunk dispatched to the device
+    # -- speculative decoding (dynamo_tpu/spec) --
+    # Resolved policy (SpecConfig) or None; set once at admission from the
+    # engine default + the request's spec_decode override.
+    spec: SpecConfig | None = None
+    # Every emitted token, in order (the drafter's lookup history beyond
+    # the prompt; cleared on preemption — the rebuilt prompt absorbs it).
+    out_tokens: list[int] = field(default_factory=list)
 
     @property
     def prompt_len(self) -> int:
@@ -449,6 +457,36 @@ class EngineCore:
                 "scheduling='chunked' is not wired for pp/sp meshes yet; "
                 "those engines keep 'waves'"
             )
+        if engine_cfg.spec_decode not in ("off", "ngram"):
+            raise ValueError(
+                f"unknown spec_decode {engine_cfg.spec_decode!r} "
+                "(expected 'off' or 'ngram')"
+            )
+        if engine_cfg.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {engine_cfg.spec_k}")
+        if engine_cfg.spec_decode != "off" and pp_mesh is not None:
+            raise ValueError(
+                "speculative decoding under pipeline parallelism is not "
+                "wired yet (the pp microbatch planner samples one row per "
+                "sequence); run spec on a tp/dp or single-chip engine"
+            )
+        # Verify-row sample width: STATIC per engine so the compiled
+        # program set stays O(buckets x widths x variants), not O(draft
+        # lengths). Rows with shorter drafts pad the sample gather with
+        # duplicate reads.
+        self._spec_R = engine_cfg.spec_k + 1
+        self._spec_default = (
+            SpecConfig(
+                method=engine_cfg.spec_decode,
+                k=engine_cfg.spec_k,
+                ngram_min=engine_cfg.spec_ngram_min,
+                ngram_max=engine_cfg.spec_ngram_max,
+                window=engine_cfg.spec_window,
+            )
+            if engine_cfg.spec_decode != "off"
+            else None
+        )
+        self.spec_stats = SpecStats()
         self.cfg = model_cfg
         self.engine = engine_cfg
         self.eos_token_ids = set(eos_token_ids)
@@ -783,6 +821,18 @@ class EngineCore:
             )
         if (pre.kv_transfer_params or {}).get("do_remote_decode"):
             seq.hold_blocks = True
+        # Per-request speculation: the request's spec_decode dict overrides
+        # the engine default (method "off" disables; k clamps to the
+        # engine's static spec_k). Bad configs reject HERE, not at the
+        # first verify step.
+        seq.spec = resolve_spec_config(
+            self._spec_default, pre.spec_decode, self.engine.spec_k
+        )
+        if seq.spec is not None and self.pp_mesh is not None:
+            raise ValueError(
+                "speculative decoding under pipeline parallelism is not "
+                "wired yet (route spec requests to a tp/dp worker)"
+            )
         if pre.mm and pre.mm.get("embeds") is not None:
             if self.pp_mesh is not None:
                 # Reject at admission (a NotImplementedError inside the
@@ -974,21 +1024,38 @@ class EngineCore:
             seq.committed_blocks += 1
 
     def _dispatch_ragged(
-        self, rows: list[tuple[Sequence, list[int], int, int]], S: int
+        self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
+        n_sample: list[int] | None = None,
     ):
         """Assemble and run ONE ragged forward + fused sampling over
         arbitrary rows. Each row is ``(seq, tokens, pos_start, kv_len)``:
-        a prefill chunk (tokens sliced from the prompt) or a decode row
-        (the single pending token at position ``processed``). Prefill
-        waves and chunked mixed steps both funnel here — mixed batches are
-        exactly what the unified ragged forward was built for (a decode
-        row is q_len=1). Programs compile per (token bucket, S,
-        sampling-variant); S is the caller's static row width. Returns
-        host-side (sampled [S], logprob arrays or None)."""
+        a prefill chunk (tokens sliced from the prompt), a decode row
+        (the single pending token at position ``processed``), or a
+        speculative verify row (pending + drafted tokens). Prefill waves,
+        chunked mixed steps, and verify steps all funnel here — mixed
+        batches are exactly what the unified ragged forward was built for
+        (a decode row is q_len=1, a verify row is a q_len=k+1 "prefill
+        chunk" of already-chosen tokens). Programs compile per (token
+        bucket, S, sample width, sampling-variant); S is the caller's
+        static row width.
+
+        ``n_sample`` (aligned with rows) marks verify rows: entry > 1
+        samples that row's FIRST n positions (the per-drafted-token
+        target choices), everything else samples only the last position.
+        The sample gather widens to the engine's static ``spec_k + 1``
+        whenever any row speculates — short drafts pad with duplicate
+        reads — so draft length never mints new compiled programs. With
+        ``n_sample`` the return is 2-D ([S, R] tokens, [S, R, ...]
+        logprobs); without it, the legacy 1-D shapes."""
         P = self.engine.max_blocks_per_seq
         bs = self.engine.block_size
         total = sum(len(tl) for _, tl, _, _ in rows)
         T = self._bucket_for(total)
+        R = (
+            self._spec_R
+            if n_sample is not None and any(n > 1 for n in n_sample)
+            else 1
+        )
 
         tokens = np.zeros(T, np.int32)
         positions = np.zeros(T, np.int32)
@@ -998,8 +1065,13 @@ class EngineCore:
         tables = np.full((S, P), self.engine.garbage_block, np.int32)
         cu = np.zeros(S + 1, np.int32)
         last_rows = np.zeros(S, np.int32)
+        # Sample gather + per-slot rng counters [S, R]: slot (i, j) of a
+        # verify row reads the logits after that row's j-th token and
+        # draws with counter generated+j — bit-identical to the counter
+        # the sequential decode path would use for that same token.
+        gather = np.zeros((S, R), np.int32)
+        counters = np.zeros((S, R), np.int32)
         seeds = np.zeros(S, np.int32)
-        counters = np.zeros(S, np.int32)
         temp = np.ones(S, np.float32)
         top_k = np.zeros(S, np.int32)
         top_p = np.ones(S, np.float32)
@@ -1016,8 +1088,15 @@ class EngineCore:
             kv_lens[i] = kv_len
             tables[i, : len(ids)] = ids
             last_rows[i] = t + chunk - 1
+            if n_sample is not None and n_sample[i] > 1:
+                j = np.arange(R, dtype=np.int32)
+                off = np.minimum(j, chunk - 1)
+                gather[i] = t + off
+                counters[i] = seq.generated + off
+            else:
+                gather[i] = t + chunk - 1
+                counters[i] = seq.generated
             seeds[i] = seq.seed
-            counters[i] = seq.generated
             temp[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
@@ -1080,7 +1159,7 @@ class EngineCore:
                 jnp.asarray(plan.last_local),
                 jnp.asarray(plan.last_mask),
                 jnp.asarray(seeds),
-                jnp.asarray(counters),
+                jnp.asarray(counters[:, 0]),
                 jnp.asarray(temp),
                 jnp.asarray(top_k),
                 jnp.asarray(top_p),
@@ -1089,6 +1168,11 @@ class EngineCore:
                 want_logprobs=want_lp,
             )
         else:
+            # Sample-slot arrays flatten [S, R] -> [S*R] row-major; the
+            # ragged forward gathers R hidden rows per sequence and the
+            # fused sampler treats them as S*R independent lanes (with
+            # R == 1 these are bit-for-bit the legacy shapes, so the
+            # no-speculation program cache is untouched).
             toks, lps, self.cache = self._prefill(
                 self.params,
                 self.cache,
@@ -1100,12 +1184,12 @@ class EngineCore:
                 jnp.asarray(tables),
                 jnp.asarray(cu),
                 jnp.asarray(np.array([len(rows)], np.int32)),
-                jnp.asarray(last_rows),
-                jnp.asarray(seeds),
-                jnp.asarray(counters),
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
-                jnp.asarray(top_p),
+                jnp.asarray(gather.reshape(-1)),
+                jnp.asarray(np.repeat(seeds, R)),
+                jnp.asarray(counters.reshape(-1)),
+                jnp.asarray(np.repeat(temp, R)),
+                jnp.asarray(np.repeat(top_k, R)),
+                jnp.asarray(np.repeat(top_p, R)),
                 jnp.asarray(mm_embeds),
                 jnp.asarray(mm_mask),
                 need_mask=need_mask and not all_greedy,
@@ -1115,6 +1199,14 @@ class EngineCore:
             )
         toks = fetch_replicated(toks)
         lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
+        if n_sample is None:
+            return toks, lps
+        toks = np.asarray(toks).reshape(S, R)
+        if lps is not None:
+            lps = tuple(
+                np.asarray(a).reshape((S, R) + np.asarray(a).shape[1:])
+                for a in lps
+            )
         return toks, lps
 
     def _run_prefill_wave(self, seqs: list[Sequence]):
@@ -1323,6 +1415,10 @@ class EngineCore:
                 new_prompt.append(seq.pending)
             seq.prompt = new_prompt
         seq.pending = None
+        # The rebuilt prompt absorbs every emitted token; keeping
+        # out_tokens too would double-count them in the drafter's lookup
+        # history after re-admission.
+        seq.out_tokens = []
         seq.block_ids = []
         seq.committed_blocks = 0
         seq.prefilled = seq.processed = 0
@@ -1459,10 +1555,23 @@ class EngineCore:
     def _step_decode(
         self, outputs: list[tuple[Sequence, LLMEngineOutput]]
     ) -> list[tuple[Sequence, LLMEngineOutput]]:
-        """One fused decode+sample chain over every runnable sequence."""
+        """One fused decode+sample chain over every runnable sequence.
+        Speculating sequences peel off into a batched verify step first
+        (draft tokens verify as ragged q_len=k+1 rows); the rest keep the
+        fused chains."""
         decoding = [s for s in self.running if s.pending is not None]
         if not decoding:
             return outputs
+        if any(s.spec is not None for s in decoding):
+            outputs = self._step_verify(
+                [s for s in decoding if s.spec is not None], outputs
+            )
+            # A verify preemption may have evicted a chain candidate.
+            decoding = [
+                s for s in decoding if s.spec is None and s in self.running
+            ]
+            if not decoding:
+                return outputs
         n_steps = self._chain_length(decoding)
         ready = self._grow_or_preempt(decoding, n_steps)
         if not ready:
@@ -1506,6 +1615,156 @@ class EngineCore:
         )
         return outputs
 
+    # -- speculative decoding (draft + batched ragged verify) ---------------
+
+    def _draft_for(self, seq: Sequence, max_extra: int) -> list[int]:
+        """Draft continuation tokens for one speculating sequence, capped
+        by the caller's token headroom, the context edge, and the
+        remaining generation budget (drafting past ``max_tokens`` is pure
+        waste — the stop scan would discard it)."""
+        sc = seq.spec
+        d_cap = min(
+            sc.k, max_extra, self.engine.max_model_len - seq.processed - 1
+        )
+        if seq.stop.max_tokens is not None:
+            d_cap = min(d_cap, seq.stop.max_tokens - seq.generated - 1)
+        if d_cap <= 0:
+            return []
+        # out_tokens ends with the pending token, so proposals continue
+        # exactly the sequence the verify row will feed. Only the last
+        # window+ngram_max tokens can ever match, so hand the drafter
+        # that tail — a full prompt+output concat would be O(context)
+        # per lane per step on the decode hot path.
+        need = sc.window + sc.ngram_max
+        if len(seq.out_tokens) >= need:
+            context = seq.out_tokens[-need:]
+        else:
+            keep = need - len(seq.out_tokens)
+            context = seq.prompt[max(0, len(seq.prompt) - keep):] + seq.out_tokens
+        return propose_ngram(
+            context, d_cap, sc.ngram_min, sc.ngram_max, sc.window
+        )
+
+    def _apply_verify_row(
+        self, seq: Sequence, draft: list[int], row_toks, lps, i: int
+    ) -> tuple[LLMEngineOutput, int, int]:
+        """Host side of one verify row: accept the longest drafted prefix
+        the target agrees with, emit accepted + 1 tokens (the last is the
+        target's own correction — or bonus — choice), advance the
+        ``num_computed_tokens`` cursor past exactly the writes that are
+        valid. Rejected drafted tokens' K/V writes sit PAST the cursor:
+        never attended (kv_lens stop at the cursor) and rewritten by the
+        next step — the rollback is the cursor itself. Returns
+        (output chunk, drafted, accepted)."""
+        d = len(draft)
+        a = 0
+        while a < d and int(row_toks[a]) == draft[a]:
+            a += 1
+        emitted_all = [int(row_toks[j]) for j in range(a + 1)]
+        if d:
+            # No-draft rows are plain decode rows: counting them would
+            # drag mean_accepted_len toward 1.0 and diverge from the
+            # mocker's gauges (which only count drafted rows).
+            self.spec_stats.observe_row(d, a)
+        k, finish = self._scan_stop(seq, np.asarray(emitted_all))
+        # Valid cache writes this row: the old pending token plus the
+        # accepted drafted tokens that stay after the stop scan (same
+        # shape as the fused chain's bookkeeping).
+        written = [seq.pending] + emitted_all[: k - 1]
+        completed = seq.hashed.extend(written)
+        self._commit_completed(seq, completed)
+        seq.processed += k
+        seq.generated += k
+        emitted = emitted_all[:k]
+        lp_entries = None
+        if lps is not None and seq.logprobs is not None:
+            lp_entries = [
+                _lp_entry(
+                    emitted[j], lps[0][i][j], lps[1][i][j], lps[2][i][j],
+                    seq.logprobs,
+                )
+                for j in range(k)
+            ]
+        out = self._emit_chunk(seq, emitted, lp_entries, finish)
+        if finish is not None:
+            seq.finish = finish
+            self._finish(seq)
+        else:
+            seq.pending = emitted[-1]
+        return out, d, a
+
+    def _step_verify(
+        self, seqs: list[Sequence],
+        outputs: list[tuple[Sequence, LLMEngineOutput]],
+    ) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """One batched verify step over speculating decode sequences:
+        every row is pending + up to k drafted tokens in the SAME ragged
+        program shape the schedulers already dispatch, so k+1 target
+        forwards ride one device invocation. Draft tokens count against
+        the per-step token budget."""
+        t0 = time.time()
+        ready = self._grow_or_preempt(seqs, 1)
+        ready = ready[: self.engine.decode_buckets[-1]]
+        if not ready:
+            return outputs
+        budget = self.engine.token_budget
+        rows: list[tuple[Sequence, list[int], int, int]] = []
+        drafts: list[list[int]] = []
+        total = 0
+        for idx, seq in enumerate(ready):
+            if total + 1 > budget:
+                break  # over-budget lanes wait one step
+            # Pre-charge the base token of every lane still to come so
+            # one greedy drafter cannot push later lanes out of the step.
+            lanes_after = len(ready) - idx - 1
+            draft = self._draft_for(seq, budget - total - 1 - lanes_after)
+            if draft and not self._grow_blocks(seq, 1 + len(draft)):
+                draft = []  # block pressure: verify degrades to q_len=1
+            cursor = seq.num_computed_tokens
+            toks = [seq.pending] + draft
+            rows.append((seq, toks, cursor, cursor + len(toks)))
+            drafts.append(draft)
+            total += len(toks)
+        if not rows:
+            return outputs
+        t_draft = time.time()
+        n_draft_rows = sum(1 for d in drafts if d)
+        if n_draft_rows:
+            self._tracer.record(
+                "spec_draft", t0, t_draft,
+                attrs={
+                    "seqs": n_draft_rows,
+                    "drafted": sum(len(d) for d in drafts),
+                },
+                stat=True,
+            )
+        toks, lps = self._dispatch_ragged(
+            rows, self._decode_width(len(rows)),
+            n_sample=[len(tl) for _, tl, _, _ in rows],
+        )
+        drafted_total = accepted_total = emitted_total = 0
+        for i, ((seq, _, _, _), draft) in enumerate(zip(rows, drafts)):
+            out, d, a = self._apply_verify_row(seq, draft, toks[i], lps, i)
+            outputs.append((seq, out))
+            drafted_total += d
+            accepted_total += a
+            emitted_total += len(out.token_ids)
+        if n_draft_rows:
+            # A step "carried a verify row" only when something was
+            # actually drafted — no-match steps are plain decode steps
+            # (same accounting as the mocker, so real and mock workers
+            # export identical series).
+            self.spec_stats.verify_steps += 1
+            self._tracer.record(
+                "spec_verify", t_draft, time.time(),
+                attrs={
+                    "seqs": n_draft_rows, "drafted": drafted_total,
+                    "accepted": accepted_total, "tokens": emitted_total,
+                },
+                stat=True,
+            )
+        return outputs
+
     def _step_mixed(
         self, prefills: list[Sequence]
     ) -> list[tuple[Sequence, LLMEngineOutput]]:
@@ -1540,13 +1799,46 @@ class EngineCore:
 
         rows: list[tuple[Sequence, list[int], int, int]] = []
         kinds: list[str] = []
+        drafts: list[list[int]] = []
         total = 0
-        for seq in ready:
+        # Speculating lanes may draft up to spec_k extra tokens, but the
+        # mixed step keeps one block-sized chunk of budget in reserve so
+        # drafting can never starve prefill admission — and every draft
+        # cap pre-charges the base token of EVERY lane still to come, so
+        # the step total stays under the budget no matter how many lanes
+        # speculate (the row cap above already bounds base tokens alone
+        # at budget - 1, the pre-speculation invariant).
+        spec_budget = budget - bs
+        for idx, seq in enumerate(ready):
+            draft: list[int] = []
+            if seq.spec is not None:
+                lanes_after = len(ready) - idx - 1
+                draft = self._draft_for(
+                    seq, spec_budget - total - 1 - lanes_after
+                )
+                if draft and not self._grow_blocks(seq, 1 + len(draft)):
+                    draft = []
             cursor = seq.num_computed_tokens
-            rows.append((seq, [seq.pending], cursor, cursor + 1))
-            kinds.append("d")
-            total += 1
+            row_toks = [seq.pending] + draft
+            rows.append((seq, row_toks, cursor, cursor + len(row_toks)))
+            kinds.append("v" if seq.spec is not None else "d")
+            drafts.append(draft)
+            total += len(row_toks)
         n_decode = len(rows)
+        decode_row_tokens = total  # decode + drafted verify tokens
+        t_drafted = time.time()
+        # Rows that actually drafted: no-match speculating lanes are
+        # plain decode rows for accounting (mocker-identical series).
+        n_spec_rows = sum(1 for d in drafts if d)
+        if n_spec_rows:
+            self._tracer.record(
+                "spec_draft", t_step, t_drafted,
+                attrs={
+                    "seqs": n_spec_rows,
+                    "drafted": sum(len(d) for d in drafts),
+                },
+                stat=True,
+            )
         for seq in prefills:
             if seq not in self.running:
                 continue  # preempted above
@@ -1572,13 +1864,37 @@ class EngineCore:
                 seq.prefilled + chunk,
             ))
             kinds.append("p")
+            drafts.append([])
             total += chunk
         if not rows:
             return outputs
 
-        toks, lps = self._dispatch_ragged(rows, self._decode_width(len(rows)))
+        # Only verify rows sample more than their last position; a
+        # prefill chunk's mid-prompt logits stay unsampled noise.
+        toks2, lps2 = self._dispatch_ragged(
+            rows, self._decode_width(len(rows)),
+            n_sample=[
+                len(tl) if kind == "v" else 1
+                for (_, tl, _, _), kind in zip(rows, kinds)
+            ],
+        )
+        # Column 0 is each row's single-sample slot (decode rows and
+        # prefill chunks); verify rows read their full sample width.
+        toks = toks2[:, 0]
+        lps = None if lps2 is None else tuple(a[:, 0] for a in lps2)
         now = time.time()
+        drafted_total = accepted_total = spec_emitted = 0
         for i, ((seq, toks_list, _pos0, _kv), kind) in enumerate(zip(rows, kinds)):
+            if kind == "v":
+                out, d, a = self._apply_verify_row(
+                    seq, drafts[i], toks2[i], lps2, i
+                )
+                outputs.append((seq, out))
+                drafted_total += d
+                accepted_total += a
+                if d:
+                    spec_emitted += len(out.token_ids)
+                continue
             if kind == "d":
                 # The row wrote the pending token's K/V and sampled the
                 # next token — the 1-step unrolling of the decode chain's
@@ -1606,6 +1922,16 @@ class EngineCore:
                 outputs.append((seq, self._emit(seq, tok, lp)))
                 if seq.finish is not None:
                     self._finish(seq)
+        if n_spec_rows:
+            self.spec_stats.verify_steps += 1
+            self._tracer.record(
+                "spec_verify", t_drafted, now,
+                attrs={
+                    "seqs": n_spec_rows, "drafted": drafted_total,
+                    "accepted": accepted_total, "tokens": spec_emitted,
+                },
+                stat=True,
+            )
 
         st = self.sched_stats
         st["mixed_steps"] += 1
@@ -1618,7 +1944,8 @@ class EngineCore:
             "engine_mixed_step", t_step, now,
             attrs={
                 "seqs": len(rows), "decode_rows": n_decode,
-                "prefill_tokens": total - n_decode, "budget": budget,
+                "prefill_tokens": total - decode_row_tokens,
+                "budget": budget,
             },
             stat=True,
         )
@@ -1688,9 +2015,10 @@ class EngineCore:
         lp_entries: list[dict] | None,
         finish: str | None,
     ) -> LLMEngineOutput:
-        """One streamed chunk for a whole decode chain (stop already
-        decided by _scan_stop — ``tokens`` is exactly what the client
-        gets)."""
+        """One streamed chunk for a whole decode chain or verify row
+        (stop already decided by _scan_stop — ``tokens`` is exactly what
+        the client gets)."""
+        seq.out_tokens.extend(tokens)
         out = LLMEngineOutput(token_ids=tokens)
         if lp_entries:
             out.logprobs = lp_entries
@@ -1715,6 +2043,7 @@ class EngineCore:
     def _emit(self, seq: Sequence, token: int, lp: dict | None = None) -> LLMEngineOutput:
         """Emit the newest sampled token. ``seq.generated`` already counts
         it, on both the prefill and decode paths."""
+        seq.out_tokens.append(token)
         finish = self._check_stop(seq, token)
         out = LLMEngineOutput(token_ids=[token])
         if lp is not None:
@@ -2147,6 +2476,14 @@ class EngineCore:
         st["token_budget"] = self.engine.token_budget
         return st
 
+    def spec_decode_stats(self) -> dict:
+        """Point-in-time speculation gauges (status-server /metrics export
+        + ForwardPassMetrics.spec_decode): acceptance rate, mean accepted
+        length, drafted/accepted/wasted token counters."""
+        st = self.spec_stats.as_dict()
+        st["enabled"] = 1 if self._spec_default is not None else 0
+        return st
+
     def metrics(self) -> ForwardPassMetrics:
         alloc = self.allocator
         return ForwardPassMetrics(
@@ -2166,4 +2503,11 @@ class EngineCore:
                 ),
             ),
             transfer=dict(self.transfer_stats),
+            # Populated once speculation is configured or any request used
+            # it; None keeps pre-spec consumers byte-compatible.
+            spec_decode=(
+                self.spec_decode_stats()
+                if self._spec_default is not None or self.spec_stats.verify_rows
+                else None
+            ),
         )

@@ -86,6 +86,9 @@ class ForwardPassMetrics:
     worker_id: int = 0
     worker: WorkerStats = field(default_factory=WorkerStats)
     kv: KvStats = field(default_factory=KvStats)
+    # Speculative-decoding gauges (dynamo_tpu/spec SpecStats.as_dict():
+    # acceptance_rate, mean_accepted_len, drafted/accepted/wasted token
+    # counters). None = speculation off and never used on this worker.
     spec_decode: dict[str, Any] | None = None
     # Disagg KV transfer accounting (imported/skipped/dropped block
     # counts; see EngineCore.transfer_stats). None = engine predates it.

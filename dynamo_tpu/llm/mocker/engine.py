@@ -28,6 +28,7 @@ from dynamo_tpu.llm.protocols.common import (
     StopConditions,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.spec import SpecConfig, SpecStats, resolve_spec_config
 from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
 
 log = logging.getLogger("dynamo_tpu.mocker")
@@ -57,6 +58,18 @@ class MockEngineArgs:
     base_iter_us: float = 500.0
     prefill_us_per_token: float = 10.0
     decode_us_per_seq: float = 100.0
+    # Speculative decoding (mirrors EngineConfig.spec_decode/spec_k): with
+    # "ngram", every decode row becomes a verify row that emits
+    # 1 + accepted tokens per iteration, where accepted is simulated by
+    # spec_acceptance_rate (per-draft-token Bernoulli, stop at first
+    # miss — the geometric acceptance profile real drafters show). Draft
+    # tokens are priced like prefill tokens and count against
+    # max_num_batched_tokens, so frontend/router/bench A/Bs exercise the
+    # scheduling + timing consequences CPU-only. Token VALUES are
+    # unchanged — the stream stays bit-identical to spec off.
+    spec_decode: str = "off"
+    spec_k: int = 4
+    spec_acceptance_rate: float = 0.6
 
 
 @dataclass
@@ -74,6 +87,9 @@ class _Seq:
     generated: int = 0
     cancelled: bool = False
     stop: StopConditions = field(default_factory=StopConditions)
+    # Speculation draft length for this request (0 = off); resolved at
+    # submit from the engine default + the request's spec_decode dict.
+    spec_k: int = 0
     # Phase timestamps for the tracer (0.0 = not reached yet). The spans
     # are emitted retroactively when the stream closes so the sim loop's
     # hot path only ever stamps a float.
@@ -104,6 +120,22 @@ class MockTpuEngine:
                 f"unknown scheduling policy {self.args.scheduling!r} "
                 "(expected 'waves' or 'chunked')"
             )
+        if self.args.spec_decode not in ("off", "ngram"):
+            raise ValueError(
+                f"unknown spec_decode {self.args.spec_decode!r} "
+                "(expected 'off' or 'ngram')"
+            )
+        self._spec_default = (
+            SpecConfig(k=self.args.spec_k)
+            if self.args.spec_decode != "off"
+            else None
+        )
+        # Acceptance simulation: deterministic per engine instance so
+        # virtual-clock A/Bs reproduce exactly.
+        import random as _random
+
+        self._spec_rng = _random.Random(0x5bec)
+        self.spec_stats = SpecStats()
         self.eos_token_ids = set(eos_token_ids)
         self.kv = kv_manager or MockKvManager(
             num_blocks=self.args.num_kv_blocks,
@@ -171,6 +203,10 @@ class MockTpuEngine:
             prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
             stop=pre.stop,
         )
+        spec = resolve_spec_config(
+            self._spec_default, pre.spec_decode, self.args.spec_k
+        )
+        seq.spec_k = spec.k if spec is not None else 0
         seq.t_submit = time.time()
         self._waiting.append(seq)
         self._ensure_loop()
@@ -228,6 +264,14 @@ class MockTpuEngine:
         st["token_budget"] = self.args.max_num_batched_tokens
         return st
 
+    def spec_decode_stats(self) -> dict:
+        """Speculation gauges, same keys as EngineCore.spec_decode_stats
+        (the status server exports identical series for real and mock
+        workers)."""
+        st = self.spec_stats.as_dict()
+        st["enabled"] = 1 if self._spec_default is not None else 0
+        return st
+
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
             worker=WorkerStats(
@@ -244,6 +288,11 @@ class MockTpuEngine:
                     if self.kv.stats.prefix_queries
                     else 0.0
                 ),
+            ),
+            spec_decode=(
+                self.spec_decode_stats()
+                if self._spec_default is not None or self.spec_stats.verify_rows
+                else None
             ),
         )
 
@@ -330,6 +379,11 @@ class MockTpuEngine:
         )
         prefill_tokens = 0
         decode_seqs = 0
+        # Simulated verify accounting: drafted tokens are priced like
+        # prefill tokens (each is one extra target forward in the verify
+        # row) and count against the shared step budget.
+        spec_tokens = 0
+        spec_rows = spec_drafted = spec_accepted = spec_emitted = 0
         finished: list[_Seq] = []
 
         for seq in self._running:
@@ -339,7 +393,10 @@ class MockTpuEngine:
             if not seq.prefill_done:
                 if not self.args.enable_chunked_prefill and prefill_tokens:
                     continue  # one prefill at a time without chunking
-                chunk = min(len(seq.prompt) - seq.prefilled, budget - prefill_tokens)
+                chunk = min(
+                    len(seq.prompt) - seq.prefilled,
+                    budget - prefill_tokens - spec_tokens,
+                )
                 if not prefill_only:
                     chunk = min(chunk, chunk_cap)  # chunked: stream the prompt
                 if chunk <= 0:
@@ -361,32 +418,64 @@ class MockTpuEngine:
             if prefill_only:
                 continue  # waves: decodes stall for the whole wave
 
-            # Decode: one token per iteration.
+            # Decode: one token per iteration — or, speculating, a verify
+            # row emitting 1 + accepted tokens (acceptance simulated,
+            # token VALUES unchanged: the stream is bit-identical to spec
+            # off, only the chunking and the virtual clock move).
             decode_seqs += 1
-            token = 97 + (seq.generated % 26)  # 'a'..'z' — ByteTokenizer text
-            if len(self.seq_tail(seq)) == 0:
-                # Starting a fresh block mid-decode needs a new partial.
-                try:
-                    self.kv.allocate_partial(1)
-                    seq.partials_held += 1
-                except InsufficientBlocksError:
-                    decode_seqs -= 1
-                    self.sched_stats["decode_stalls"] += 1
-                    continue  # stalled this iteration (preemption-lite)
-            completed = seq.seq.append(token)
-            if completed is not None:
-                self.kv.commit_block(completed.block_hash, completed.parent_hash)
-                seq.partials_held -= 1
-                seq.pinned.append(completed.block_hash)
-            seq.generated += 1
-            out = LLMEngineOutput(token_ids=[token])
-            if seq.generated == 1:
+            drafted = min(
+                seq.spec_k, max(0, budget - prefill_tokens - spec_tokens)
+            )
+            accepted = 0
+            for _ in range(drafted):
+                if self._spec_rng.random() >= self.args.spec_acceptance_rate:
+                    break
+                accepted += 1
+            emitted: list[int] = []
+            finish = None
+            stalled = False
+            for _ in range(1 + accepted):
+                token = 97 + (seq.generated % 26)  # 'a'..'z' — ByteTokenizer
+                if len(self.seq_tail(seq)) == 0:
+                    # Starting a fresh block mid-decode needs a new partial.
+                    try:
+                        self.kv.allocate_partial(1)
+                        seq.partials_held += 1
+                    except InsufficientBlocksError:
+                        stalled = not emitted
+                        break  # stalled: emit what we have (maybe nothing)
+                completed = seq.seq.append(token)
+                if completed is not None:
+                    self.kv.commit_block(completed.block_hash, completed.parent_hash)
+                    seq.partials_held -= 1
+                    seq.pinned.append(completed.block_hash)
+                seq.generated += 1
+                emitted.append(token)
+                finish = self._check_stop(seq, token)
+                if finish is not None:
+                    break
+            if stalled:
+                decode_seqs -= 1
+                self.sched_stats["decode_stalls"] += 1
+                continue  # stalled this iteration (preemption-lite)
+            if drafted:
+                # Charge + account the verify row only once it actually
+                # ran (the real engine drops the draft under block
+                # pressure the same way — a stalled lane must not skew
+                # the clock or the acceptance gauges).
+                spec_tokens += drafted
+                self.spec_stats.observe_row(drafted, accepted)
+                spec_rows += 1
+                spec_drafted += drafted
+                spec_accepted += accepted
+                spec_emitted += len(emitted)
+            out = LLMEngineOutput(token_ids=emitted)
+            if seq.generated == len(emitted):
                 out.meta = {
                     "cached_tokens": seq.cached_blocks * self.args.block_size,
                     "iteration": self._iterations,
                 }
             seq.t_last_token = time.time()
-            finish = self._check_stop(seq, token)
             if finish is not None:
                 out.finish_reason = finish
                 out.prompt_tokens = len(seq.prompt)
@@ -399,17 +488,34 @@ class MockTpuEngine:
         for seq in finished:
             self._running.remove(seq)
             self._finish(seq, emit=True)
+        if spec_rows:
+            # Draft + verify spans mirror the real engine's (the mocker's
+            # draft is free, so the spans share one timestamp pair; what
+            # matters for /traces consumers is the accepted-token attrs).
+            now = time.time()
+            self.spec_stats.verify_steps += 1
+            self._tracer.record(
+                "spec_draft", now, now,
+                attrs={"seqs": spec_rows, "drafted": spec_drafted}, stat=True,
+            )
+            self._tracer.record(
+                "spec_verify", now, now,
+                attrs={
+                    "seqs": spec_rows, "drafted": spec_drafted,
+                    "accepted": spec_accepted, "tokens": spec_emitted,
+                },
+                stat=True,
+            )
         st = self.sched_stats
         if prefill_tokens and decode_seqs:
             st["mixed_steps"] += 1
-        st["last_step_batched_tokens"] = prefill_tokens + decode_seqs
-        st["last_step_budget_utilization"] = (
-            (prefill_tokens + decode_seqs) / budget if budget else 0.0
-        )
+        batched = prefill_tokens + spec_tokens + decode_seqs
+        st["last_step_batched_tokens"] = batched
+        st["last_step_budget_utilization"] = batched / budget if budget else 0.0
         st["chunked_prefills_in_flight"] = sum(
             1 for s in self._running if not s.prefill_done and s.t_first_sched
         )
-        return prefill_tokens, decode_seqs
+        return prefill_tokens + spec_tokens, decode_seqs
 
     def _check_stop(self, seq: _Seq, token: int) -> str | None:
         reason = seq.stop.check_token(token, seq.generated, self.eos_token_ids)

@@ -85,6 +85,11 @@ class OpenAIPreprocessor:
             output=request.output_options(),
             router=dict(request.dyn.router),
             annotations=list(request.dyn.annotations),
+            spec_decode=(
+                dict(request.dyn.spec_decode)
+                if request.dyn.spec_decode is not None
+                else None
+            ),
         )
 
     def make_decoder(self, pre: PreprocessedRequest) -> Decoder:

@@ -111,6 +111,12 @@ class PreprocessedRequest:
     # and the engine splices them over the placeholder rows
     # (llm/multimodal.py; reference examples/multimodal pipeline).
     mm: dict[str, Any] | None = None
+    # Per-request speculative-decoding override (dynamo_tpu/spec):
+    # {"method": "ngram"|"off", "k": int, "ngram_min": int, "ngram_max":
+    # int, "window": int}. None = the worker engine's default policy.
+    # Set from the OpenAI dyn.spec_decode extension by the preprocessor
+    # and resolved at engine admission.
+    spec_decode: dict[str, Any] | None = None
 
     def to_wire(self) -> dict:
         return asdict(self)
@@ -128,6 +134,7 @@ class PreprocessedRequest:
             annotations=d.get("annotations", []),
             request_id=d.get("request_id"),
             mm=d.get("mm"),
+            spec_decode=d.get("spec_decode"),
         )
 
 

@@ -35,6 +35,10 @@ class DynExt(BaseModel):
     # Router overrides: {"backend_instance_id": int} pins a worker;
     # {"overlap_weight": float, "router_temperature": float} tune scoring.
     router: dict[str, Any] = Field(default_factory=dict)
+    # Speculative-decoding override: {"method": "ngram"|"off", "k": int,
+    # ...} — rides PreprocessedRequest.spec_decode to the worker engine
+    # (greedy output is bit-identical with or without it).
+    spec_decode: dict[str, Any] | None = None
 
 
 class FunctionCall(BaseModel):

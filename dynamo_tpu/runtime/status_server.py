@@ -85,6 +85,56 @@ def bind_scheduler_gauges(
         )
 
 
+# Speculative-decoding gauge export: stats-dict key -> (name, doc). Keys
+# match EngineCore.spec_decode_stats() / MockTpuEngine.spec_decode_stats()
+# (SpecStats.as_dict + "enabled").
+SPEC_GAUGES: dict[str, tuple[str, str]] = {
+    "enabled": (
+        "spec_decode_enabled",
+        "1 when an engine-level speculative-decoding policy is configured",
+    ),
+    "acceptance_rate": (
+        "spec_decode_acceptance_rate",
+        "Drafted tokens the target model accepted / drafted tokens",
+    ),
+    "mean_accepted_len": (
+        "spec_decode_mean_accepted_len",
+        "Mean tokens emitted per verify row (>= 1.0; the dispatch "
+        "amortization speculation buys)",
+    ),
+    "drafted_tokens": (
+        "spec_decode_drafted_tokens_total",
+        "Draft tokens proposed (and verified) since start",
+    ),
+    "accepted_tokens": (
+        "spec_decode_accepted_tokens_total",
+        "Draft tokens accepted since start",
+    ),
+    "wasted_tokens": (
+        "spec_decode_wasted_tokens_total",
+        "Draft tokens verified and rejected since start (speculation loss)",
+    ),
+    "verify_steps": (
+        "spec_decode_verify_steps_total",
+        "Engine steps that carried at least one verify row",
+    ),
+}
+
+
+def bind_spec_gauges(
+    status: "SystemStatusServer | None", spec_stats: Callable[[], dict]
+) -> None:
+    """Export a worker's speculative-decoding gauges on /metrics (same
+    scrape-time evaluation as the scheduler gauges)."""
+    if status is None:
+        return
+    scoped = status.metrics.scoped(service="engine")
+    for key, (name, doc) in SPEC_GAUGES.items():
+        scoped.gauge(name, doc).set_function(
+            lambda k=key: float(spec_stats().get(k, 0) or 0)
+        )
+
+
 class SystemStatusServer:
     def __init__(
         self,

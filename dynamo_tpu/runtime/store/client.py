@@ -11,12 +11,32 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime import framing
 
 log = logging.getLogger("dynamo_tpu.store.client")
+
+# Reconnect backoff schedule: exponential ceiling 0.2 -> x2 -> cap 2.0.
+RECONNECT_BASE_S = 0.2
+RECONNECT_FACTOR = 2.0
+RECONNECT_CAP_S = 2.0
+
+
+def reconnect_delay(attempt: int, rng: random.Random | None = None) -> float:
+    """Full-jitter reconnect delay for the given 0-based attempt:
+    uniform in [0, min(base * factor**attempt, cap)].
+
+    A store restart disconnects EVERY client in the deployment at the
+    same instant; a deterministic schedule would have the whole fleet
+    redial in synchronized waves exactly when the store is busiest
+    recovering (the thundering-herd shape AWS's backoff-and-jitter note
+    measured). Full jitter decorrelates the redials while keeping the
+    same ceiling."""
+    ceiling = min(RECONNECT_BASE_S * RECONNECT_FACTOR ** attempt, RECONNECT_CAP_S)
+    return (rng or random).uniform(0.0, ceiling)
 
 
 @dataclass(frozen=True)
@@ -175,7 +195,7 @@ class StoreClient:
         log = logging.getLogger("dynamo_tpu.store.client")
         if self._writer is not None:
             return  # session already live (duplicate schedule)
-        backoff = 0.2
+        attempt = 0
         while not self._closed:
             try:
                 self._reader, self._writer = await asyncio.open_connection(
@@ -183,8 +203,8 @@ class StoreClient:
                 )
                 break
             except OSError:
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                await asyncio.sleep(reconnect_delay(attempt))
+                attempt += 1
         if self._closed:
             return
         self._reader_task = asyncio.create_task(self._recv_loop())

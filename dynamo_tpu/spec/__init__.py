@@ -1,0 +1,28 @@
+"""dynamo_tpu.spec — speculative decoding (draft-and-verify).
+
+A speculating sequence drafts up to ``k`` continuation tokens with a
+model-free drafter, then the engine verifies pending + draft as ONE
+``q_len=k+1`` row of the same ragged program that serves prefill chunks
+and decode rows (engine/core.py `_dispatch_ragged`) — amortizing one
+device dispatch over several emitted tokens. Verification samples the
+target model's own per-lane (seed, counter)-keyed choice at every drafted
+position, so accepted output is **bit-identical** to non-speculative
+decoding for greedy AND seeded temperature lanes; the drafter only
+decides how many of those choices land per dispatch.
+
+The reference wraps engines that own their own spec-decode
+(vLLM `--speculative-config`); here the subsystem is first-party and
+TPU-shaped: the verify row is just another ragged chunk, so XLA replays
+the existing compiled programs at a wider sample gather.
+"""
+
+from dynamo_tpu.spec.config import SpecConfig, resolve_spec_config
+from dynamo_tpu.spec.ngram import propose_ngram
+from dynamo_tpu.spec.stats import SpecStats
+
+__all__ = [
+    "SpecConfig",
+    "SpecStats",
+    "propose_ngram",
+    "resolve_spec_config",
+]

@@ -1,0 +1,117 @@
+"""Sampler edge cases (ISSUE 4 satellite): top-p rank-0 survival at tiny
+nucleus mass, top-k exactness at the k_cap boundary, and per-lane rng
+reproducibility independent of batch neighbors — the property the
+speculative verify step leans on (counter-keyed lanes must replay the
+same choices whether they run as a chain, a mixed-step row, or a verify
+row)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.sampler import DEFAULT_TOP_CAP, sample
+
+pytestmark = [pytest.mark.unit]
+
+
+def _keys(seeds, counters):
+    base = jax.random.PRNGKey(0)
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
+    )(jnp.asarray(seeds), jnp.asarray(counters))
+
+
+def _arrs(B, temp=1.0, top_k=-1, top_p=1.0):
+    return (
+        jnp.full((B,), temp, jnp.float32),
+        jnp.full((B,), top_k, jnp.int32),
+        jnp.full((B,), top_p, jnp.float32),
+    )
+
+
+def test_top_p_rank0_always_kept_at_tiny_top_p():
+    """top_p epsilon must still sample SOMETHING: the highest-probability
+    token's preceding cumulative mass is 0 < top_p, so rank 0 survives
+    the nucleus mask for any top_p > 0 — a masked-out full row would
+    sample from all -inf logits and return garbage."""
+    rng = np.random.RandomState(0)
+    B, V = 4, 128
+    logits = jnp.asarray(rng.randn(B, V).astype(np.float32) * 3)
+    temp, top_k, top_p = _arrs(B, temp=0.7, top_p=1e-6)
+    toks = sample(
+        logits, _keys([1, 2, 3, 4], [0, 0, 0, 0]), temp, top_k, top_p
+    )
+    # With an epsilon nucleus only rank 0 survives -> argmax exactly.
+    np.testing.assert_array_equal(
+        np.asarray(toks), np.asarray(jnp.argmax(logits, axis=-1))
+    )
+
+
+def test_top_k_exact_at_k_cap_boundary():
+    """top_k == k_cap is the last exact configuration (the docstring's
+    contract: exact for k <= k_cap). Construct logits where the k_cap
+    worst tokens are massively likely under a wrong implementation: only
+    the top k_cap ids may ever be sampled, and k_cap-1 must exclude the
+    k_cap-th ranked id."""
+    B, V = 2, 256
+    cap = DEFAULT_TOP_CAP
+    base = np.zeros((B, V), np.float32)
+    # ids 0..cap-1 are the top-cap set (descending); everything else far below.
+    for i in range(cap):
+        base[:, i] = 100.0 - i
+    base[:, cap:] = -100.0
+    logits = jnp.asarray(base)
+    keys = _keys([7, 8], [0, 0])
+
+    temp, top_k, top_p = _arrs(B, temp=5.0, top_k=cap)
+    allowed = set(range(cap))
+    for c in range(50):
+        toks = np.asarray(
+            sample(logits, _keys([7, 8], [c, c]), temp, top_k, top_p)
+        )
+        assert set(toks.tolist()) <= allowed
+
+    # k = cap - 1: the cap-1 ranked id (value 100 - (cap-1)) must never
+    # appear, even at high temperature.
+    temp, top_k, top_p = _arrs(B, temp=5.0, top_k=cap - 1)
+    seen = set()
+    for c in range(100):
+        toks = np.asarray(
+            sample(logits, _keys([7, 8], [c, c]), temp, top_k, top_p)
+        )
+        seen.update(toks.tolist())
+    assert cap - 1 not in seen
+    assert seen <= set(range(cap - 1))
+
+
+def test_per_lane_rng_independent_of_batch_neighbors():
+    """A seeded lane must reproduce its choices regardless of who shares
+    the batch: lane (seed=5, counter=c) draws the same token whether it
+    sits in a B=1 batch, a B=4 batch of strangers, or a different lane
+    index — the invariant that makes decode chains, mixed-step rows, and
+    speculative verify rows interchangeable."""
+    rng = np.random.RandomState(3)
+    V = 96
+    row = rng.randn(V).astype(np.float32)
+    strangers = rng.randn(3, V).astype(np.float32)
+
+    def draw(lane_logits_batch, seeds, counters, lane):
+        temp, top_k, top_p = _arrs(len(seeds), temp=0.9, top_k=20, top_p=0.9)
+        toks = sample(
+            jnp.asarray(lane_logits_batch), _keys(seeds, counters),
+            temp, top_k, top_p,
+        )
+        return int(np.asarray(toks)[lane])
+
+    for c in range(8):
+        solo = draw(row[None, :], [5], [c], 0)
+        first = draw(
+            np.concatenate([row[None, :], strangers]), [5, 1, 2, 3],
+            [c, c + 9, c + 17, c + 31], 0,
+        )
+        last = draw(
+            np.concatenate([strangers, row[None, :]]), [1, 2, 3, 5],
+            [c + 9, c + 17, c + 31, c], 3,
+        )
+        assert solo == first == last

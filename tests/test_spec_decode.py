@@ -1,0 +1,636 @@
+"""Speculative decoding (ISSUE 4): n-gram draft + batched ragged verify.
+
+The tentpole contract: with ``spec_decode='ngram'`` every speculating
+decode row becomes a q_len<=k+1 verify row in the SAME ragged program the
+schedulers already dispatch, emitting accepted+1 tokens per step — while
+greedy AND seeded-temperature output stay BIT-IDENTICAL to speculation
+off (verification replays the target's own per-lane counter-keyed
+choices). Same parity discipline as the chunked-vs-waves suite.
+"""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import tracing
+from dynamo_tpu.engine import EngineCore, tiny_engine, tiny_model
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.spec import SpecConfig, propose_ngram, resolve_spec_config
+
+pytestmark = [pytest.mark.unit]
+
+CFG = tiny_model()
+
+# Repetitive prompts give the prompt-lookup drafter real hits, so the
+# accept path (not just the all-rejected path) is exercised.
+REPEAT_PROMPT = [5, 6, 7, 8] * 6
+RANDOM_PROMPT = list(np.random.RandomState(0).randint(1, 200, size=40))
+
+
+def _req(prompt, rid, max_tokens=16, temp=0.0, seed=None, spec=None, **stop_kw):
+    return PreprocessedRequest(
+        model="tiny",
+        token_ids=list(prompt),
+        request_id=rid,
+        sampling=SamplingOptions(temperature=temp, seed=seed),
+        stop=StopConditions(max_tokens=max_tokens, **stop_kw),
+        spec_decode=spec,
+    )
+
+
+def run_to_completion(core, seqs, max_steps=2000):
+    done: dict[str, list[int]] = {s.request_id: [] for s in seqs}
+    finishes: dict[str, str] = {}
+    for _ in range(max_steps):
+        for seq, out in core.step():
+            done[seq.request_id].extend(out.token_ids)
+            if out.finish_reason:
+                finishes[seq.request_id] = out.finish_reason
+        if len(finishes) == len(seqs):
+            break
+    return done, finishes
+
+
+# -- drafter ------------------------------------------------------------------
+
+
+def test_ngram_drafter_basic_match():
+    # ... 5 6 7 8 | 5 6 -> suffix [5, 6] recurs; propose [7, 8, 5]
+    ctx = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+    assert propose_ngram(ctx, 3) == [7, 8, 5]
+    assert propose_ngram(ctx, 1) == [7]
+
+
+def test_ngram_drafter_prefers_most_recent_occurrence():
+    # Suffix [2] occurs twice; the most recent earlier one is followed
+    # by 9, the older by 3.
+    ctx = [1, 2, 3, 4, 2, 9, 7, 2]
+    assert propose_ngram(ctx, 2, ngram_max=1) == [9, 7]
+
+
+def test_ngram_drafter_no_match_and_bounds():
+    assert propose_ngram([1, 2, 3, 4], 4) == []  # no repeated suffix
+    assert propose_ngram([], 4) == []
+    assert propose_ngram([1], 4) == []
+    assert propose_ngram([1, 1], 0) == []
+    # Window excludes the distant match.
+    ctx = [7, 8, 9] + [1, 2, 3, 4] * 5 + [7, 8]
+    assert propose_ngram(ctx, 2, window=8) == []
+
+
+def test_ngram_drafter_longest_suffix_wins():
+    # 3-gram [1, 2, 3] matches (-> 9); the 1-gram [3] alone would pick 5.
+    ctx = [1, 2, 3, 9, 3, 5, 1, 2, 3]
+    assert propose_ngram(ctx, 1, ngram_max=3) == [9]
+
+
+# -- config resolution --------------------------------------------------------
+
+
+def test_spec_config_resolution():
+    default = SpecConfig(k=4)
+    assert resolve_spec_config(default, None, 4) is default
+    assert resolve_spec_config(default, {"method": "off"}, 4) is None
+    assert resolve_spec_config(None, None, 4) is None
+    # Request enables speculation on an engine whose default is off.
+    got = resolve_spec_config(None, {"method": "ngram", "k": 2}, 4)
+    assert got is not None and got.k == 2
+    # Per-request k clamps to the engine's static width.
+    got = resolve_spec_config(default, {"k": 99}, 4)
+    assert got.k == 4
+    # The host-CPU knobs clamp to the engine baseline too: an unclamped
+    # ngram_max/window would let one request inject O(window x ngram)
+    # drafter work into every engine step.
+    got = resolve_spec_config(default, {"ngram_max": 8192, "window": 10**9}, 4)
+    assert got.ngram_max == default.ngram_max
+    assert got.window == default.window
+    with pytest.raises(ValueError, match="method"):
+        resolve_spec_config(default, {"method": "medusa"}, 4)
+
+
+def test_engine_spec_config_validation():
+    with pytest.raises(ValueError, match="spec_decode"):
+        EngineCore(CFG, tiny_engine(spec_decode="medusa"), seed=0)
+    with pytest.raises(ValueError, match="spec_k"):
+        EngineCore(CFG, tiny_engine(spec_decode="ngram", spec_k=0), seed=0)
+
+
+# -- greedy parity ------------------------------------------------------------
+
+
+def _run_all(engine_kw, reqs):
+    core = EngineCore(CFG, tiny_engine(**engine_kw), seed=0)
+    seqs = [core.add_request(r) for r in reqs]
+    done, fin = run_to_completion(core, seqs)
+    return core, done, fin
+
+
+def test_greedy_parity_spec_on_vs_off_waves():
+    reqs = lambda: [  # noqa: E731
+        _req(REPEAT_PROMPT, "rep", max_tokens=20, ignore_eos=True),
+        _req(RANDOM_PROMPT, "rnd", max_tokens=12),
+        _req([9, 9, 9, 9, 9, 9], "nines", max_tokens=16, ignore_eos=True),
+    ]
+    _, base, fb = _run_all({}, reqs())
+    core, spec, fs = _run_all({"spec_decode": "ngram", "spec_k": 4}, reqs())
+    assert base == spec
+    assert fb == fs
+    st = core.spec_decode_stats()
+    assert st["verify_steps"] > 0
+    assert st["acceptance_rate"] > 0  # repetitive greedy output drafts land
+
+
+def test_greedy_parity_spec_in_chunked_mixed_step():
+    """The acceptance-criterion case: speculating decodes ride a chunked
+    MIXED step (verify rows next to a long prompt's prefill chunks) and
+    still match the non-speculative stream token for token."""
+    long_prompt = list(np.random.RandomState(1).randint(1, 200, size=200))
+
+    def run(spec_on):
+        kw = dict(scheduling="chunked", prefill_chunk=32)
+        if spec_on:
+            kw.update(spec_decode="ngram", spec_k=4)
+        core = EngineCore(CFG, tiny_engine(**kw), seed=0)
+        d1 = core.add_request(
+            _req(REPEAT_PROMPT, "d1", max_tokens=40, ignore_eos=True)
+        )
+        d2 = core.add_request(
+            _req([3, 4] * 8, "d2", max_tokens=40, ignore_eos=True)
+        )
+        while not (d1.prefill_done and d2.prefill_done):
+            core.step()
+        seqs = [d1, d2, core.add_request(_req(long_prompt, "long", max_tokens=6))]
+        done, fin = run_to_completion(core, seqs)
+        return core, done, fin
+
+    core_off, done_off, fin_off = run(False)
+    core_on, done_on, fin_on = run(True)
+    assert done_off == done_on
+    assert fin_off == fin_on
+    assert core_on.spec_stats.verify_steps > 0
+    assert core_on.sched_stats["mixed_steps"] > 0
+
+
+def test_seeded_temperature_parity_spec_on_vs_off():
+    """Verification replays the target's counter-keyed sampler, so even
+    TEMPERATURE lanes are bit-identical with speculation on — a stronger
+    guarantee than lossy rejection sampling."""
+    reqs = lambda: [  # noqa: E731
+        _req(REPEAT_PROMPT, "a", max_tokens=20, temp=0.8, seed=42,
+             ignore_eos=True),
+        _req(RANDOM_PROMPT, "b", max_tokens=14, temp=1.2, seed=7),
+    ]
+    _, base, _ = _run_all({}, reqs())
+    _, spec, _ = _run_all({"spec_decode": "ngram", "spec_k": 4}, reqs())
+    assert base == spec
+
+
+def test_parity_with_logprobs():
+    def run(spec_on):
+        kw = {"spec_decode": "ngram", "spec_k": 4} if spec_on else {}
+        core = EngineCore(CFG, tiny_engine(**kw), seed=0)
+        pre = _req(REPEAT_PROMPT, "lp", max_tokens=10, ignore_eos=True)
+        pre.output.logprobs = 3
+        seq = core.add_request(pre)
+        toks, entries = [], []
+        for _ in range(200):
+            for s, out in core.step():
+                toks.extend(out.token_ids)
+                entries.extend(out.logprobs or [])
+                if out.finish_reason:
+                    return toks, entries
+        raise AssertionError("did not finish")
+
+    t0, e0 = run(False)
+    t1, e1 = run(True)
+    assert t0 == t1
+    assert len(e1) == len(t1)
+    assert [e["token_id"] for e in e0] == [e["token_id"] for e in e1]
+    for a, b in zip(e0, e1):
+        assert a["top"] == b["top"]
+        assert abs(a["logprob"] - b["logprob"]) < 1e-5
+
+
+# -- scheduling / budget ------------------------------------------------------
+
+
+def test_draft_tokens_count_against_token_budget():
+    """Chunked mixed steps: drafted tokens consume max_num_batched_tokens
+    (with a one-block reserve so prefill admission can't starve)."""
+    budget = 16
+    core = EngineCore(
+        CFG,
+        tiny_engine(
+            scheduling="chunked", prefill_chunk=8,
+            max_num_batched_tokens=budget, prefill_buckets=(16, 32, 64),
+            spec_decode="ngram", spec_k=4,
+        ),
+        seed=0,
+    )
+    decoders = [
+        core.add_request(
+            _req([5, 6] * 6, f"d{i}", max_tokens=40, ignore_eos=True)
+        )
+        for i in range(3)
+    ]
+    while not all(s.prefill_done for s in decoders):
+        core.step()
+    long = core.add_request(
+        _req(list(range(1, 65)), "long", max_tokens=2, ignore_eos=True)
+    )
+    while not long.prefill_done:
+        core.step()
+        assert core.sched_stats["last_step_batched_tokens"] <= budget
+        # The one-block reserve kept prefill moving: the long prompt
+        # always gets a chunk while decodes speculate.
+    run_to_completion(core, decoders + [long])
+
+
+def test_many_spec_lanes_never_exceed_budget():
+    """The overflow regression: with more speculating lanes than the
+    draft budget covers, every lane's BASE token is pre-charged, so the
+    step total stays under max_num_batched_tokens (no bucket overflow,
+    no prefill starvation) and every lane keeps emitting."""
+    budget = 16
+    core = EngineCore(
+        CFG,
+        tiny_engine(
+            scheduling="chunked", prefill_chunk=8,
+            max_num_batched_tokens=budget, prefill_buckets=(16, 32, 64),
+            decode_buckets=(4, 8), max_num_seqs=8,
+            spec_decode="ngram", spec_k=4,
+        ),
+        seed=0,
+    )
+    lanes = [
+        core.add_request(
+            _req([5, 6] * 6, f"d{i}", max_tokens=30, ignore_eos=True)
+        )
+        for i in range(7)
+    ]
+    while not all(s.prefill_done for s in lanes):
+        core.step()
+    long = core.add_request(
+        _req(list(range(1, 49)), "long", max_tokens=2, ignore_eos=True)
+    )
+    steps_to_prefill = 0
+    while not long.prefill_done:
+        outs = core.step()
+        steps_to_prefill += 1
+        assert core.sched_stats["last_step_batched_tokens"] <= budget
+        assert steps_to_prefill < 50, "prefill starved by speculation"
+        # In-flight lanes keep emitting every mixed step.
+        emitted_ids = {s.request_id for s, _ in outs}
+        assert any(s.request_id in emitted_ids for s in lanes if s.finish is None)
+    run_to_completion(core, lanes + [long])
+
+
+def test_spec_respects_max_tokens_budget():
+    """Drafting never overshoots the generation budget, and the stream
+    ends with exactly max_tokens tokens."""
+    core = EngineCore(
+        CFG, tiny_engine(spec_decode="ngram", spec_k=4), seed=0
+    )
+    seq = core.add_request(
+        _req(REPEAT_PROMPT, "m", max_tokens=7, ignore_eos=True)
+    )
+    done, fin = run_to_completion(core, [seq])
+    assert len(done["m"]) == 7
+    assert fin["m"] == "length"
+
+
+def test_spec_under_block_pressure_preempts_and_recovers():
+    """Verify rows grow blocks like decode rows; under pressure the
+    engine preempts/degrades but the allocator lands back at baseline
+    and output parity holds."""
+    def run(spec_on):
+        kw = dict(num_kv_blocks=12, max_model_len=64)
+        if spec_on:
+            kw.update(spec_decode="ngram", spec_k=4)
+        core = EngineCore(CFG, tiny_engine(**kw), seed=0)
+        seqs = [
+            core.add_request(
+                _req([5, 6] * 8, "a", max_tokens=24, ignore_eos=True)
+            ),
+            core.add_request(
+                _req([7, 8] * 8, "b", max_tokens=24, ignore_eos=True)
+            ),
+        ]
+        done, fin = run_to_completion(core, seqs, max_steps=4000)
+        assert core.allocator.used_blocks == len(core.allocator._inactive)
+        assert core.allocator._partials == 0
+        return done, fin
+
+    base = run(False)
+    spec = run(True)
+    assert base == spec
+
+
+# -- per-request plumbing -----------------------------------------------------
+
+
+def test_per_request_spec_override():
+    # Engine default OFF, request turns speculation ON.
+    core = EngineCore(CFG, tiny_engine(), seed=0)
+    on = core.add_request(
+        _req(REPEAT_PROMPT, "on", spec={"method": "ngram", "k": 3})
+    )
+    off = core.add_request(_req(REPEAT_PROMPT, "off"))
+    assert on.spec is not None and on.spec.k == 3
+    assert off.spec is None
+    done, _ = run_to_completion(core, [on, off])
+    assert done["on"] == done["off"]  # parity inside ONE mixed batch
+    assert core.spec_stats.verify_rows > 0
+
+    # Engine default ON, request turns it off.
+    core2 = EngineCore(
+        CFG, tiny_engine(spec_decode="ngram", spec_k=4), seed=0
+    )
+    seq = core2.add_request(_req(REPEAT_PROMPT, "x", spec={"method": "off"}))
+    assert seq.spec is None
+    # k clamps to the engine's static width.
+    seq2 = core2.add_request(_req(REPEAT_PROMPT, "y", spec={"k": 99}))
+    assert seq2.spec.k == 4
+    with pytest.raises(ValueError, match="method"):
+        core2.add_request(_req(REPEAT_PROMPT, "z", spec={"method": "eagle"}))
+
+
+def test_spec_decode_rides_openai_dyn_to_wire():
+    """dyn.spec_decode -> preprocessor -> PreprocessedRequest -> wire dict
+    -> from_wire: the field the router used to drop now round-trips to
+    the worker payload."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    body = ChatCompletionRequest.model_validate(
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "dyn": {"spec_decode": {"method": "ngram", "k": 2}},
+        }
+    )
+    mdc = ModelDeploymentCard(
+        name="tiny", tokenizer="byte", model_type="chat", context_length=256
+    )
+    pre = OpenAIPreprocessor(mdc).preprocess_chat(body)
+    assert pre.spec_decode == {"method": "ngram", "k": 2}
+    wire = pre.to_wire()
+    assert wire["spec_decode"] == {"method": "ngram", "k": 2}
+    back = PreprocessedRequest.from_wire(wire)
+    assert back.spec_decode == {"method": "ngram", "k": 2}
+    # Unset stays None end to end.
+    body2 = ChatCompletionRequest.model_validate(
+        {"model": "tiny", "messages": [{"role": "user", "content": "hi"}]}
+    )
+    pre2 = OpenAIPreprocessor(mdc).preprocess_chat(body2)
+    assert pre2.spec_decode is None
+    assert PreprocessedRequest.from_wire(pre2.to_wire()).spec_decode is None
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_spec_spans_and_metrics():
+    tracing.configure(enabled=True, sample=1.0)
+    collector = tracing.get_collector()
+    collector.clear()
+    core = EngineCore(
+        CFG, tiny_engine(spec_decode="ngram", spec_k=4), seed=0
+    )
+    seq = core.add_request(
+        _req(REPEAT_PROMPT, "t", max_tokens=16, ignore_eos=True)
+    )
+    run_to_completion(core, [seq])
+    stats = collector.stats()
+    drafts = [s for s in stats if s.name == "spec_draft"]
+    verifies = [s for s in stats if s.name == "spec_verify"]
+    assert drafts and verifies
+    assert sum(v.attrs["accepted"] for v in verifies) == (
+        core.spec_stats.accepted_tokens
+    )
+    assert all("drafted" in v.attrs for v in verifies)
+
+    fpm = core.metrics()
+    assert fpm.spec_decode is not None
+    assert fpm.spec_decode["enabled"] == 1
+    assert fpm.spec_decode["acceptance_rate"] > 0
+    assert fpm.spec_decode["mean_accepted_len"] >= 1.0
+    # Round-trips the (previously dead) ForwardPassMetrics field.
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    back = ForwardPassMetrics.from_wire(fpm.to_wire())
+    assert back.spec_decode == fpm.spec_decode
+
+    # Speculation off and unused: field stays None (wire compat).
+    core_off = EngineCore(CFG, tiny_engine(), seed=0)
+    assert core_off.metrics().spec_decode is None
+
+
+def test_spec_gauges_exported():
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+    from dynamo_tpu.runtime.status_server import (
+        SPEC_GAUGES,
+        SystemStatusServer,
+        bind_spec_gauges,
+    )
+
+    core = EngineCore(
+        CFG, tiny_engine(spec_decode="ngram", spec_k=4), seed=0
+    )
+    seq = core.add_request(
+        _req(REPEAT_PROMPT, "g", max_tokens=12, ignore_eos=True)
+    )
+    run_to_completion(core, [seq])
+    status = SystemStatusServer(MetricsRegistry())
+    bind_spec_gauges(status, core.spec_decode_stats)
+    text = status.metrics.render().decode() if isinstance(
+        status.metrics.render(), bytes
+    ) else status.metrics.render()
+    for _, (name, _doc) in SPEC_GAUGES.items():
+        assert name in text
+    assert "spec_decode_enabled" in text
+    # The scrape-time closure reads live stats.
+    st = core.spec_decode_stats()
+    assert st["acceptance_rate"] > 0
+
+
+# -- mocker: acceptance-rate simulation ---------------------------------------
+
+
+def _mock_engine(spec_rate=None, **kw):
+    from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine
+
+    args = MockEngineArgs(
+        num_kv_blocks=512, block_size=4, max_num_batched_tokens=256,
+        **(
+            dict(spec_decode="ngram", spec_k=4, spec_acceptance_rate=spec_rate)
+            if spec_rate is not None
+            else {}
+        ),
+        **kw,
+    )
+    return MockTpuEngine(args)
+
+
+def _mock_seq(prompt, rid, max_tokens, block_size, spec_k=0):
+    from dynamo_tpu.llm.mocker.engine import _Seq
+    from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
+
+    s = _Seq(
+        request_id=rid,
+        prompt=prompt,
+        max_tokens=max_tokens,
+        out=asyncio.Queue(),
+        seq=TokenBlockSequence(prompt, block_size),
+        prompt_hashes=compute_seq_hashes(prompt, block_size),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    s.spec_k = spec_k
+    return s
+
+
+def _drain_mock(eng, seq):
+    from dynamo_tpu.llm.mocker.engine import MockTpuEngine
+
+    toks, iters = [], 0
+    eng._waiting.append(seq)
+    eng._admit()
+    while seq in eng._running:
+        eng._step()
+        iters += 1
+        while not seq.out.empty():
+            item = seq.out.get_nowait()
+            if item is not MockTpuEngine._FINISHED:
+                toks.extend(item.get("token_ids", []))
+    return toks, iters
+
+
+def test_mocker_spec_stream_bit_identical_and_fewer_iterations():
+    base = _mock_engine()
+    t0, i0 = _drain_mock(base, _mock_seq([1] * 8, "a", 30, 4))
+    spec = _mock_engine(spec_rate=0.7)
+    t1, i1 = _drain_mock(spec, _mock_seq([1] * 8, "a", 30, 4, spec_k=4))
+    assert t0 == t1
+    assert i1 < i0
+    st = spec.spec_decode_stats()
+    assert st["acceptance_rate"] > 0
+    assert st["verify_steps"] > 0
+    assert spec.metrics().spec_decode is not None
+
+
+def test_mocker_spec_tpot_ab_on_virtual_clock():
+    """The acceptance-criterion A/B: at acceptance >= 0.5, decode TPOT on
+    the mocker's virtual clock improves vs speculation off (one dispatch
+    amortizes over accepted+1 tokens; draft tokens are priced like
+    prefill tokens, so the win is net of verify cost)."""
+
+    def tpot(spec_rate):
+        from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine
+
+        args = MockEngineArgs(
+            num_kv_blocks=8192, block_size=32, max_num_seqs=32,
+            max_num_batched_tokens=2048, enable_prefix_caching=False,
+            **(
+                dict(
+                    spec_decode="ngram", spec_k=4,
+                    spec_acceptance_rate=spec_rate,
+                )
+                if spec_rate is not None
+                else {}
+            ),
+        )
+        eng = MockTpuEngine(args)
+        seqs = [
+            _mock_seq(
+                [1 + (j % 7)] * 128, f"s{j}", 64, 32,
+                spec_k=4 if spec_rate is not None else 0,
+            )
+            for j in range(16)
+        ]
+        for s in seqs:
+            eng._waiting.append(s)
+        vt = 0.0
+        first: dict[str, float] = {}
+        gaps: list[float] = []
+        prev: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        while any(s in eng._running or s in eng._waiting for s in seqs):
+            eng._admit()
+            p, d = eng._step()
+            vt += (
+                args.base_iter_us
+                + p * args.prefill_us_per_token
+                + d * args.decode_us_per_seq
+            ) / 1e6
+            for s in seqs:
+                while not s.out.empty():
+                    item = s.out.get_nowait()
+                    if not isinstance(item, dict):
+                        continue
+                    n = len(item.get("token_ids", []))
+                    if not n:
+                        continue
+                    rid = s.request_id
+                    if rid in first:
+                        # n tokens landed this step: n TPOT samples over
+                        # the gap (chunked emission still yields honest
+                        # per-token pacing).
+                        gaps.extend([(vt - prev[rid]) / n] * n)
+                    first.setdefault(rid, vt)
+                    prev[rid] = vt
+                    counts[rid] = counts.get(rid, 0) + n
+        gaps.sort()
+        return (
+            gaps[len(gaps) // 2],
+            gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))],
+            vt,
+        )
+
+    off_p50, off_p99, off_total = tpot(None)
+    on_p50, on_p99, on_total = tpot(0.6)
+    # Headline: median TPOT and total decode wall-clock both improve.
+    assert on_p50 < off_p50, (on_p50, off_p50)
+    assert on_total < off_total, (on_total, off_total)
+    # Tail: a first-draft rejection pays the k verify forwards for one
+    # emitted token, so p99 trades a BOUNDED amount (the per-token cost
+    # of a miss step is base + k*prefill_us over 1 token).
+    assert on_p99 < off_p99 * 1.6, (on_p99, off_p99)
+    # Below-threshold acceptance must not catastrophically regress: the
+    # verify cost is bounded by k draft-token forwards per step.
+    low_p50, _, low_total = tpot(0.2)
+    assert low_p50 < off_p50 * 1.5
+    assert low_total < off_total * 1.5
+
+
+def test_mocker_per_request_spec_override():
+    """The mocker honors PreprocessedRequest.spec_decode, so frontend /
+    router e2e tests can exercise per-request speculation CPU-only."""
+    from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    async def run():
+        eng = MockTpuEngine(
+            MockEngineArgs(
+                num_kv_blocks=256, block_size=4, speedup_ratio=100.0,
+            )
+        )
+        pre = _req([1] * 8, "r1", max_tokens=12, ignore_eos=True,
+                   spec={"method": "ngram", "k": 3})
+        toks = []
+        async for out in eng.generate(pre.to_wire(), Context("r1")):
+            toks.extend(out.get("token_ids", []))
+        assert eng.spec_stats.verify_rows > 0
+        assert len(toks) == 12
+        if eng._loop_task is not None:
+            eng._loop_task.cancel()
+        return toks
+
+    toks = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())
+    assert toks == [97 + (i % 26) for i in range(12)]

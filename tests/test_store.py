@@ -179,3 +179,42 @@ async def test_client_survives_store_restart():
             await server2.stop()
     finally:
         await client.close()
+
+
+# -- reconnect backoff jitter (ISSUE 4 satellite) -----------------------------
+
+
+def test_reconnect_delay_full_jitter_bounds():
+    """Full jitter: every delay lands in [0, min(0.2 * 2**attempt, 2.0)]
+    and the ceiling caps at 2.0 from attempt 4 on."""
+    import random
+
+    from dynamo_tpu.runtime.store.client import (
+        RECONNECT_BASE_S,
+        RECONNECT_CAP_S,
+        RECONNECT_FACTOR,
+        reconnect_delay,
+    )
+
+    rng = random.Random(7)
+    for attempt in range(12):
+        ceiling = min(
+            RECONNECT_BASE_S * RECONNECT_FACTOR ** attempt, RECONNECT_CAP_S
+        )
+        for _ in range(200):
+            d = reconnect_delay(attempt, rng)
+            assert 0.0 <= d <= ceiling, (attempt, d, ceiling)
+    assert RECONNECT_BASE_S * RECONNECT_FACTOR ** 4 > RECONNECT_CAP_S
+
+
+def test_reconnect_delay_decorrelates_clients():
+    """Two clients that disconnect at the same instant must not redial in
+    lockstep: with jitter the per-attempt delays differ (this is the
+    thundering-herd property the deterministic 0.2 -> x2 schedule lacked)."""
+    import random
+
+    from dynamo_tpu.runtime.store.client import reconnect_delay
+
+    a = [reconnect_delay(i, random.Random(1)) for i in range(8)]
+    b = [reconnect_delay(i, random.Random(2)) for i in range(8)]
+    assert a != b

@@ -1,0 +1,161 @@
+"""Speculative-decoding smoke: a mocker-backed frontend with
+``--spec-decode ngram`` streams BIT-IDENTICAL greedy output with
+speculation on vs off, and the worker reports acceptance rate > 0.
+
+This is the user-visible contract of the spec subsystem (ISSUE 4):
+speculation changes the step shape (several tokens per verify dispatch)
+and the timing, never the tokens. The same request is sent twice — once
+with the per-request ``dyn.spec_decode`` override disabling speculation,
+once riding the engine default — and the full streamed text must match
+byte for byte. The worker's /metrics must then show
+``spec_decode_acceptance_rate`` > 0 and ``spec_draft``/``spec_verify``
+spans in the trace collector.
+
+CI usage (`.github/workflows/ci.yml` spec-smoke step) and local:
+
+    python tools/spec_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def stream_text(session, url: str, body: dict) -> str:
+    """POST a streaming chat completion; return the concatenated content."""
+    import json
+
+    parts: list[str] = []
+    async with session.post(url, json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:") or "[DONE]" in line:
+                continue
+            chunk = json.loads(line[len("data:"):])
+            for choice in chunk.get("choices", []):
+                parts.append((choice.get("delta") or {}).get("content") or "")
+    return "".join(parts)
+
+
+async def run() -> None:
+    import aiohttp
+
+    from dynamo_tpu import tracing
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.status_server import SystemStatusServer
+    from dynamo_tpu.runtime.store import StoreServer
+
+    tracing.configure(enabled=True, sample=1.0)
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    # Status server so the spec gauges export exactly as deployed workers
+    # export them (run_mocker binds them to runtime.status).
+    worker_rt.status = SystemStatusServer(host="127.0.0.1", port=0)
+    await worker_rt.status.start()
+    served = asyncio.Event()
+    worker = asyncio.create_task(
+        run_mocker(
+            worker_rt,
+            model_name="mock",
+            engine_args=MockEngineArgs(
+                num_kv_blocks=8192,
+                block_size=8,
+                spec_decode="ngram",
+                spec_k=4,
+                spec_acceptance_rate=0.7,
+                speedup_ratio=50.0,
+            ),
+            served_event=served,
+        )
+    )
+    await asyncio.wait_for(served.wait(), 30)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+
+        url = f"{base}/v1/chat/completions"
+
+        def body(spec_override: dict | None) -> dict:
+            out = {
+                "model": "mock",
+                "messages": [{"role": "user", "content": "speculate this"}],
+                "max_tokens": 48,
+                "temperature": 0.0,
+                "stream": True,
+            }
+            if spec_override is not None:
+                out["dyn"] = {"spec_decode": spec_override}
+            return out
+
+        text_off = await stream_text(s, url, body({"method": "off"}))
+        text_on = await stream_text(s, url, body(None))  # engine default: on
+        assert text_on and text_on == text_off, (
+            f"speculative stream diverged from baseline:\n"
+            f"  off: {text_off!r}\n  on:  {text_on!r}"
+        )
+
+        async with s.get(
+            f"http://127.0.0.1:{worker_rt.status.port}/metrics"
+        ) as r:
+            metrics = await r.text()
+        acc = next(
+            (
+                float(line.rsplit(" ", 1)[1])
+                for line in metrics.splitlines()
+                if line.startswith("dynamo_spec_decode_acceptance_rate{")
+            ),
+            None,
+        )
+        assert acc is not None, "spec_decode_acceptance_rate gauge missing"
+        assert acc > 0, f"acceptance rate {acc} (speculation never accepted)"
+
+        spans = {sp.name for sp in tracing.get_collector().stats()}
+        assert "spec_draft" in spans and "spec_verify" in spans, spans
+
+        print(
+            "spec-smoke OK: 48-token greedy stream bit-identical spec-on "
+            f"vs spec-off; acceptance_rate={acc:.3f}", flush=True,
+        )
+
+    for task in (worker, frontend):
+        task.cancel()
+    await worker_rt.status.stop()
+    for rt in (worker_rt, front_rt):
+        await rt.shutdown()
+    await store.stop()
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
