@@ -527,16 +527,18 @@ async def run_jax_worker(
     )
     await metrics_pub.start()
 
-    # Scheduler + speculation gauges on this worker's /metrics (queue
-    # depth, budget utilization, acceptance rate, ...) — evaluated at
-    # scrape time against the live core.
+    # Scheduler + speculation + prefix-cache gauges on this worker's
+    # /metrics (queue depth, budget utilization, acceptance rate, hit
+    # rate, ...) — evaluated at scrape time against the live core.
     from dynamo_tpu.runtime.status_server import (
+        bind_kv_cache_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
     )
 
     bind_scheduler_gauges(runtime.status, core.scheduler_stats)
     bind_spec_gauges(runtime.status, core.spec_decode_stats)
+    bind_kv_cache_gauges(runtime.status, core.kv_cache_stats)
 
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
@@ -1146,6 +1148,13 @@ def main() -> None:
         help="max draft tokens per verify step (also clamps per-request "
              "dyn.spec_decode k)",
     )
+    ap.add_argument(
+        "--async-exec", default=None, choices=["on", "off"],
+        help="one-step-ahead pipelined engine loop: plan+enqueue step N+1 "
+             "while N executes, with device-resident token feedback and "
+             "double-buffered host fetch (token stream bit-identical to "
+             "'off'; default off)",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
@@ -1211,6 +1220,9 @@ def main() -> None:
             "max_num_batched_tokens": args.max_num_batched_tokens,
             "spec_decode": args.spec_decode,
             "spec_k": args.spec_k,
+            "async_exec": (
+                None if args.async_exec is None else args.async_exec == "on"
+            ),
         }.items()
         if v is not None
     }

@@ -60,12 +60,14 @@ async def run_mocker(
     # Same scheduler + speculation gauges as the real worker (mock fleets
     # exercise the policies CPU-only; dashboards see identical series).
     from dynamo_tpu.runtime.status_server import (
+        bind_kv_cache_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
     )
 
     bind_scheduler_gauges(runtime.status, engine.scheduler_stats)
     bind_spec_gauges(runtime.status, engine.spec_decode_stats)
+    bind_kv_cache_gauges(runtime.status, engine.kv_cache_stats)
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
@@ -121,6 +123,10 @@ def main() -> None:
                     help="draft tokens per verify step")
     ap.add_argument("--spec-acceptance-rate", type=float, default=0.6,
                     help="per-draft-token acceptance probability")
+    ap.add_argument("--async-exec", default="off", choices=["on", "off"],
+                    help="one-step-ahead overlap model: per-iteration host "
+                         "overhead hides under device compute (virtual "
+                         "clock; stream stays bit-identical to 'off')")
     args = ap.parse_args()
 
     engine_args = MockEngineArgs(
@@ -134,6 +140,7 @@ def main() -> None:
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
         spec_acceptance_rate=args.spec_acceptance_rate,
+        async_exec=args.async_exec == "on",
     )
 
     @dynamo_worker()

@@ -162,6 +162,18 @@ class EngineConfig:
     # decode row costs 1 token). 0 = the largest prefill bucket.
     max_num_batched_tokens: int = 0
 
+    # -- async pipelined execution (PERF.md r8) -----------------------------
+    # One-step-ahead engine loop: while step N executes on device, the
+    # host plans and enqueues step N+1 (decode lanes advance exactly one
+    # token, deterministically — EOS/max-tokens land one step late and
+    # roll back via the num_computed_tokens cursor), sampled token ids
+    # feed the next step's token buffer via an on-device gather (no
+    # D2H→H2D round trip), and step N's tokens/logprobs land through a
+    # double-buffered async copy consumed while N+1 runs. The token
+    # stream is bit-identical on vs off (greedy AND seeded sampling).
+    # Off by default until parity is pinned on every deployment shape.
+    async_exec: bool = False
+
     # Disaggregation: a remote-decode prefill's held blocks are released
     # if no decode worker pulls them within this window (a decode-side
     # timeout would otherwise pin them forever). 0 = never expire.

@@ -49,10 +49,19 @@ from dynamo_tpu.engine.model import (
     init_cache,
     init_params,
 )
-from dynamo_tpu.engine.sampler import LOGPROBS_K, sample, token_logprobs
+from dynamo_tpu.engine.sampler import (
+    LOGPROBS_K,
+    gather_feedback,
+    sample,
+    token_logprobs,
+)
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.spec import SpecConfig, SpecStats, propose_ngram, resolve_spec_config
-from dynamo_tpu.parallel.multihost import fetch_replicated
+from dynamo_tpu.parallel.multihost import (
+    fetch_replicated,
+    fetch_replicated_many,
+    start_host_copy,
+)
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -138,6 +147,96 @@ def _check_fuse_tp(params, tp: int) -> None:
             f"tp={tp}; reload with load_hf_llama(path, tp={tp}) or "
             f"init_params(rng, cfg, tp={tp})"
         )
+
+
+class _NeedDrain(Exception):
+    """Plan-time block growth failed while a step is in flight: the
+    planner must not preempt over uncommitted state (the victim's emitted
+    tokens may still be on device), so the async loop commits the
+    in-flight step and re-plans from settled state, where normal
+    preemption applies."""
+
+
+class _PendingFetch:
+    """In-flight device outputs of ONE dispatch plus their double-buffered
+    D2H copies. Construction enqueues ``copy_to_host_async`` on every
+    output array, so by the time :meth:`land` blocks — one full device
+    step later under async execution — the bytes have been streaming to
+    host while the next step computes. ``sr`` carries the (S, R) reshape
+    for sample-width dispatches (the legacy 2-D return shape)."""
+
+    def __init__(self, core: "EngineCore", toks, lps, sr=None):
+        self.core = core
+        self.toks = toks
+        self.lps = lps
+        self.sr = sr
+        self.no = core._note_dispatch()
+        start_host_copy(toks)
+        if lps is not None:
+            for a in lps:
+                start_host_copy(a)
+
+    def land(self):
+        core = self.core
+        if core._exec_log is not None:
+            core._exec_log.append(("land", self.no))
+        toks = fetch_replicated(self.toks)  # dynalint: sync-ok — double-buffered landing point
+        lps = self.lps
+        if lps is not None:
+            lps = tuple(fetch_replicated_many(lps))  # dynalint: sync-ok — batched logprob landing
+        if self.sr is not None:
+            # fetch_replicated already landed host np arrays; reshape to
+            # the legacy 2-D ([S, R], [S, R, ...]) sample-width views.
+            S, R = self.sr
+            toks = toks.reshape(S, R)
+            if lps is not None:
+                lps = tuple(a.reshape((S, R) + a.shape[1:]) for a in lps)
+        return toks, lps
+
+
+@dataclass
+class _PlannedStep:
+    """One planned-and-dispatched engine step awaiting commit.
+
+    The plan/dispatch/commit split is the async execution tentpole: the
+    plan side assembles host arrays and enqueues the device program(s);
+    the commit side lands the double-buffered outputs and applies every
+    piece of host bookkeeping (block commits, cursor advances, stop
+    scans, stream emission). With ``async_exec`` off, commit runs
+    immediately after plan — the classic loop. With it on, the engine
+    keeps ONE of these in flight and plans step N+1 against the
+    optimistic ``adv`` overlays before committing step N.
+    """
+
+    core: "EngineCore"
+    commit_fn: Callable[[], list]
+    # Optimistic per-lane deltas this step will apply once committed:
+    # request_id -> (d_prefilled, d_processed, d_generated). The next
+    # plan reads real-state + adv while this step is in flight.
+    adv: dict[str, tuple[int, int, int]] = field(default_factory=dict)
+    # Device-resident sampled tokens of this step (flat [S*R] or
+    # [n_steps, B]) + request_id -> flat index of each lane's newest
+    # token: the next plan's token buffer gathers from here on device.
+    feed_tokens: Any = None
+    feed_index: dict[str, int] = field(default_factory=dict)
+    # False when any lane's advance is data-dependent (verify rows with
+    # live drafts): the next plan must commit this step first.
+    deterministic: bool = True
+    committed: bool = False
+
+    def commit(self) -> list:
+        if self.committed:
+            return []
+        self.committed = True
+        t0 = time.time()
+        out = self.commit_fn()
+        core = self.core
+        core.exec_stats["commits"] += 1
+        core._tracer.record(
+            "engine_commit", t0, time.time(),
+            attrs={"outputs": len(out)}, stat=True,
+        )
+        return out
 
 
 @dataclass
@@ -470,6 +569,13 @@ class EngineCore:
                 "wired yet (the pp microbatch planner samples one row per "
                 "sequence); run spec on a tp/dp or single-chip engine"
             )
+        if engine_cfg.async_exec and (pp_mesh is not None or sp_mesh is not None):
+            raise ValueError(
+                "async_exec is not wired for pp/sp meshes yet (the pp "
+                "microbatch planner rearranges the token buffer on host, "
+                "which the device feedback gather bypasses); those engines "
+                "keep the synchronous loop"
+            )
         # Verify-row sample width: STATIC per engine so the compiled
         # program set stays O(buckets x widths x variants), not O(draft
         # lengths). Rows with shorter drafts pad the sample gather with
@@ -736,12 +842,42 @@ class EngineCore:
             "last_step_budget_utilization": 0.0,
             "chunked_prefills_in_flight": 0,
         }
+        # -- async pipelined execution (plan/dispatch/commit) ---------------
+        # At most ONE step is in flight; its _PlannedStep carries the
+        # optimistic advances the next plan overlays and the
+        # device-resident sampled tokens the next dispatch gathers from.
+        self._inflight: _PlannedStep | None = None
+        # Execution-pipeline counters (status surface + tests): drains
+        # count forced pipeline flushes (block pressure mid-plan).
+        self.exec_stats = {
+            "dispatches": 0,
+            "commits": 0,
+            "drains": 0,
+            "last_host_gap_ms": 0.0,
+        }
+        # Test hook: set to [] to record ("dispatch", n) / ("land", n)
+        # events — the pipelining contract is that dispatch n+1 precedes
+        # the landing of step n's outputs in steady-state decode.
+        self._exec_log: list[tuple[str, int]] | None = None
+        self._dispatch_no = 0
+        self._t_prev_dispatch = 0.0
+        # Admission-time prefix-cache accounting (kv_prefix_cache_admitted_*
+        # gauges). Separate from the allocator's match_prefix counters:
+        # those count router/disagg probes, these count admitted sequences
+        # whose prefix (device cache + host-tier onboard) was served.
+        self._admit_prefix_queries = 0
+        self._admit_prefix_hits = 0
 
         self._prefill = jax.jit(
             partial(_prefill_and_sample, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
             static_argnames=("need_mask", "all_greedy", "want_logprobs", "want_mm"),
             donate_argnums=(1,),
         )
+        # Device-resident token feedback: the next step's token buffer
+        # gathers just-sampled ids straight from the previous dispatch's
+        # device output (sampler.gather_feedback) — no D2H→H2D round trip
+        # on the decode critical path.
+        self._feed = jax.jit(gather_feedback)
         self.sp_mesh = sp_mesh
         self._ring = None
         if sp_mesh is not None:
@@ -878,7 +1014,71 @@ class EngineCore:
     # -- scheduling --------------------------------------------------------
 
     def has_work(self) -> bool:
-        return bool(self._inbox or self.waiting or self.running)
+        # An in-flight step is work: its outputs (possibly a stream's
+        # final tokens) are not committed until the next step() call.
+        return bool(
+            self._inbox or self.waiting or self.running
+            or self._inflight is not None
+        )
+
+    # -- optimistic overlays (async planning) -------------------------------
+
+    def _adv3(self, seq: Sequence) -> tuple[int, int, int]:
+        """Optimistic (prefilled, processed, generated) deltas the
+        in-flight step will apply to this sequence once committed —
+        (0, 0, 0) with an empty pipeline, so every plan-time computation
+        reads ``real + _adv3`` and is bit-identical to the classic
+        synchronous loop."""
+        if self._inflight is None:
+            return (0, 0, 0)
+        return self._inflight.adv.get(seq.request_id, (0, 0, 0))
+
+    def _eff_prefill_done(self, seq: Sequence) -> bool:
+        return seq.prefilled + self._adv3(seq)[0] >= seq.prompt_len
+
+    def _eff_processed(self, seq: Sequence) -> int:
+        return seq.processed + self._adv3(seq)[1]
+
+    def _eff_generated(self, seq: Sequence) -> int:
+        return seq.generated + self._adv3(seq)[2]
+
+    def _feed_src(self, seq: Sequence) -> int | None:
+        """Flat index of this lane's newest sampled token in the in-flight
+        step's device output, or None when the pending token is committed
+        host-side."""
+        if self._inflight is None:
+            return None
+        return self._inflight.feed_index.get(seq.request_id)
+
+    def _note_dispatch(self) -> int:
+        """Dispatch-side bookkeeping for the pipelining invariants: the
+        sequence number feeds the test hook (the async contract is that
+        dispatch N+1 precedes the landing of step N's outputs), and the
+        host-side WALL-CLOCK gap between consecutive dispatch enqueues is
+        recorded as the ``host_gap`` stat — an upper bound on device
+        idle when the pipeline is empty, fully covered by the in-flight
+        step when it is not (``overlapped`` attr). The mocker records the
+        same stat name from its cost model's exact device-idle term; the
+        two track the same bottleneck but are not numerically comparable."""
+        self._dispatch_no += 1
+        self.exec_stats["dispatches"] += 1
+        now = time.time()
+        if self._t_prev_dispatch:
+            self.exec_stats["last_host_gap_ms"] = (
+                (now - self._t_prev_dispatch) * 1e3
+            )
+            self._tracer.record(
+                "host_gap", self._t_prev_dispatch, now,
+                attrs={
+                    "dispatch": self._dispatch_no,
+                    "overlapped": self._inflight is not None,
+                },
+                stat=True,
+            )
+        self._t_prev_dispatch = now
+        if self._exec_log is not None:
+            self._exec_log.append(("dispatch", self._dispatch_no))
+        return self._dispatch_no
 
     def _bucket_for(self, n: int) -> int:
         """Token-budget bucket: total ragged tokens in a prefill wave."""
@@ -950,6 +1150,14 @@ class EngineCore:
                 self.allocator.release(seq.prompt_hashes[:ncached])
                 return
             self.waiting.popleft()
+            # Admission-time prefix accounting (one query per ADMITTED
+            # sequence — watermark retries don't double-count). DEDICATED
+            # counters: the allocator's prefix_queries/prefix_hits belong
+            # to match_prefix probes (router/disagg), and sharing them
+            # would double-count requests that are probed AND admitted.
+            self._admit_prefix_queries += 1
+            if ncached:
+                self._admit_prefix_hits += 1
             seq.block_ids = cached_ids + new_ids
             seq.committed_blocks = ncached
             seq.pinned_hashes = list(seq.prompt_hashes[:ncached])
@@ -1026,7 +1234,8 @@ class EngineCore:
     def _dispatch_ragged(
         self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
         n_sample: list[int] | None = None,
-    ):
+        feed_rows: list[int | None] | None = None,
+    ) -> _PendingFetch:
         """Assemble and run ONE ragged forward + fused sampling over
         arbitrary rows. Each row is ``(seq, tokens, pos_start, kv_len)``:
         a prefill chunk (tokens sliced from the prompt), a decode row
@@ -1044,9 +1253,17 @@ class EngineCore:
         target choices), everything else samples only the last position.
         The sample gather widens to the engine's static ``spec_k + 1``
         whenever any row speculates — short drafts pad with duplicate
-        reads — so draft length never mints new compiled programs. With
-        ``n_sample`` the return is 2-D ([S, R] tokens, [S, R, ...]
-        logprobs); without it, the legacy 1-D shapes."""
+        reads — so draft length never mints new compiled programs.
+
+        ``feed_rows`` (aligned with rows) carries the device-resident
+        token feedback: a non-None entry is the flat index of that row's
+        FIRST token in the in-flight step's sampled-token output, and the
+        host placeholder at that slot is overridden by an on-device
+        gather — the just-sampled id never round-trips through the host.
+
+        Returns a :class:`_PendingFetch`; ``land()`` yields the legacy
+        shapes — 2-D ([S, R] tokens, [S, R, ...] logprobs) with
+        ``n_sample``, 1-D without."""
         P = self.engine.max_blocks_per_seq
         bs = self.engine.block_size
         total = sum(len(tl) for _, tl, _, _ in rows)
@@ -1077,25 +1294,35 @@ class EngineCore:
         top_p = np.ones(S, np.float32)
 
         t = 0
+        feed_idx = None
+        if feed_rows is not None and any(f is not None for f in feed_rows):
+            feed_idx = np.full(T, -1, np.int32)
         for i, (seq, toks_list, pos0, kv_len) in enumerate(rows):
             chunk = len(toks_list)
             pos = np.arange(pos0, pos0 + chunk, dtype=np.int32)
             tokens[t : t + chunk] = toks_list
             positions[t : t + chunk] = pos
-            ids = np.asarray(seq.block_ids, np.int32)
+            ids = np.asarray(seq.block_ids, np.int32)  # dynalint: sync-ok — host list, not a device array
             write_pages[t : t + chunk] = ids[pos // bs]
             write_offs[t : t + chunk] = pos % bs
             kv_lens[i] = kv_len
             tables[i, : len(ids)] = ids
             last_rows[i] = t + chunk - 1
+            # Counters read through the optimistic overlay: with a step in
+            # flight the lane's generated count lags by exactly the tokens
+            # the in-flight step will commit, and the replayed (seed,
+            # counter) keys must match the synchronous loop bit-for-bit.
+            gen0 = seq.generated + self._adv3(seq)[2]
             if n_sample is not None and n_sample[i] > 1:
                 j = np.arange(R, dtype=np.int32)
                 off = np.minimum(j, chunk - 1)
                 gather[i] = t + off
-                counters[i] = seq.generated + off
+                counters[i] = gen0 + off
             else:
                 gather[i] = t + chunk - 1
-                counters[i] = seq.generated
+                counters[i] = gen0
+            if feed_idx is not None and feed_rows[i] is not None:
+                feed_idx[t] = feed_rows[i]
             seeds[i] = seq.seed
             temp[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
@@ -1173,10 +1400,18 @@ class EngineCore:
             # fused sampler treats them as S*R independent lanes (with
             # R == 1 these are bit-for-bit the legacy shapes, so the
             # no-speculation program cache is untouched).
+            tok_in = jnp.asarray(tokens)
+            if feed_idx is not None:
+                # Device-resident feedback: override the placeholder slots
+                # with just-sampled ids straight from the in-flight step's
+                # output — enqueued on the device stream, never blocking.
+                tok_in = self._feed(
+                    self._inflight.feed_tokens, tok_in, jnp.asarray(feed_idx)
+                )
             toks, lps, self.cache = self._prefill(
                 self.params,
                 self.cache,
-                jnp.asarray(tokens),
+                tok_in,
                 jnp.asarray(positions),
                 jnp.asarray(write_pages),
                 jnp.asarray(write_offs),
@@ -1197,57 +1432,80 @@ class EngineCore:
                 want_logprobs=want_lp,
                 want_mm=want_mm,
             )
-        toks = fetch_replicated(toks)
-        lps = None if lps is None else tuple(fetch_replicated(a) for a in lps)
-        if n_sample is None:
-            return toks, lps
-        toks = np.asarray(toks).reshape(S, R)
-        if lps is not None:
-            lps = tuple(
-                np.asarray(a).reshape((S, R) + np.asarray(a).shape[1:])
-                for a in lps
-            )
-        return toks, lps
+        return _PendingFetch(
+            self, toks, lps, sr=(S, R) if n_sample is not None else None
+        )
 
-    def _run_prefill_wave(self, seqs: list[Sequence]):
-        """One ragged dispatch prefills up to ``prefill_batch`` sequences
+    def _plan_prefill_wave(self, seqs: list[Sequence]) -> _PlannedStep | None:
+        """Plan one ragged prefill wave: up to ``prefill_batch`` sequences
         under a shared token budget (largest prefill bucket) — different
-        chunk lengths pack into one token buffer with no per-lane padding.
-        First-token sampling is fused into the same program; returns
-        [(seq, chunk, sampled_or_None)] with the sampled token for every
-        sequence that completed its prompt this wave."""
+        chunk lengths pack into one token buffer with no per-lane padding,
+        first-token sampling fused into the same program. The commit side
+        lands the sampled tokens and emits for every sequence whose
+        prompt completed this wave. Chunk cursors read through the
+        optimistic overlay, so consecutive waves of one long prompt
+        pipeline under async execution."""
         S = self.engine.prefill_batch
         budget = self.engine.prefill_buckets[-1]
-        chosen: list[tuple[Sequence, int]] = []
+        chosen: list[tuple[Sequence, int, int]] = []  # (seq, p0, chunk)
         total = 0
         for seq in seqs:
             if len(chosen) == S or total >= budget:
                 break
-            chunk = min(seq.prompt_len - seq.prefilled, budget - total)
+            p0 = seq.prefilled + self._adv3(seq)[0]
+            chunk = min(seq.prompt_len - p0, budget - total)
             if chunk <= 0:
                 continue
-            chosen.append((seq, chunk))
+            chosen.append((seq, p0, chunk))
             total += chunk
+        if not chosen:
+            return None
         t_disp = time.time()
         rows: list[tuple[Sequence, list[int], int, int]] = []
-        for seq, chunk in chosen:
+        for seq, p0, chunk in chosen:
             self._mark_first_sched(seq, t_disp)
-            rows.append((
-                seq,
-                seq.prompt[seq.prefilled : seq.prefilled + chunk],
-                seq.prefilled,
-                seq.prefilled + chunk,
-            ))
-        toks, lps = self._dispatch_ragged(rows, S)
+            rows.append((seq, seq.prompt[p0 : p0 + chunk], p0, p0 + chunk))
+        pend = self._dispatch_ragged(rows, S)
+        adv: dict[str, tuple[int, int, int]] = {}
+        feed_index: dict[str, int] = {}
+        for i, (seq, p0, chunk) in enumerate(chosen):
+            done = p0 + chunk >= seq.prompt_len
+            adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
+            if done:
+                feed_index[seq.request_id] = i
 
-        out = []
-        now = time.time()
-        for i, (seq, chunk) in enumerate(chosen):
-            tok, lp = self._advance_prefill_chunk(
-                seq, chunk, toks, lps, i, t_disp, now
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            toks, lps = pend.land()
+            outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+            now = time.time()
+            live = {id(s) for s in self.running}
+            for i, (seq, p0, chunk) in enumerate(chosen):
+                if seq.finish is not None or seq.cancelled or id(seq) not in live:
+                    continue  # lane left the scheduler while in flight
+                tok, lp = self._advance_prefill_chunk(
+                    seq, chunk, toks, lps, i, t_disp, now
+                )
+                if tok is None:
+                    continue  # prompt not finished this wave
+                seq.pending = tok
+                seq.generated += 1
+                outputs.append((seq, self._emit(seq, tok, lp)))
+                if seq.finish is not None:
+                    self._finish(seq)
+            self._tracer.record(
+                "engine_prefill_step", t_disp, time.time(),
+                attrs={
+                    "seqs": len(chosen),
+                    "tokens": sum(chunk for _, _, chunk in chosen),
+                },
+                stat=True,
             )
-            out.append((seq, chunk, tok, lp))
-        return out
+            return outputs
+
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            feed_tokens=pend.toks, feed_index=feed_index,
+        )
 
     def _advance_prefill_chunk(
         self, seq: Sequence, chunk: int, toks, lps, i: int,
@@ -1351,7 +1609,7 @@ class EngineCore:
         seq.generated += 1
         lp = None
         if want_lp and lps is not None:
-            lps = tuple(fetch_replicated(a) for a in lps)
+            lps = tuple(fetch_replicated_many(lps))
             lp = _lp_entry(tok, lps[0][0], lps[1][0], lps[2][0], seq.logprobs)
         out = self._emit(seq, tok, lp)
         if seq.finish is not None:
@@ -1373,6 +1631,11 @@ class EngineCore:
             if self._grow_blocks(seq, n_tokens):
                 ready.append(seq)
                 continue
+            if self._inflight is not None:
+                # Block pressure mid-plan with a step in flight: the
+                # async loop drains the pipeline and re-plans from
+                # settled state, where preemption is safe.
+                raise _NeedDrain(seq.request_id)
             victim = next((s for s in reversed(self.running) if s is not seq), None)
             if victim is not None:
                 self._preempt(victim)
@@ -1384,9 +1647,12 @@ class EngineCore:
 
     def _grow_blocks(self, seq: Sequence, n_tokens: int) -> bool:
         """Ensure physical blocks exist for the next ``n_tokens`` decode
-        writes (positions processed .. processed+n_tokens-1)."""
+        writes (positions processed .. processed+n_tokens-1, read through
+        the optimistic overlay so an in-flight step's writes are already
+        covered)."""
         bs = self.engine.block_size
-        need = (seq.processed + n_tokens - 1) // bs + 1 - len(seq.block_ids)
+        base = self._eff_processed(seq)
+        need = (base + n_tokens - 1) // bs + 1 - len(seq.block_ids)
         grabbed: list[int] = []
         for _ in range(max(0, need)):
             try:
@@ -1439,7 +1705,17 @@ class EngineCore:
         seq.block_ids = seq.block_ids[: seq.committed_blocks]
         seq.pinned_hashes = []
 
-    def _run_decode(self, seqs: list[Sequence], n_steps: int) -> Any:
+    def _run_decode(
+        self, seqs: list[Sequence], n_steps: int,
+        feed_lanes: list[int | None] | None = None,
+    ) -> _PendingFetch:
+        """Dispatch one fused decode+sample chain. ``feed_lanes`` (aligned
+        with seqs) carries device-resident token feedback: a non-None
+        entry is the flat index of that lane's pending token in the
+        in-flight step's sampled output, gathered on device instead of
+        round-tripping through the host. Cursor/counter inputs read
+        through the optimistic overlay. Returns a pending fetch whose
+        ``land()`` yields ([n_steps, B] tokens, lp arrays or None)."""
         B = self._decode_width(len(seqs))
         seqs = seqs[:B]
         tokens = np.zeros(B, np.int32)
@@ -1453,26 +1729,37 @@ class EngineCore:
         top_p = np.ones(B, np.float32)
         seeds = np.zeros(B, np.int32)
         counters = np.zeros(B, np.int32)
+        feed_idx = None
+        if feed_lanes is not None and any(f is not None for f in feed_lanes):
+            feed_idx = np.full(B, -1, np.int32)
         for i, seq in enumerate(seqs):
-            tokens[i] = seq.pending
-            positions[i] = seq.processed
+            if feed_idx is not None and i < len(feed_lanes) and feed_lanes[i] is not None:
+                feed_idx[i] = feed_lanes[i]
+            else:
+                tokens[i] = seq.pending
+            positions[i] = self._eff_processed(seq)
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
             temp[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
             seeds[i] = seq.seed
-            counters[i] = seq.generated
+            counters[i] = self._eff_generated(seq)
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
         )
         want_lp = any(s.logprobs is not None for s in seqs)
         all_greedy = all(s.sampling.temperature == 0.0 for s in seqs)
+        tok_in = self._put_batch(tokens)
+        if feed_idx is not None:
+            tok_in = self._feed(
+                self._inflight.feed_tokens, tok_in, jnp.asarray(feed_idx)
+            )
         decode_fn = self._decode_pp if self.pp_mesh is not None else self._decode
         out, lps, self.cache = decode_fn(
             self.params,
             self.cache,
-            self._put_batch(tokens),
+            tok_in,
             self._put_batch(tables),
             self._put_batch(positions),
             self._put_batch(active),
@@ -1486,20 +1773,76 @@ class EngineCore:
             all_greedy=all_greedy,
             want_logprobs=want_lp,
         )
-        if lps is not None:
-            lps = tuple(fetch_replicated(a) for a in lps)
-        return fetch_replicated(out), lps  # [n_steps, B], lp arrays or None
+        return _PendingFetch(self, out, lps)  # [n_steps, B] on land()
 
     # -- the iteration -----------------------------------------------------
 
     def step(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         """One engine iteration; returns (sequence, output-chunk) pairs.
-        A chunk with ``finish_reason`` set is the sequence's last."""
+        A chunk with ``finish_reason`` set is the sequence's last.
+
+        With ``async_exec`` off, the step plans, dispatches, and commits
+        in place — the classic synchronous loop. With it on, the step
+        plans and dispatches iteration N+1 BEFORE committing iteration N
+        (one-step-ahead pipelining), so the returned outputs lag the
+        dispatch by exactly one call; the token stream is bit-identical
+        either way."""
         with self._step_lock:
             return self._step_locked()
 
     def _step_locked(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+        if self.engine.async_exec:
+            outputs = self._step_async()
+        else:
+            self.iterations += 1
+            plan = self._plan_step()
+            outputs = plan.commit() if plan is not None else []
+        if self._inflight is None and not (
+            self.running or self.waiting or self._inbox
+        ):
+            # Engine going idle: break the host_gap chain so the next
+            # burst's first dispatch doesn't record request inter-arrival
+            # time as per-dispatch host overhead.
+            self._t_prev_dispatch = 0.0
+        return outputs
+
+    def _step_async(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+        """One-step-ahead iteration: plan and enqueue the next step while
+        the previous one executes on device, then commit the previous
+        step's double-buffered outputs — block-table assembly, stop
+        scans, and stream emission overlap device compute instead of
+        serializing with it. Steps whose advances are data-dependent
+        (verify rows with live drafts) commit before the next plan; block
+        pressure mid-plan drains the pipeline and re-plans settled."""
+        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+        # One engine iteration per step() call, even when a drain re-plans
+        # (a double increment would skew the mixed-step fairness rotation
+        # and the iteration trace attrs versus the synchronous schedule).
         self.iterations += 1
+        if self._inflight is not None and not self._inflight.deterministic:
+            outputs.extend(self._commit_inflight())
+        try:
+            plan = self._plan_step()
+        except _NeedDrain:
+            self.exec_stats["drains"] += 1
+            outputs.extend(self._commit_inflight())
+            plan = self._plan_step()
+        prev, self._inflight = self._inflight, plan
+        if prev is not None:
+            outputs.extend(prev.commit())
+        return outputs
+
+    def _commit_inflight(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+        prev, self._inflight = self._inflight, None
+        return prev.commit() if prev is not None else []
+
+    def _plan_step(self) -> _PlannedStep | None:
+        """Plan + dispatch one engine iteration (no commit): drain
+        intake, admit under the watermark, then assemble and enqueue the
+        iteration's device program(s). All cursor reads go through the
+        optimistic overlay, so planning over an in-flight step sees the
+        state that step will commit. The caller owns the iteration
+        counter (a drain calls this twice for one engine step)."""
         self._sweep_expired_holds()
 
         for seq in [s for s in self.running if s.cancelled]:
@@ -1507,113 +1850,208 @@ class EngineCore:
             self._release_blocks(seq)
 
         self._admit()
-
+        t_plan = time.time()
         if self._sched_chunked:
-            prefills = [s for s in self.running if not s.prefill_done]
-            if prefills:
-                return self._step_mixed(prefills)
-            # No prefill work: pure decode rides the fused chains — chunked
-            # scheduling only reshapes steps that mix the two phases.
-            return self._step_decode([])
-        return self._step_waves()
+            prefills = [
+                s for s in self.running if not self._eff_prefill_done(s)
+            ]
+            plan = (
+                self._plan_mixed(prefills) if prefills else self._plan_decode()
+            )
+        else:
+            plan = self._plan_waves()
+        if plan is not None:
+            self._tracer.record(
+                "engine_plan", t_plan, time.time(),
+                attrs={
+                    "iteration": self.iterations,
+                    "pipelined": self._inflight is not None,
+                },
+                stat=True,
+            )
+        return plan
 
-    def _step_waves(self) -> list[tuple[Sequence, LLMEngineOutput]]:
+    def _plan_waves(self) -> _PlannedStep | None:
         """Prefill-priority scheduling: one monolithic prefill wave
         strictly before any decode (the classic vLLM-default shape)."""
-        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
-        prefills = [s for s in self.running if not s.prefill_done]
+        prefills = [s for s in self.running if not self._eff_prefill_done(s)]
         if prefills:
             t_wave = time.time()
             ring_out = self._maybe_ring_prefill(prefills)
             if ring_out is not None:
-                outputs.extend(ring_out)
+                # The ring path runs synchronously (sp engines keep the
+                # classic loop); wrap its already-committed outputs.
                 self._tracer.record(
                     "engine_prefill_step", t_wave, time.time(),
                     attrs={"seqs": len(prefills), "ring": True}, stat=True,
                 )
-                return outputs
-            wave = self._run_prefill_wave(prefills)
-            for seq, _chunk, tok, lp in wave:
-                if tok is None:
-                    continue  # prompt not finished this wave
-                seq.pending = tok
-                seq.generated += 1
-                outputs.append((seq, self._emit(seq, tok, lp)))
-                if seq.finish is not None:
+                return _PlannedStep(core=self, commit_fn=lambda: ring_out)
+            return self._plan_prefill_wave(prefills)
+        return self._plan_decode()
+
+    def _decode_candidates(self) -> list[Sequence]:
+        """Runnable decode lanes under the optimistic overlay. Lanes whose
+        in-flight step is guaranteed to finish them (generation budget or
+        context edge reached) are excluded — the synchronous loop would
+        have removed them before this iteration, so scheduling them would
+        both waste a slot and write past the block table."""
+        out: list[Sequence] = []
+        for s in self.running:
+            dpre, dproc, dgen = self._adv3(s)
+            if s.pending is None and dgen == 0:
+                continue  # no sampled token yet (still prefilling)
+            if not self._eff_prefill_done(s):
+                continue
+            if (
+                s.stop.max_tokens is not None
+                and s.generated + dgen >= s.stop.max_tokens
+            ):
+                continue  # finishes (length) in flight
+            if self.engine.max_model_len - (s.processed + dproc) < 1:
+                continue  # context edge reached in flight
+            out.append(s)
+        return out
+
+    def _plan_decode(self) -> _PlannedStep | None:
+        """Plan one decode iteration: speculating lanes peel off into a
+        batched verify dispatch (draft tokens verify as ragged q_len=k+1
+        rows); the rest ride one fused decode+sample chain. Both
+        dispatches share one planned step — their commits run in order.
+
+        ALL block growth happens before ANY dispatch: block pressure must
+        surface (preemption, or _NeedDrain under async) while this plan
+        has enqueued nothing, so a drain never abandons an already-
+        dispatched device step."""
+        decoding = self._decode_candidates()
+        if not decoding:
+            return None
+        spec_lanes = [s for s in decoding if s.spec is not None]
+        chain_lanes = [s for s in decoding if s.spec is None]
+        chain_ready: list[Sequence] = []
+        n_steps = 0
+        if chain_lanes:
+            n_steps = self._chain_length(chain_lanes)
+            chain_ready = self._grow_or_preempt(chain_lanes, n_steps)
+        parts: list[_PlannedStep] = []
+        if spec_lanes:
+            # Verify growth (and any preemption it causes) also precedes
+            # its dispatch, inside _plan_verify.
+            vplan = self._plan_verify(
+                [s for s in spec_lanes if s in self.running]
+            )
+            if vplan is not None:
+                parts.append(vplan)
+        # A verify preemption may have evicted a chain candidate.
+        chain_ready = [s for s in chain_ready if s in self.running]
+        if chain_ready:
+            cplan = self._plan_chain(chain_ready, n_steps)
+            if cplan is not None:
+                parts.append(cplan)
+        return self._merge_plans(parts)
+
+    def _merge_plans(self, parts: list[_PlannedStep]) -> _PlannedStep | None:
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            out: list[tuple[Sequence, LLMEngineOutput]] = []
+            for p in parts:
+                p.committed = True  # bypass the per-part wrapper
+                out.extend(p.commit_fn())
+            return out
+
+        adv: dict[str, tuple[int, int, int]] = {}
+        for p in parts:
+            adv.update(p.adv)
+        # A multi-dispatch step (spec + chain lanes in one batch) never
+        # feeds the next plan directly: the feedback gather reads ONE
+        # device array, and each part has its own — so the merged plan is
+        # conservatively non-deterministic and commits before the next
+        # plan, even when no drafts were proposed. Mixed spec/non-spec
+        # decode batches therefore run unpipelined; pure batches of
+        # either kind keep the one-step-ahead overlap. (Lifting this
+        # needs a multi-source feed gather — future work.)
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            deterministic=all(p.deterministic for p in parts)
+            and all(not p.feed_index for p in parts),
+        )
+
+    def _plan_chain(
+        self, ready: list[Sequence], n_steps: int
+    ) -> _PlannedStep | None:
+        """Plan one fused decode+sample chain over non-speculating lanes
+        (the caller already grew their blocks — _plan_decode front-loads
+        growth before any dispatch); the commit side scans stops, commits
+        K/V bookkeeping, and emits whole-chain chunks."""
+        if not ready:
+            return None
+        t_decode = time.time()
+        feed_lanes = [self._feed_src(s) for s in ready]
+        pend = self._run_decode(ready, n_steps, feed_lanes=feed_lanes)
+        adv = {
+            s.request_id: (0, n_steps, n_steps) for s in ready
+        }
+        # Each lane's newest token is the chain's LAST sampled row:
+        # flat index (n_steps-1)*B + lane in the [n_steps, B] output.
+        B = self._decode_width(len(ready))
+        feed_index = {
+            s.request_id: (n_steps - 1) * B + i for i, s in enumerate(ready)
+        }
+
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+            emitted_total = 0
+            chained, lps = pend.land()  # [n_steps, len(ready)]
+            live = {id(s) for s in self.running}
+            for i, seq in enumerate(ready):
+                if seq.finish is not None or seq.cancelled or id(seq) not in live:
+                    continue  # late finish/preempt: discard the optimistic chain
+                toks = chained[:, i]
+                k, finish = self._scan_stop(seq, toks)
+                # Cache writes this chain: the old pending token plus the
+                # first k-1 sampled tokens (each step writes the current
+                # token's K/V, then samples the next).
+                written = [seq.pending] + [int(t) for t in toks[: k - 1]]
+                completed = seq.hashed.extend(written)
+                self._commit_completed(seq, completed)
+                seq.processed += k
+                seq.generated += k
+                emitted = [int(t) for t in toks[:k]]
+                lp_entries = None
+                if lps is not None and seq.logprobs is not None:
+                    lp_entries = [
+                        _lp_entry(
+                            emitted[j], lps[0][j][i], lps[1][j][i], lps[2][j][i],
+                            seq.logprobs,
+                        )
+                        for j in range(k)
+                    ]
+                outputs.append(
+                    (seq, self._emit_chunk(seq, emitted, lp_entries, finish))
+                )
+                emitted_total += len(emitted)
+                if finish is not None:
+                    seq.finish = finish
                     self._finish(seq)
+                else:
+                    seq.pending = emitted[-1]
             self._tracer.record(
-                "engine_prefill_step", t_wave, time.time(),
+                "engine_decode_step", t_decode, time.time(),
                 attrs={
-                    "seqs": len(wave),
-                    "tokens": sum(chunk for _, chunk, _, _ in wave),
+                    "seqs": len(ready), "chain": n_steps,
+                    "tokens": emitted_total,
                 },
                 stat=True,
             )
             return outputs
-        return self._step_decode(outputs)
 
-    def _step_decode(
-        self, outputs: list[tuple[Sequence, LLMEngineOutput]]
-    ) -> list[tuple[Sequence, LLMEngineOutput]]:
-        """One fused decode+sample chain over every runnable sequence.
-        Speculating sequences peel off into a batched verify step first
-        (draft tokens verify as ragged q_len=k+1 rows); the rest keep the
-        fused chains."""
-        decoding = [s for s in self.running if s.pending is not None]
-        if not decoding:
-            return outputs
-        if any(s.spec is not None for s in decoding):
-            outputs = self._step_verify(
-                [s for s in decoding if s.spec is not None], outputs
-            )
-            # A verify preemption may have evicted a chain candidate.
-            decoding = [
-                s for s in decoding if s.spec is None and s in self.running
-            ]
-            if not decoding:
-                return outputs
-        n_steps = self._chain_length(decoding)
-        ready = self._grow_or_preempt(decoding, n_steps)
-        if not ready:
-            return outputs
-
-        t_decode = time.time()
-        emitted_total = 0
-        chained, lps = self._run_decode(ready, n_steps)  # [n_steps, len(ready)]
-        for i, seq in enumerate(ready):
-            toks = chained[:, i]
-            k, finish = self._scan_stop(seq, toks)
-            # Cache writes this chain: the old pending token plus the
-            # first k-1 sampled tokens (each step writes the current
-            # token's K/V, then samples the next).
-            written = [seq.pending] + [int(t) for t in toks[: k - 1]]
-            completed = seq.hashed.extend(written)
-            self._commit_completed(seq, completed)
-            seq.processed += k
-            seq.generated += k
-            emitted = [int(t) for t in toks[:k]]
-            lp_entries = None
-            if lps is not None and seq.logprobs is not None:
-                lp_entries = [
-                    _lp_entry(
-                        emitted[j], lps[0][j][i], lps[1][j][i], lps[2][j][i],
-                        seq.logprobs,
-                    )
-                    for j in range(k)
-                ]
-            outputs.append((seq, self._emit_chunk(seq, emitted, lp_entries, finish)))
-            emitted_total += len(emitted)
-            if finish is not None:
-                seq.finish = finish
-                self._finish(seq)
-            else:
-                seq.pending = emitted[-1]
-        self._tracer.record(
-            "engine_decode_step", t_decode, time.time(),
-            attrs={"seqs": len(ready), "chain": n_steps, "tokens": emitted_total},
-            stat=True,
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            feed_tokens=pend.toks, feed_index=feed_index,
         )
-        return outputs
 
     # -- speculative decoding (draft + batched ragged verify) ---------------
 
@@ -1624,14 +2062,19 @@ class EngineCore:
         waste — the stop scan would discard it)."""
         sc = seq.spec
         d_cap = min(
-            sc.k, max_extra, self.engine.max_model_len - seq.processed - 1
+            sc.k, max_extra,
+            self.engine.max_model_len - self._eff_processed(seq) - 1,
         )
         if seq.stop.max_tokens is not None:
-            d_cap = min(d_cap, seq.stop.max_tokens - seq.generated - 1)
+            d_cap = min(d_cap, seq.stop.max_tokens - self._eff_generated(seq) - 1)
         if d_cap <= 0:
             return []
         # out_tokens ends with the pending token, so proposals continue
-        # exactly the sequence the verify row will feed. Only the last
+        # exactly the sequence the verify row will feed. (Under async
+        # execution the history lags by the in-flight tokens — the
+        # device-fed pending is not host-visible yet; proposals are then
+        # one step stale, which can only change WHICH tokens are drafted,
+        # never which tokens are emitted.) Only the last
         # window+ngram_max tokens can ever match, so hand the drafter
         # that tail — a full prompt+output concat would be O(context)
         # per lane per step on the decode hot path.
@@ -1693,23 +2136,30 @@ class EngineCore:
             seq.pending = emitted[-1]
         return out, d, a
 
-    def _step_verify(
-        self, seqs: list[Sequence],
-        outputs: list[tuple[Sequence, LLMEngineOutput]],
-    ) -> list[tuple[Sequence, LLMEngineOutput]]:
-        """One batched verify step over speculating decode sequences:
+    def _plan_verify(self, seqs: list[Sequence]) -> _PlannedStep | None:
+        """Plan one batched verify step over speculating decode sequences:
         every row is pending + up to k drafted tokens in the SAME ragged
         program shape the schedulers already dispatch, so k+1 target
         forwards ride one device invocation. Draft tokens count against
-        the per-step token budget."""
+        the per-step token budget.
+
+        Under async execution each row CONSUMES the device-resident
+        pending token (the verify row's first slot gathers it from the
+        in-flight step's output); the drafter proposes from host history,
+        which lags by the in-flight tokens — proposal quality dips one
+        step, token values never change (verification replays the
+        target's own counter-keyed choices). A step carrying live drafts
+        advances data-dependently, so it is marked non-deterministic and
+        the async loop commits it before planning over it."""
         t0 = time.time()
         ready = self._grow_or_preempt(seqs, 1)
         ready = ready[: self.engine.decode_buckets[-1]]
         if not ready:
-            return outputs
+            return None
         budget = self.engine.token_budget
         rows: list[tuple[Sequence, list[int], int, int]] = []
         drafts: list[list[int]] = []
+        feed_rows: list[int | None] = []
         total = 0
         for idx, seq in enumerate(ready):
             if total + 1 > budget:
@@ -1720,13 +2170,15 @@ class EngineCore:
             draft = self._draft_for(seq, budget - total - 1 - lanes_after)
             if draft and not self._grow_blocks(seq, 1 + len(draft)):
                 draft = []  # block pressure: verify degrades to q_len=1
-            cursor = seq.num_computed_tokens
-            toks = [seq.pending] + draft
+            cursor = self._eff_processed(seq)
+            src = self._feed_src(seq)
+            toks = [0 if src is not None else seq.pending] + draft
             rows.append((seq, toks, cursor, cursor + len(toks)))
             drafts.append(draft)
+            feed_rows.append(src)
             total += len(toks)
         if not rows:
-            return outputs
+            return None
         t_draft = time.time()
         n_draft_rows = sum(1 for d in drafts if d)
         if n_draft_rows:
@@ -1738,54 +2190,78 @@ class EngineCore:
                 },
                 stat=True,
             )
-        toks, lps = self._dispatch_ragged(
+        pend = self._dispatch_ragged(
             rows, self._decode_width(len(rows)),
             n_sample=[len(tl) for _, tl, _, _ in rows],
+            feed_rows=feed_rows,
         )
-        drafted_total = accepted_total = emitted_total = 0
-        for i, ((seq, _, _, _), draft) in enumerate(zip(rows, drafts)):
-            out, d, a = self._apply_verify_row(seq, draft, toks[i], lps, i)
-            outputs.append((seq, out))
-            drafted_total += d
-            accepted_total += a
-            emitted_total += len(out.token_ids)
-        if n_draft_rows:
-            # A step "carried a verify row" only when something was
-            # actually drafted — no-match steps are plain decode steps
-            # (same accounting as the mocker, so real and mock workers
-            # export identical series).
-            self.spec_stats.verify_steps += 1
-            self._tracer.record(
-                "spec_verify", t_draft, time.time(),
-                attrs={
-                    "seqs": n_draft_rows, "drafted": drafted_total,
-                    "accepted": accepted_total, "tokens": emitted_total,
-                },
-                stat=True,
-            )
-        return outputs
+        # No live drafts -> every row advances exactly one token (a plain
+        # decode row in verify clothing): the step pipelines like any
+        # decode step, and the sample width is R == 1, so each lane's
+        # newest token sits at flat index i.
+        deterministic = n_draft_rows == 0
+        adv = {seq.request_id: (0, 1, 1) for seq, _, _, _ in rows}
+        feed_index = (
+            {seq.request_id: i for i, (seq, _, _, _) in enumerate(rows)}
+            if deterministic
+            else {}
+        )
 
-    def _step_mixed(
-        self, prefills: list[Sequence]
-    ) -> list[tuple[Sequence, LLMEngineOutput]]:
-        """One chunked-scheduling step: every runnable decode sequence
-        rides as a q_len=1 row NEXT TO prefill chunks in the same ragged
-        program, under the ``max_num_batched_tokens`` budget. A long
-        prompt streams through ceil(P/chunk) steps while in-flight
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+            toks, lps = pend.land()
+            drafted_total = accepted_total = emitted_total = 0
+            live = {id(s) for s in self.running}
+            for i, ((seq, _, _, _), draft) in enumerate(zip(rows, drafts)):
+                if seq.finish is not None or seq.cancelled or id(seq) not in live:
+                    continue  # late finish/preempt: discard the row
+                out, d, a = self._apply_verify_row(seq, draft, toks[i], lps, i)
+                outputs.append((seq, out))
+                drafted_total += d
+                accepted_total += a
+                emitted_total += len(out.token_ids)
+            if n_draft_rows:
+                # A step "carried a verify row" only when something was
+                # actually drafted — no-match steps are plain decode steps
+                # (same accounting as the mocker, so real and mock workers
+                # export identical series).
+                self.spec_stats.verify_steps += 1
+                self._tracer.record(
+                    "spec_verify", t_draft, time.time(),
+                    attrs={
+                        "seqs": n_draft_rows, "drafted": drafted_total,
+                        "accepted": accepted_total, "tokens": emitted_total,
+                    },
+                    stat=True,
+                )
+            return outputs
+
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            feed_tokens=pend.toks, feed_index=feed_index,
+            deterministic=deterministic,
+        )
+
+    def _plan_mixed(self, prefills: list[Sequence]) -> _PlannedStep | None:
+        """Plan one chunked-scheduling step: every runnable decode
+        sequence rides as a q_len=1 row NEXT TO prefill chunks in the
+        same ragged program, under the ``max_num_batched_tokens`` budget.
+        A long prompt streams through ceil(P/chunk) steps while in-flight
         decodes keep emitting one token per step — prefill waves no
         longer stall decodes, and new arrivals stop queueing behind whole
         waves (PERF.md r5: saturated TTFT is admission shaping, not a
-        kernel gap)."""
-        outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+        kernel gap). Under async execution, decode rows gather their
+        pending token from the in-flight step's device output and chunk
+        cursors read through the optimistic overlay, so mixed steps
+        pipeline exactly like pure-decode steps (speculating rows with
+        live drafts mark the step non-deterministic)."""
         t_step = time.time()
         budget = self.engine.token_budget
         chunk_cap = self.engine.chunk_size
         bs = self.engine.block_size
         S_max = self.engine.decode_buckets[-1]
 
-        decoding = [
-            s for s in self.running if s.prefill_done and s.pending is not None
-        ]
+        decoding = self._decode_candidates()
         # Reserve one row + headroom for a prefill chunk so a full decode
         # batch can never starve admission; rotate which decode lanes sit
         # out so no single stream stalls repeatedly.
@@ -1800,6 +2276,7 @@ class EngineCore:
         rows: list[tuple[Sequence, list[int], int, int]] = []
         kinds: list[str] = []
         drafts: list[list[int]] = []
+        feed_rows: list[int | None] = []
         total = 0
         # Speculating lanes may draft up to spec_k extra tokens, but the
         # mixed step keeps one block-sized chunk of budget in reserve so
@@ -1818,11 +2295,13 @@ class EngineCore:
                 )
                 if draft and not self._grow_blocks(seq, 1 + len(draft)):
                     draft = []
-            cursor = seq.num_computed_tokens
-            row_toks = [seq.pending] + draft
+            cursor = self._eff_processed(seq)
+            src = self._feed_src(seq)
+            row_toks = [0 if src is not None else seq.pending] + draft
             rows.append((seq, row_toks, cursor, cursor + len(row_toks)))
             kinds.append("v" if seq.spec is not None else "d")
             drafts.append(draft)
+            feed_rows.append(src)
             total += len(row_toks)
         n_decode = len(rows)
         decode_row_tokens = total  # decode + drafted verify tokens
@@ -1847,7 +2326,8 @@ class EngineCore:
             room = min(budget - total, chunk_cap)
             if room <= 0:
                 break
-            remaining = seq.prompt_len - seq.num_computed_tokens
+            p0 = seq.prefilled + self._adv3(seq)[0]
+            remaining = seq.prompt_len - p0
             chunk = min(remaining, room)
             if chunk < remaining:
                 # Non-final chunks split on block boundaries so both
@@ -1857,99 +2337,127 @@ class EngineCore:
                 if chunk <= 0:
                     continue
             self._mark_first_sched(seq, t_step)
-            rows.append((
-                seq,
-                seq.prompt[seq.prefilled : seq.prefilled + chunk],
-                seq.prefilled,
-                seq.prefilled + chunk,
-            ))
+            rows.append((seq, seq.prompt[p0 : p0 + chunk], p0, p0 + chunk))
             kinds.append("p")
             drafts.append([])
+            feed_rows.append(None)
             total += chunk
         if not rows:
-            return outputs
+            return None
 
         # Only verify rows sample more than their last position; a
         # prefill chunk's mid-prompt logits stay unsampled noise.
-        toks2, lps2 = self._dispatch_ragged(
+        pend = self._dispatch_ragged(
             rows, self._decode_width(len(rows)),
             n_sample=[
                 len(tl) if kind == "v" else 1
                 for (_, tl, _, _), kind in zip(rows, kinds)
             ],
+            feed_rows=feed_rows,
         )
-        # Column 0 is each row's single-sample slot (decode rows and
-        # prefill chunks); verify rows read their full sample width.
-        toks = toks2[:, 0]
-        lps = None if lps2 is None else tuple(a[:, 0] for a in lps2)
-        now = time.time()
-        drafted_total = accepted_total = spec_emitted = 0
-        for i, ((seq, toks_list, _pos0, _kv), kind) in enumerate(zip(rows, kinds)):
-            if kind == "v":
-                out, d, a = self._apply_verify_row(
-                    seq, drafts[i], toks2[i], lps2, i
+        deterministic = n_spec_rows == 0
+        adv: dict[str, tuple[int, int, int]] = {}
+        feed_index: dict[str, int] = {}
+        for i, ((seq, toks_list, p0, _kv), kind) in enumerate(zip(rows, kinds)):
+            if kind in ("d", "v"):
+                adv[seq.request_id] = (0, 1, 1)
+                if deterministic:
+                    feed_index[seq.request_id] = i  # R == 1: column 0
+            else:
+                chunk = len(toks_list)
+                done = p0 + chunk >= seq.prompt_len
+                adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
+                if done and deterministic:
+                    feed_index[seq.request_id] = i
+
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+            toks2, lps2 = pend.land()
+            # Column 0 is each row's single-sample slot (decode rows and
+            # prefill chunks); verify rows read their full sample width.
+            toks = toks2[:, 0]
+            lps = None if lps2 is None else tuple(a[:, 0] for a in lps2)
+            now = time.time()
+            drafted_total = accepted_total = spec_emitted = 0
+            live = {id(s) for s in self.running}
+            for i, ((seq, toks_list, _pos0, _kv), kind) in enumerate(
+                zip(rows, kinds)
+            ):
+                if seq.finish is not None or seq.cancelled or id(seq) not in live:
+                    continue  # late finish/preempt: discard the row
+                if kind == "v":
+                    out, d, a = self._apply_verify_row(
+                        seq, drafts[i], toks2[i], lps2, i
+                    )
+                    outputs.append((seq, out))
+                    drafted_total += d
+                    accepted_total += a
+                    if d:
+                        spec_emitted += len(out.token_ids)
+                    continue
+                if kind == "d":
+                    # The row wrote the pending token's K/V and sampled
+                    # the next token — the 1-step unrolling of the decode
+                    # chain's bookkeeping.
+                    completed = seq.hashed.extend([seq.pending])
+                    self._commit_completed(seq, completed)
+                    seq.processed += 1
+                    seq.generated += 1
+                    tok = int(toks[i])
+                    lp = None
+                    if lps is not None and seq.logprobs is not None:
+                        lp = _lp_entry(
+                            tok, lps[0][i], lps[1][i], lps[2][i], seq.logprobs
+                        )
+                    outputs.append((seq, self._emit(seq, tok, lp)))
+                    if seq.finish is not None:
+                        self._finish(seq)
+                    else:
+                        seq.pending = tok
+                    continue
+                tok, lp = self._advance_prefill_chunk(
+                    seq, len(toks_list), toks, lps, i, t_step, now
                 )
-                outputs.append((seq, out))
-                drafted_total += d
-                accepted_total += a
-                if d:
-                    spec_emitted += len(out.token_ids)
-                continue
-            if kind == "d":
-                # The row wrote the pending token's K/V and sampled the
-                # next token — the 1-step unrolling of the decode chain's
-                # bookkeeping.
-                completed = seq.hashed.extend([seq.pending])
-                self._commit_completed(seq, completed)
-                seq.processed += 1
-                seq.generated += 1
-                tok = int(toks[i])
-                lp = None
-                if lps is not None and seq.logprobs is not None:
-                    lp = _lp_entry(tok, lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
-                outputs.append((seq, self._emit(seq, tok, lp)))
-                if seq.finish is not None:
-                    self._finish(seq)
-                else:
+                if tok is not None:  # this chunk completed the prompt
                     seq.pending = tok
-                continue
-            tok, lp = self._advance_prefill_chunk(
-                seq, len(toks_list), toks, lps, i, t_step, now
+                    seq.generated += 1
+                    outputs.append((seq, self._emit(seq, tok, lp)))
+                    if seq.finish is not None:
+                        self._finish(seq)
+            if n_spec_rows:
+                self.spec_stats.verify_steps += 1
+                self._tracer.record(
+                    "spec_verify", t_drafted, now,
+                    attrs={
+                        "seqs": n_spec_rows, "drafted": drafted_total,
+                        "accepted": accepted_total, "tokens": spec_emitted,
+                    },
+                    stat=True,
+                )
+
+            st = self.sched_stats
+            st["mixed_steps"] += 1
+            st["last_step_batched_tokens"] = total
+            st["last_step_budget_utilization"] = total / budget if budget else 0.0
+            st["chunked_prefills_in_flight"] = sum(
+                1 for s in self.running if not s.prefill_done and s.t_first_sched
             )
-            if tok is not None:  # this chunk completed the prompt
-                seq.pending = tok
-                seq.generated += 1
-                outputs.append((seq, self._emit(seq, tok, lp)))
-                if seq.finish is not None:
-                    self._finish(seq)
-        if n_spec_rows:
-            self.spec_stats.verify_steps += 1
             self._tracer.record(
-                "spec_verify", t_drafted, now,
+                "engine_mixed_step", t_step, now,
                 attrs={
-                    "seqs": n_spec_rows, "drafted": drafted_total,
-                    "accepted": accepted_total, "tokens": spec_emitted,
+                    "seqs": len(rows), "decode_rows": n_decode,
+                    "prefill_tokens": total - decode_row_tokens,
+                    "budget": budget,
                 },
                 stat=True,
             )
+            return outputs
 
-        st = self.sched_stats
-        st["mixed_steps"] += 1
-        st["last_step_batched_tokens"] = total
-        st["last_step_budget_utilization"] = total / budget if budget else 0.0
-        st["chunked_prefills_in_flight"] = sum(
-            1 for s in self.running if not s.prefill_done and s.t_first_sched
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            feed_tokens=pend.toks, feed_index=feed_index,
+            deterministic=deterministic,
         )
-        self._tracer.record(
-            "engine_mixed_step", t_step, now,
-            attrs={
-                "seqs": len(rows), "decode_rows": n_decode,
-                "prefill_tokens": total - decode_row_tokens,
-                "budget": budget,
-            },
-            stat=True,
-        )
-        return outputs
 
     def _scan_stop(self, seq: Sequence, toks: np.ndarray) -> tuple[int, str | None]:
         """Vectorized stop scan over a decode chain's sampled tokens:
@@ -1987,10 +2495,12 @@ class EngineCore:
         short-budget tool-call workload). Snapped down to a power of two
         so the compiled-program count stays O(log chain); per-lane
         overshoot within a chain is discarded by the host stop-scan."""
-        ctx_cap = min(self.engine.max_model_len - s.processed for s in seqs)
+        ctx_cap = min(
+            self.engine.max_model_len - self._eff_processed(s) for s in seqs
+        )
         budget_cap = max(
             (
-                s.stop.max_tokens - s.generated
+                s.stop.max_tokens - self._eff_generated(s)
                 if s.stop.max_tokens is not None
                 else self.engine.decode_chain
             )
@@ -2474,7 +2984,32 @@ class EngineCore:
         st["running"] = len(self.running)
         st["chunked_scheduling"] = 1 if self._sched_chunked else 0
         st["token_budget"] = self.engine.token_budget
+        st["async_exec"] = 1 if self.engine.async_exec else 0
+        st.update(self.exec_stats)
         return st
+
+    def kv_cache_stats(self) -> dict:
+        """Point-in-time prefix-cache gauges (status-server /metrics
+        export). Two distinct series, never mixed: ``prefix_*`` are the
+        allocator's match_prefix probe counters (router overlap scoring,
+        disagg local-vs-remote decisions — counted since the prefix cache
+        landed, never surfaced before); ``admitted_*`` count admitted
+        sequences and whether their prefix was served from cache."""
+        a = self.allocator
+        return {
+            "prefix_queries": a.prefix_queries,
+            "prefix_hits": a.prefix_hits,
+            "prefix_hit_rate": (
+                a.prefix_hits / a.prefix_queries if a.prefix_queries else 0.0
+            ),
+            "admitted_queries": self._admit_prefix_queries,
+            "admitted_hits": self._admit_prefix_hits,
+            "admitted_hit_rate": (
+                self._admit_prefix_hits / self._admit_prefix_queries
+                if self._admit_prefix_queries
+                else 0.0
+            ),
+        }
 
     def spec_decode_stats(self) -> dict:
         """Point-in-time speculation gauges (status-server /metrics export

@@ -31,6 +31,24 @@ DEFAULT_TOP_CAP = 64
 LOGPROBS_K = 20
 
 
+def gather_feedback(
+    prev_tokens: jax.Array,   # previous dispatch's sampled tokens, any shape
+    host_tokens: jax.Array,   # [T] int32 — host-assembled token buffer
+    src_idx: jax.Array,       # [T] int32 — flat index into prev_tokens, or -1
+) -> jax.Array:               # [T] int32
+    """Device-resident token feedback (async pipelined execution): slots
+    of the next step's token buffer whose value is a just-sampled token
+    read it straight from the previous dispatch's device output — the
+    sampled id never round-trips D2H→H2D on the critical path. Slots
+    with ``src_idx < 0`` keep the host value (prefill chunks, draft
+    tokens, already-committed pendings). One tiny program per (prev
+    size, T) pair; enqueued on the device stream, so it never blocks the
+    host."""
+    flat = prev_tokens.reshape(-1)
+    fed = flat[jnp.clip(src_idx, 0, flat.shape[0] - 1)]
+    return jnp.where(src_idx >= 0, fed, host_tokens)
+
+
 def token_logprobs(
     logits: jax.Array,   # [B, V] float32 (raw, pre-temperature)
     tokens: jax.Array,   # [B] int32 — the sampled/chosen tokens

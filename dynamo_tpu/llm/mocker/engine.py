@@ -53,11 +53,18 @@ class MockEngineArgs:
     # only (mirrors EngineConfig.prefill_chunk).
     prefill_chunk: int = 0
     speedup_ratio: float = 1.0
-    # Cost model (pre-speedup): iteration = base + prefill_tokens*prefill
-    #                            + decoding_seqs*decode
+    # Cost model (pre-speedup): base_iter_us is the fixed per-dispatch
+    # HOST overhead (plan assembly, sampled-token fetch, bookkeeping,
+    # detokenization); the token/seq terms are DEVICE compute.
+    #   async_exec off: iteration = host + device  (they serialize)
+    #   async_exec on:  iteration = max(host, device)  (one-step-ahead
+    #     pipelining hides the smaller term under the larger — the
+    #     virtual-clock twin of EngineCore's plan/dispatch/commit split;
+    #     token VALUES are unchanged, the stream stays bit-identical)
     base_iter_us: float = 500.0
     prefill_us_per_token: float = 10.0
     decode_us_per_seq: float = 100.0
+    async_exec: bool = False
     # Speculative decoding (mirrors EngineConfig.spec_decode/spec_k): with
     # "ngram", every decode row becomes a verify row that emits
     # 1 + accepted tokens per iteration, where accepted is simulated by
@@ -158,6 +165,10 @@ class MockTpuEngine:
         # The mocker never truly preempts (release + re-queue) — a decode
         # blocked on allocation just stalls one iteration — so stalls are
         # counted separately, not as preemptions.
+        # Admission-time prefix-cache accounting, mirroring
+        # EngineCore._admit (kv_prefix_cache_admitted_* gauges).
+        self._admit_prefix_queries = 0
+        self._admit_prefix_hits = 0
         self.sched_stats = {
             "preemptions": 0,
             "decode_stalls": 0,
@@ -262,6 +273,7 @@ class MockTpuEngine:
         st["running"] = len(self._running)
         st["chunked_scheduling"] = 1 if self.args.scheduling == "chunked" else 0
         st["token_budget"] = self.args.max_num_batched_tokens
+        st["async_exec"] = 1 if self.args.async_exec else 0
         return st
 
     def spec_decode_stats(self) -> dict:
@@ -271,6 +283,26 @@ class MockTpuEngine:
         st = self.spec_stats.as_dict()
         st["enabled"] = 1 if self._spec_default is not None else 0
         return st
+
+    def kv_cache_stats(self) -> dict:
+        """Prefix-cache gauges, same keys as EngineCore.kv_cache_stats:
+        ``prefix_*`` are match_prefix probe counters, ``admitted_*`` count
+        admitted sequences whose prefix was served from cache."""
+        st = self.kv.stats
+        return {
+            "prefix_queries": st.prefix_queries,
+            "prefix_hits": st.prefix_hits,
+            "prefix_hit_rate": (
+                st.prefix_hits / st.prefix_queries if st.prefix_queries else 0.0
+            ),
+            "admitted_queries": self._admit_prefix_queries,
+            "admitted_hits": self._admit_prefix_hits,
+            "admitted_hit_rate": (
+                self._admit_prefix_hits / self._admit_prefix_queries
+                if self._admit_prefix_queries
+                else 0.0
+            ),
+        }
 
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
@@ -302,6 +334,34 @@ class MockTpuEngine:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.create_task(self._sim_loop())
 
+    def iter_time_s(self, prefill_tokens: int, decode_seqs: int) -> float:
+        """Virtual-clock cost of one iteration under the overlap model:
+        with async execution, the fixed host overhead runs one step ahead
+        and hides under device compute (bounded by the larger term). The
+        uncovered remainder is recorded as the ``host_gap`` stat. NOTE on
+        semantics: the mocker's span is the model's DEVICE-IDLE time per
+        iteration (it knows the split exactly), while the real engine's
+        ``host_gap`` is the wall-clock gap between consecutive dispatch
+        enqueues (it cannot see device occupancy) — same name, related
+        but not identical quantities; compare trends, not absolutes."""
+        host_s = self.args.base_iter_us / 1e6
+        device_s = (
+            prefill_tokens * self.args.prefill_us_per_token
+            + decode_seqs * self.args.decode_us_per_seq
+        ) / 1e6
+        if self.args.async_exec:
+            total = max(host_s, device_s)
+            gap = max(0.0, host_s - device_s)
+        else:
+            total = host_s + device_s
+            gap = host_s
+        now = time.time()
+        self._tracer.record(
+            "host_gap", now - gap, now,
+            attrs={"overlapped": self.args.async_exec}, stat=True,
+        )
+        return total / self.args.speedup_ratio
+
     async def _sim_loop(self) -> None:
         while True:
             if not self._waiting and not self._running:
@@ -309,13 +369,8 @@ class MockTpuEngine:
                 await self._wakeup.wait()
             self._admit()
             prefill_tokens, decode_seqs = self._step()
-            iter_time_s = (
-                self.args.base_iter_us
-                + prefill_tokens * self.args.prefill_us_per_token
-                + decode_seqs * self.args.decode_us_per_seq
-            ) / 1e6 / self.args.speedup_ratio
             self._iterations += 1
-            await asyncio.sleep(iter_time_s)
+            await asyncio.sleep(self.iter_time_s(prefill_tokens, decode_seqs))
 
     def _admit(self) -> None:
         watermark_blocks = self.args.watermark * self.kv.capacity
@@ -339,6 +394,12 @@ class MockTpuEngine:
                 self.kv.release(seq.prompt_hashes[:cached])
                 return
             self._waiting.pop(0)
+            # Admission-time prefix accounting (one query per ADMITTED
+            # sequence), mirroring EngineCore._admit — DEDICATED counters,
+            # never the kv manager's match_prefix probe counters.
+            self._admit_prefix_queries += 1
+            if cached:
+                self._admit_prefix_hits += 1
             seq.cached_blocks = cached
             seq.pinned = list(seq.prompt_hashes[:cached])
             seq.partials_held = need

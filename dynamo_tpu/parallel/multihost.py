@@ -79,3 +79,25 @@ def fetch_replicated(x) -> np.ndarray:
             "the program must produce replicated host-visible outputs"
         )
     return np.asarray(shard.data)
+
+
+def start_host_copy(x) -> None:
+    """Enqueue an async device->host copy of one array (no-op on arrays
+    that don't support it, e.g. plain numpy): the later blocking fetch
+    then lands data that has been streaming in the background instead of
+    paying the full transfer at the sync point."""
+    fn = getattr(x, "copy_to_host_async", None)
+    if fn is not None:
+        fn()
+
+
+def fetch_replicated_many(arrays) -> list[np.ndarray]:
+    """Batched host fetch: start async D2H copies for EVERY array first,
+    then land them in order — the transfers overlap each other (and any
+    still-running device work) instead of serializing one blocking fetch
+    per array. Used for the sampler's (chosen, top_ids, top_lps) logprob
+    tuple, which the engine previously fetched as three serial syncs."""
+    arrs = list(arrays)
+    for a in arrs:
+        start_host_copy(a)
+    return [fetch_replicated(a) for a in arrs]

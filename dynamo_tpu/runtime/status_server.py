@@ -135,6 +135,54 @@ def bind_spec_gauges(
         )
 
 
+# Prefix-cache gauge export: stats-dict key -> (name, doc). Keys match
+# EngineCore.kv_cache_stats() / MockTpuEngine.kv_cache_stats() — the
+# allocator has counted prefix queries/hits since the prefix cache
+# landed, but never surfaced them on /metrics.
+KV_CACHE_GAUGES: dict[str, tuple[str, str]] = {
+    "prefix_queries": (
+        "kv_prefix_cache_queries_total",
+        "match_prefix probes (router overlap scoring, disagg "
+        "local-vs-remote decisions) since start",
+    ),
+    "prefix_hits": (
+        "kv_prefix_cache_hits_total",
+        "match_prefix probes that found at least one cached leading block",
+    ),
+    "prefix_hit_rate": (
+        "kv_prefix_cache_hit_rate",
+        "prefix_hits / prefix_queries (probe series; 0 when no queries)",
+    ),
+    "admitted_queries": (
+        "kv_prefix_cache_admitted_queries_total",
+        "Sequences admitted by the scheduler since start",
+    ),
+    "admitted_hits": (
+        "kv_prefix_cache_admitted_hits_total",
+        "Admitted sequences whose prompt prefix was served from cache "
+        "(device blocks or host-tier onboard)",
+    ),
+    "admitted_hit_rate": (
+        "kv_prefix_cache_admitted_hit_rate",
+        "admitted_hits / admitted_queries (0 when nothing admitted yet)",
+    ),
+}
+
+
+def bind_kv_cache_gauges(
+    status: "SystemStatusServer | None", kv_cache_stats: Callable[[], dict]
+) -> None:
+    """Export a worker's prefix-cache gauges on /metrics (same scrape-time
+    evaluation as the scheduler gauges)."""
+    if status is None:
+        return
+    scoped = status.metrics.scoped(service="engine")
+    for key, (name, doc) in KV_CACHE_GAUGES.items():
+        scoped.gauge(name, doc).set_function(
+            lambda k=key: float(kv_cache_stats().get(k, 0) or 0)
+        )
+
+
 class SystemStatusServer:
     def __init__(
         self,

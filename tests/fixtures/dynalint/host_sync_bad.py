@@ -1,0 +1,19 @@
+"""Seeded blocking-host-sync violations (4 findings): device->host
+synchronization calls inside registered step-loop hot paths with no
+`# dynalint: sync-ok` pragma."""
+
+import numpy as np
+
+from dynamo_tpu.parallel.multihost import fetch_replicated
+
+
+def plan_step(dev):
+    host = np.asarray(dev)          # landing mid-plan: finding 1
+    val = dev.item()                # scalar sync mid-plan: finding 2
+    toks = fetch_replicated(dev)    # blocking fetch mid-plan: finding 3
+    return host, val, toks
+
+
+def dispatch(dev):
+    dev.block_until_ready()         # device barrier mid-dispatch: finding 4
+    return dev

@@ -1,0 +1,23 @@
+"""Clean twin of host_sync_bad.py: syncs carry the sync-ok pragma inside
+hot paths, or live outside them (the commit side), so the detector stays
+quiet."""
+
+import numpy as np
+
+from dynamo_tpu.parallel.multihost import fetch_replicated
+
+
+def plan_step(rows, dev):
+    ids = np.asarray(rows)  # dynalint: sync-ok — host list, not a device array
+    # dynalint: sync-ok — intentional landing, pragma on the line above
+    toks = fetch_replicated(dev)
+    return ids, toks
+
+
+def commit(dev):
+    # Not a registered hot path: commit-side landings sync freely.
+    return np.asarray(dev), dev.item()
+
+
+def dispatch(dev):
+    return dev + 1  # pure enqueue, nothing to flag

@@ -16,6 +16,7 @@ RULE_BROAD_EXCEPT = "broad-except"
 RULE_LOCK_DISCIPLINE = "lock-discipline"
 RULE_JAX_PITFALL = "jax-pitfall"
 RULE_UNCLOSED_SPAN = "unclosed-span"
+RULE_HOST_SYNC = "blocking-host-sync"
 
 ALL_RULES = (
     RULE_FIRE_AND_FORGET,
@@ -24,6 +25,7 @@ ALL_RULES = (
     RULE_LOCK_DISCIPLINE,
     RULE_JAX_PITFALL,
     RULE_UNCLOSED_SPAN,
+    RULE_HOST_SYNC,
 )
 
 # ---------------------------------------------------------------------------
@@ -100,6 +102,40 @@ MUTATOR_METHODS = {
     "append", "extend", "insert", "add", "update", "setdefault",
     "pop", "popleft", "popitem", "remove", "discard", "clear",
     "appendleft", "rotate", "sort", "reverse",
+}
+
+# ---------------------------------------------------------------------------
+# blocking-host-sync: device->host synchronization points flagged inside
+# step-loop HOT PATHS (the plan/dispatch side of the async pipelined
+# engine, PERF.md r8). A blocking sync there serializes host work with
+# device compute — exactly the overhead the one-step-ahead loop removes;
+# landings belong on the commit side. Suppress an intentional sync with a
+# `# dynalint: sync-ok` pragma on the line (or the line above) — e.g. the
+# double-buffered landing point itself, or np.asarray over a host list.
+# ---------------------------------------------------------------------------
+
+# Call names (last dotted component) that block on device state.
+HOST_SYNC_FNS = {"fetch_replicated", "fetch_replicated_many", "device_get"}
+
+# Method-style syncs: `x.item()` / `x.block_until_ready()` on any receiver.
+HOST_SYNC_METHODS = {"item", "block_until_ready"}
+
+# `np.asarray` / `numpy.asarray` (D2H landing when handed a device array).
+HOST_SYNC_ASARRAY_ROOTS = {"np", "numpy"}
+
+# Hot-path registry: repo-relative file suffix -> function names whose
+# bodies must stay sync-free. Nested defs (commit closures) are NOT hot —
+# the commit side is where landings belong.
+HOT_STEP_FUNCS: dict[str, set[str]] = {
+    "dynamo_tpu/engine/core.py": {
+        "_plan_step", "_plan_waves", "_plan_prefill_wave", "_plan_decode",
+        "_plan_chain", "_plan_verify", "_plan_mixed", "_merge_plans",
+        "_dispatch_ragged", "_run_decode", "_grow_or_preempt", "_admit",
+        "land",
+    },
+    # Detector fixtures (linted directly by tests; excluded from the tree).
+    "tests/fixtures/dynalint/host_sync_bad.py": {"plan_step", "dispatch"},
+    "tests/fixtures/dynalint/host_sync_ok.py": {"plan_step", "dispatch"},
 }
 
 # ---------------------------------------------------------------------------

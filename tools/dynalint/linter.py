@@ -36,17 +36,21 @@ class Finding:
 class Pragma:
     path: str
     line: int
-    kind: str          # "allow" | "holds-lock"
+    kind: str          # "allow" | "holds-lock" | "sync-ok"
     arg: str           # rule name for allow, lock name for holds-lock
-    reason: str        # required for allow, empty for holds-lock
+    reason: str        # required for allow, empty otherwise
 
     def __str__(self) -> str:
         detail = f"({self.reason})" if self.reason else ""
-        return f"{self.path}:{self.line}: {self.kind}-{self.arg}{detail}"
+        tail = f"-{self.arg}" if self.arg else ""
+        return f"{self.path}:{self.line}: {self.kind}{tail}{detail}"
 
 
 _ALLOW_RE = re.compile(r"dynalint:\s*allow-([a-z][a-z0-9-]*)\s*\(\s*([^)]*?)\s*\)")
 _HOLDS_RE = re.compile(r"dynalint:\s*holds-lock\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\)")
+# Intentional host-sync marker (blocking-host-sync rule): bare, no arg —
+# prose may follow after the keyword (`# dynalint: sync-ok — reason`).
+_SYNC_OK_RE = re.compile(r"dynalint:\s*sync-ok\b")
 # A pragma must START the comment (`# dynalint: ...`); "dynalint:"
 # mid-comment is prose about the tool, not a directive.
 _ANY_PRAGMA_RE = re.compile(r"^#+\s*dynalint:")
@@ -119,9 +123,13 @@ class _FileLinter(ast.NodeVisitor):
         self._allow: dict[int, set[str]] = {}
         # holds-lock pragma lines -> lock names.
         self._holds: dict[int, set[str]] = {}
+        # sync-ok pragma lines (blocking-host-sync suppressions).
+        self._sync_ok: set[int] = set()
         for p in pragmas:
             if p.kind == "allow":
                 self._allow.setdefault(p.line, set()).add(p.arg)
+            elif p.kind == "sync-ok":
+                self._sync_ok.add(p.line)
             else:
                 self._holds.setdefault(p.line, set()).add(p.arg)
 
@@ -138,6 +146,12 @@ class _FileLinter(ast.NodeVisitor):
         for suffix, entries in C.GUARDED_BY.items():
             if path.endswith(suffix):
                 self._registry.update(entries)
+
+        # blocking-host-sync hot-path slice for this file.
+        self._hot: set[str] = set()
+        for suffix, funcs in C.HOT_STEP_FUNCS.items():
+            if path.endswith(suffix):
+                self._hot.update(funcs)
 
         # jax-pitfall bookkeeping (filled by _prescan).
         self._signal_handlers: set[str] = set()
@@ -291,7 +305,48 @@ class _FileLinter(ast.NodeVisitor):
             self._check_blocking(node)
         self._check_jit_call(node)
         self._check_mutator_call(node)
+        self._check_host_sync(node)
         self.generic_visit(node)
+
+    # -- rule 7: blocking host syncs in step-loop hot paths ----------------
+
+    def _check_host_sync(self, node: ast.Call) -> None:
+        """Flag device->host synchronization calls inside registered
+        step-loop hot paths (the plan/dispatch side of the async engine):
+        np.asarray / fetch_replicated / .item() / .block_until_ready()
+        there serialize host work with device compute. Nested named defs
+        (the commit closures) are their own scope — _current_func_name
+        resolves to the innermost named def, which is not in the hot set
+        — so commit-side landings sync freely. Suppressed by a
+        `# dynalint: sync-ok` pragma on the line or the line above."""
+        if not self._hot:
+            return
+        fname = self._current_func_name()
+        if fname is None or fname not in self._hot:
+            return
+        func = node.func
+        what = None
+        if isinstance(func, ast.Attribute):
+            if func.attr in C.HOST_SYNC_METHODS:
+                what = f".{func.attr}()"
+            elif func.attr == "asarray" and dotted_name(func.value) in C.HOST_SYNC_ASARRAY_ROOTS:
+                what = "np.asarray()"
+            elif func.attr in C.HOST_SYNC_FNS:
+                what = f"{func.attr}()"
+        elif isinstance(func, ast.Name) and func.id in C.HOST_SYNC_FNS:
+            what = f"{func.id}()"
+        if what is None:
+            return
+        line = node.lineno
+        if line in self._sync_ok or line - 1 in self._sync_ok:
+            return
+        self.report(
+            node, C.RULE_HOST_SYNC,
+            f"{what} inside step-loop hot path {fname!r} blocks the host "
+            "on device state, serializing scheduling with device compute; "
+            "move the landing to the commit side, or mark an intentional "
+            "sync with `# dynalint: sync-ok`",
+        )
 
     def _check_blocking(self, node: ast.Call) -> None:
         d = dotted_name(node.func)
@@ -646,6 +701,9 @@ def extract_pragmas(path: str, source: str) -> tuple[list[Pragma], list[Finding]
         for m in _HOLDS_RE.finditer(text):
             matched = True
             pragmas.append(Pragma(path, line, "holds-lock", m.group(1), ""))
+        if _SYNC_OK_RE.search(text):
+            matched = True
+            pragmas.append(Pragma(path, line, "sync-ok", "", ""))
         if not matched:
             errors.append(Finding(
                 path, line, 0, "malformed-pragma",
