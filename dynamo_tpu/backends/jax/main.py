@@ -43,6 +43,7 @@ from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPu
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
 from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.tasks import spawn_logged
 from dynamo_tpu.runtime.worker import dynamo_worker
 
@@ -121,6 +122,8 @@ async def _pull_peer_prefix(
         # Hard deadline: a stalled peer must degrade to local recompute,
         # never hang the user's request.
         async with asyncio.timeout(30.0):
+            if chaos.active():
+                await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
             stream = await fetch_client.direct(
                 hint["worker_id"], {"hashes": want}
             )
@@ -723,6 +726,7 @@ async def run_jax_worker(
                 await _pull_peer_prefix(core, fetch_client, hint, list(pre.token_ids))
             cached = await asyncio.to_thread(core.cached_prefix_tokens, pre.token_ids)
             uncached = len(pre.token_ids) - cached
+            fallback_replayed = 0  # tokens replayed by an in-worker disagg fallback
             depth = 0
             if prefill_client.instance_ids():
                 try:
@@ -758,13 +762,26 @@ async def run_jax_worker(
                     stop = pre.stop.after_replay(len(emitted))
                     if stop.max_tokens is not None:
                         stop.max_tokens = max(1, stop.max_tokens)
+                    fallback_replayed = len(emitted)
                     pre = dataclasses.replace(
                         pre,
                         token_ids=list(pre.token_ids) + emitted,
                         stop=stop,
                         kv_transfer_params=None,
+                        # ACCUMULATE: an upstream migration may already
+                        # have marked replayed tokens on this request.
+                        replayed_tokens=pre.replayed_tokens + len(emitted),
                     )
             async for out in engine.generate(pre.to_wire(), context):
+                if fallback_replayed and out.get("finish_reason") is not None:
+                    # Usage fix-up for the in-worker replay (invisible to
+                    # the frontend's migration operator): the engine
+                    # counted the replayed tokens as prompt and only its
+                    # own output as completion — charge each token once.
+                    if out.get("prompt_tokens") is not None:
+                        out["prompt_tokens"] -= fallback_replayed
+                    if out.get("completion_tokens") is not None:
+                        out["completion_tokens"] += fallback_replayed
                 yield out
 
     else:
@@ -1017,6 +1034,10 @@ async def _remote_prefill_then_decode(
         descs: list[dict] | None = None
         imported = total = dropped = 0
         t_xfer = time.time()
+        if chaos.active():
+            # Disagg block pull: a severed pull surfaces as ConnectionError,
+            # which the decode handler degrades to local recompute + replay.
+            await chaos.inject("kv_transfer.pull", str(prefill_worker))
         bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
         async for frame in bstream:
             if "error" in frame:

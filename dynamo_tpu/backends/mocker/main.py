@@ -40,6 +40,9 @@ async def run_mocker(
     args = engine_args or MockEngineArgs()
     engine = MockTpuEngine(args)
     worker_id = runtime.primary_lease_id
+    # Chaos targeting: `engine.step` rules match this worker by id (and
+    # by model name, so a plan can wedge "one worker of model X").
+    engine.chaos_tag = f"worker-{worker_id}/{model_name}"
 
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
 
@@ -127,7 +130,22 @@ def main() -> None:
                     help="one-step-ahead overlap model: per-iteration host "
                          "overhead hides under device compute (virtual "
                          "clock; stream stays bit-identical to 'off')")
+    ap.add_argument("--chaos-plan", default="",
+                    help="fault-injection plan: inline JSON or @file "
+                         "(same format as $DYN_CHAOS_PLAN; see "
+                         "runtime/chaos.py for points/actions)")
     args = ap.parse_args()
+
+    if args.chaos_plan:
+        import json as _json
+
+        from dynamo_tpu.runtime import chaos
+
+        raw = args.chaos_plan
+        if raw.startswith("@"):
+            with open(raw[1:], encoding="utf-8") as f:
+                raw = f.read()
+        chaos.install(chaos.ChaosPlan.from_dict(_json.loads(raw)))
 
     engine_args = MockEngineArgs(
         num_kv_blocks=args.num_kv_blocks,

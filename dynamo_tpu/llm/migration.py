@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from dataclasses import replace
 from typing import AsyncIterator
@@ -34,10 +35,9 @@ from dynamo_tpu.llm.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.pipeline import NextFn, PipelineBuilder, ServicePipeline
+from dynamo_tpu.runtime.store.client import reconnect_delay
 
 log = logging.getLogger("dynamo_tpu.migration")
-
-_RETRY_WAIT_S = 0.2
 
 
 class RouterEgress:
@@ -91,9 +91,16 @@ class MigrationOperator:
     state the next forward rewrite needs) and closes the stream exactly
     once a finish reason passes."""
 
-    def __init__(self, limit: int = 3):
+    def __init__(self, limit: int = 3, rng: random.Random | None = None):
         self.limit = limit
         self._tracer = tracing.get_tracer("migration")
+        # Retry pacing: full-jitter exponential backoff on the store
+        # client's reconnect schedule (same ceilings, same rationale — a
+        # worker crash fails every stream it carried at the same instant,
+        # and a fixed wait would re-dial the survivors in one synchronized
+        # wave). `rng`/`_sleep` are injectable for deterministic tests.
+        self._rng = rng or random.Random()
+        self._sleep = asyncio.sleep
 
     async def generate(
         self, pre: PreprocessedRequest, context: Context, next: NextFn
@@ -127,6 +134,18 @@ class MigrationOperator:
             try:
                 async for out in next(current, attempt_ctx):
                     generated.extend(out.token_ids)
+                    if attempts and out.finish_reason is not None:
+                        # Usage fix-up after a replay: the final attempt's
+                        # engine counts the replayed tokens as PROMPT and
+                        # only its own output as completion. The client
+                        # billed the original prompt and streamed
+                        # len(generated) tokens total — report exactly
+                        # that, charging each replayed token once.
+                        out = replace(
+                            out,
+                            prompt_tokens=len(pre.token_ids),
+                            completion_tokens=len(generated),
+                        )
                     yield out
                     if out.finish_reason is not None:
                         trace_attempt(t_attempt, "completed")
@@ -161,12 +180,13 @@ class MigrationOperator:
                     current,
                     token_ids=list(pre.token_ids) + generated,
                     stop=new_stop,
+                    replayed_tokens=len(generated),
                 )
                 log.info(
                     "migrating request %s (attempt %d/%d, %d tokens replayed): %s",
                     pre.request_id, attempts, self.limit, len(generated), e,
                 )
-                await asyncio.sleep(_RETRY_WAIT_S)
+                await self._sleep(reconnect_delay(attempts - 1, self._rng))
 
 
 class Migration:

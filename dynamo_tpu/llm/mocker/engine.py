@@ -27,6 +27,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
     StopConditions,
 )
+from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.spec import SpecConfig, SpecStats, resolve_spec_config
 from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
@@ -97,6 +98,12 @@ class _Seq:
     # Speculation draft length for this request (0 = off); resolved at
     # submit from the engine default + the request's spec_decode dict.
     spec_k: int = 0
+    # Tokens a previous attempt already streamed to the client
+    # (migration replay): offsets the synthetic token function so a
+    # replayed stream continues bit-identically where the dead worker
+    # stopped, the way a real model conditioning on the grown prompt
+    # would.
+    replay_base: int = 0
     # Phase timestamps for the tracer (0.0 = not reached yet). The spans
     # are emitted retroactively when the stream closes so the sim loop's
     # hot path only ever stamps a float.
@@ -154,6 +161,13 @@ class MockTpuEngine:
         self._wakeup = asyncio.Event()
         self._loop_task: asyncio.Task | None = None
         self._iterations = 0
+        # Chaos: the engine.step injection point fires once per sim
+        # iteration, targeted by this tag (run_mocker sets it to the
+        # worker id). A `kill` action leaves the loop dead — in-flight
+        # streams stop producing, which is exactly the wedged-worker
+        # shape the client-side stall deadline exists to catch.
+        self.chaos_tag = ""
+        self._dead = False
         self._tracer = tracing.get_tracer("engine")
         # Queue-wait stat spans under their own service (the waterfall
         # sched_admit twin in _trace_phases is service "engine"; sharing
@@ -213,6 +227,7 @@ class MockTpuEngine:
             seq=TokenBlockSequence(pre.token_ids, self.args.block_size),
             prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
             stop=pre.stop,
+            replay_base=pre.replayed_tokens,
         )
         spec = resolve_spec_config(
             self._spec_default, pre.spec_decode, self.args.spec_k
@@ -224,6 +239,9 @@ class MockTpuEngine:
         self._wakeup.set()
         try:
             while True:
+                # Engine-local queue; a chaos-killed loop parks this
+                # deliberately (the client stall deadline catches it).
+                # dynalint: unbounded-ok — engine-local queue
                 item = await seq.out.get()
                 if item is self._FINISHED:
                     return
@@ -331,6 +349,8 @@ class MockTpuEngine:
     # -- simulation loop ---------------------------------------------------
 
     def _ensure_loop(self) -> None:
+        if self._dead:
+            return  # chaos-killed: stays dead until the process restarts
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.create_task(self._sim_loop())
 
@@ -367,6 +387,18 @@ class MockTpuEngine:
             if not self._waiting and not self._running:
                 self._wakeup.clear()
                 await self._wakeup.wait()
+            if chaos.active():
+                try:
+                    # stall: wedged loop, streams freeze, socket stays up;
+                    # kill: the loop dies for good (worker-crash twin).
+                    await chaos.inject("engine.step", self.chaos_tag)
+                except chaos.ChaosKill:
+                    log.warning(
+                        "chaos: engine loop killed (tag=%r, %d in flight)",
+                        self.chaos_tag, len(self._running),
+                    )
+                    self._dead = True
+                    return
             self._admit()
             prefill_tokens, decode_seqs = self._step()
             self._iterations += 1
@@ -496,7 +528,9 @@ class MockTpuEngine:
             finish = None
             stalled = False
             for _ in range(1 + accepted):
-                token = 97 + (seq.generated % 26)  # 'a'..'z' — ByteTokenizer
+                # 'a'..'z' cycle (ByteTokenizer); replay_base keeps a
+                # migrated continuation on the original cycle position.
+                token = 97 + ((seq.replay_base + seq.generated) % 26)
                 if len(self.seq_tail(seq)) == 0:
                     # Starting a fresh block mid-decode needs a new partial.
                     try:

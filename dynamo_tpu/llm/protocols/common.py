@@ -117,6 +117,13 @@ class PreprocessedRequest:
     # Set from the OpenAI dyn.spec_decode extension by the preprocessor
     # and resolved at engine admission.
     spec_decode: dict[str, Any] | None = None
+    # Token-replay continuation marker (migration / disagg fallback):
+    # the trailing `replayed_tokens` entries of token_ids were GENERATED
+    # by a previous attempt and already reached the client. A real model
+    # conditions on them naturally (they are prompt now); the mocker uses
+    # the count to keep its synthetic token function bit-identical across
+    # a replay. 0 on every fresh request.
+    replayed_tokens: int = 0
 
     def to_wire(self) -> dict:
         return asdict(self)
@@ -135,6 +142,7 @@ class PreprocessedRequest:
             request_id=d.get("request_id"),
             mm=d.get("mm"),
             spec_decode=d.get("spec_decode"),
+            replayed_tokens=d.get("replayed_tokens", 0),
         )
 
 

@@ -28,6 +28,8 @@ import msgpack
 
 from dynamo_tpu.runtime.dataplane import EgressClient, Handler, IngressServer, ResponseStream
 from dynamo_tpu.runtime.store import StoreClient, Subscription
+from dynamo_tpu.runtime.store.client import StoreError
+from dynamo_tpu.runtime.tasks import spawn_logged
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
@@ -91,6 +93,10 @@ class DistributedRuntime:
         self._ingress_started = False
         self._ingress_lock = asyncio.Lock()
         self._shutdown = asyncio.Event()
+        # Instances this process registered (Endpoint.serve) — the drain
+        # path deregisters them from discovery before anything else.
+        self._served: list[tuple["Endpoint", int]] = []
+        self._draining = False
 
     @classmethod
     async def create(
@@ -120,8 +126,52 @@ class DistributedRuntime:
         self.egress.close()
         await self.store.close()
 
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful worker drain (SIGTERM path), in containment order:
+
+        1. deregister every served instance from discovery — routers stop
+           picking this worker the moment the watch event lands;
+        2. stop admitting on the ingress (late arrivals racing the watch
+           get a retryable "draining" err → migration replays elsewhere);
+        3. let in-flight streams finish within ``timeout`` (stragglers
+           are killed by the subsequent shutdown, which peers see as
+           worker death → token-replay migration — no request is lost);
+        4. revoke the primary lease so lease-bound state (model cards,
+           KV inventories) vanishes now rather than at TTL expiry;
+        5. release the shutdown waiter so the worker main exits.
+
+        Returns True when all in-flight work completed within budget.
+        Parity: reference graceful-shutdown flow (PAPER.md §L1 —
+        deregister first, drain, then exit).
+        """
+        if self._draining:
+            await self._shutdown.wait()
+            return True
+        self._draining = True
+        log.info("draining: deregistering %d instance(s)", len(self._served))
+        for ep, instance_id in self._served:
+            try:
+                await ep.deregister(instance_id)
+            except (ConnectionError, StoreError):
+                log.warning("drain: deregister %s failed", ep.path, exc_info=True)
+        completed = True
+        if self._ingress_started:
+            completed = await self.ingress.drain(timeout)
+        try:
+            await self.store.lease_revoke(self.primary_lease_id)
+        except (ConnectionError, StoreError):
+            log.warning("drain: lease revoke failed", exc_info=True)
+        self._shutdown.set()
+        return completed
+
     def signal_shutdown(self) -> None:
         self._shutdown.set()
+
+    def request_drain(self, timeout: float = 30.0) -> None:
+        """Signal-handler-safe drain entry: schedules :meth:`drain` on
+        the running loop (SIGTERM → graceful; SIGINT stays immediate via
+        :meth:`signal_shutdown`)."""
+        spawn_logged(self.drain(timeout), name="graceful-drain", logger=log)
 
     async def wait_for_shutdown(self) -> None:
         await self._shutdown.wait()
@@ -196,12 +246,18 @@ class Endpoint:
             inst.to_wire(),
             lease=self.runtime.primary_lease_id,
         )
+        self.runtime._served.append((self, inst.instance_id))
         log.info("serving %s as instance %d at %s", self.path, inst.instance_id, inst.address)
         return inst
 
     async def deregister(self, instance_id: int) -> None:
         await self.runtime.store.kv_del(f"{self.instance_prefix}{instance_id:016x}")
         self.runtime.ingress.unregister(self.path)
+        self.runtime._served = [
+            (ep, iid)
+            for ep, iid in self.runtime._served
+            if not (iid == instance_id and ep.path == self.path)
+        ]
 
     async def client(self) -> "EndpointClient":
         client = EndpointClient(self)
@@ -303,15 +359,24 @@ class EndpointClient:
         inst = self.instances.get(instance_id)
         if inst is None:
             raise NoInstancesError(f"{self.endpoint.path} instance {instance_id}")
-        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+        # Failure attribution: errors the stream synthesizes (conn death,
+        # stall deadline, drain refusal) carry the instance id so the
+        # migration layer excludes the right worker on replay.
+        return await self.runtime.egress.request(
+            inst.address, inst.path, payload, headers, worker_id=instance_id
+        )
 
     async def round_robin(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
         inst = self._pick_round_robin()
-        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+        return await self.runtime.egress.request(
+            inst.address, inst.path, payload, headers, worker_id=inst.instance_id
+        )
 
     async def random(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
         inst = self._pick_random()
-        return await self.runtime.egress.request(inst.address, inst.path, payload, headers)
+        return await self.runtime.egress.request(
+            inst.address, inst.path, payload, headers, worker_id=inst.instance_id
+        )
 
     async def generate(self, payload: Any, headers: dict[str, str] | None = None) -> ResponseStream:
         return await self.round_robin(payload, headers)

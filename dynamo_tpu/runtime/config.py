@@ -39,6 +39,10 @@ class RuntimeConfig:
     trace_enabled: bool = True
     trace_sample: float = 1.0
     trace_buffer: int = 4096
+    # Graceful drain budget on SIGTERM: how long in-flight streams get
+    # to finish after the worker deregisters from discovery. Stragglers
+    # past the budget are killed (peers migrate them by token replay).
+    drain_timeout_s: float = 30.0
 
     @classmethod
     def from_env(cls, config_file: str | None = None) -> "RuntimeConfig":
@@ -59,4 +63,5 @@ class RuntimeConfig:
         cfg.trace_enabled = _env("DYN_TRACE_ENABLED", cfg.trace_enabled)
         cfg.trace_sample = _env("DYN_TRACE_SAMPLE", cfg.trace_sample)
         cfg.trace_buffer = _env("DYN_TRACE_BUFFER", cfg.trace_buffer)
+        cfg.drain_timeout_s = _env("DYN_WORKER_DRAIN_TIMEOUT_S", cfg.drain_timeout_s)
         return cfg

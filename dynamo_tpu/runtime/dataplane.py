@@ -29,6 +29,27 @@ receiving process parent to the sender's span and the whole request
 stitches into one trace across disagg and migration hops. Intermediaries
 must forward both keys verbatim (mint a child traceparent only when
 starting a new span of their own).
+
+Failure containment (the availability contract request migration builds
+on, reference RetryManager `lib/llm/src/migration.rs:26`):
+
+* **Deadlines.** Dials are bounded by ``EgressPolicy.connect_s``;
+  consumer waits on a response stream are bounded by the per-token
+  STALL deadline ``EgressPolicy.stall_s`` — a wedged-but-connected
+  worker (engine loop dead, socket alive) surfaces as a synthesized
+  ``ConnectionError`` carrying ``worker_id``, which the migration layer
+  treats exactly like worker death: replay on another instance.
+* **Circuit breaker.** Consecutive connect failures / connection deaths
+  per address open a breaker; while open, dials fail fast (no connect
+  timeout burned per attempt); after ``breaker_reset_s`` a single
+  half-open probe decides. State exports on ``/metrics``
+  (status_server.bind_egress_gauges).
+* **Eager eviction.** A dead connection is removed from the pool the
+  moment its reader loop exits — not at the next ``_get_conn`` — so
+  every routing decision sees live pool state.
+* **Drain-aware errors.** A draining server (graceful SIGTERM) answers
+  new requests with a distinguished err frame that the client surfaces
+  as ``ConnectionError`` — i.e. "retry elsewhere", not "request failed".
 """
 
 from __future__ import annotations
@@ -36,14 +57,141 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from typing import Any, AsyncIterator, Awaitable, Callable
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable
 
-from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime import chaos, framing
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.tasks import spawn_logged
 
 log = logging.getLogger("dynamo_tpu.dataplane")
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+# Distinguished err payload a draining server answers new requests with;
+# clients map it to ConnectionError so migration replays elsewhere.
+DRAINING_ERR = "worker draining"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw is not None else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class EgressPolicy:
+    """Client-side containment knobs (env-overridable per process)."""
+
+    # Dial deadline for one egress connect.
+    connect_s: float = 5.0
+    # Per-frame stall deadline on a response stream: maximum time a
+    # consumer waits for the NEXT frame before the stream is declared
+    # stalled and synthesized into a ConnectionError. <= 0 disables.
+    stall_s: float | None = 60.0
+    # Circuit breaker: consecutive failures to open; cooldown before the
+    # half-open probe.
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "EgressPolicy":
+        d = cls()  # fallbacks come from the field defaults above
+        stall = _env_float(
+            "DYN_DATAPLANE_STALL_TIMEOUT_S", d.stall_s if d.stall_s else 0.0
+        )
+        return cls(
+            connect_s=_env_float("DYN_DATAPLANE_CONNECT_TIMEOUT_S", d.connect_s),
+            stall_s=None if stall <= 0 else stall,
+            breaker_threshold=int(
+                _env_float("DYN_DATAPLANE_BREAKER_THRESHOLD", d.breaker_threshold)
+            ),
+            breaker_reset_s=_env_float(
+                "DYN_DATAPLANE_BREAKER_RESET_S", d.breaker_reset_s
+            ),
+        )
+
+
+class CircuitBreaker:
+    """Per-address three-state breaker (closed → open → half-open).
+
+    Closed: all dials pass. After ``threshold`` consecutive failures the
+    breaker opens and dials fail fast for ``reset_s``; then exactly one
+    probe is let through (half-open) — its outcome closes or re-opens.
+    Parity: the availability pattern the reference delegates to its NATS
+    client; our dataplane owns its own connections so it owns this too.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        reset_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.threshold = max(1, threshold)
+        self.reset_s = reset_s
+        self._clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opens_total = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    def allow(self) -> bool:
+        if self.state == self.CLOSED:
+            return True
+        now = self._clock()
+        if self.state == self.OPEN:
+            if now - self._opened_at >= self.reset_s:
+                self.state = self.HALF_OPEN
+                self._probe_at = now
+                return True  # the single probe
+            return False
+        # Half-open: a probe is in flight — hold further dials, UNLESS
+        # the probe went stale (its task was cancelled mid-dial and never
+        # reported back); re-arm rather than wedging the address forever.
+        if now - self._probe_at >= self.reset_s:
+            self._probe_at = now
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if (
+            self.state == self.HALF_OPEN
+            or self.consecutive_failures >= self.threshold
+        ):
+            if self.state != self.OPEN:
+                self.opens_total += 1
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+
+    def stats(self) -> dict:
+        return {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "opens_total": self.opens_total,
+        }
+
+
+class BreakerOpenError(ConnectionError):
+    """Dial rejected fast: the address's circuit breaker is open."""
+
+    def __init__(self, address: str):
+        super().__init__(f"circuit breaker open for {address}")
+        self.address = address
 
 
 class IngressServer:
@@ -61,6 +209,11 @@ class IngressServer:
         self._inflight: dict[tuple[int, int], tuple[asyncio.Task, Context]] = {}
         self._conn_ids = itertools.count(1)
         self._writers: set[asyncio.StreamWriter] = set()
+        # Graceful drain: while True, new requests are refused with a
+        # retryable err frame; _idle signals the in-flight set emptied.
+        self.draining = False
+        self._idle = asyncio.Event()
+        self._idle.set()
 
     def register(self, route: str, handler: Handler) -> None:
         self._routes[route] = handler
@@ -75,6 +228,24 @@ class IngressServer:
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new requests and wait for in-flight handlers
+        to finish. Returns True when everything completed within the
+        deadline, False when stragglers remain (the caller's stop() will
+        kill them, which surfaces to peers as worker death → migration)."""
+        self.draining = True
+        if not self._inflight:
+            return True
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            log.warning(
+                "drain deadline passed with %d request(s) still in flight",
+                len(self._inflight),
+            )
+            return False
 
     async def stop(self) -> None:
         for task, ctx in self._inflight.values():
@@ -98,9 +269,20 @@ class IngressServer:
         self._writers.add(writer)
         try:
             while True:
+                # Server side waits indefinitely for client traffic by
+                # design: an idle multiplexed conn is healthy, and conn
+                # death surfaces as EOF.
+                # dynalint: unbounded-ok — server read loop idles between frames
                 msg = await framing.read_frame(reader)
                 kind = msg.get("t")
                 if kind == "req":
+                    if self.draining:
+                        async with write_lock:
+                            await framing.send_frame(
+                                writer,
+                                {"t": "err", "i": msg["i"], "err": DRAINING_ERR},
+                            )
+                        continue
                     key = (conn_id, msg["i"])
                     ctx = Context(
                         request_id=msg.get("h", {}).get("x-request-id"),
@@ -110,6 +292,7 @@ class IngressServer:
                         self._serve_one(writer, write_lock, key, msg, ctx)
                     )
                     self._inflight[key] = (task, ctx)
+                    self._idle.clear()
                 elif kind in ("stop", "kill"):
                     entry = self._inflight.get((conn_id, msg["i"]))
                     if entry is not None:
@@ -127,6 +310,8 @@ class IngressServer:
                 task, ctx = self._inflight.pop(key)
                 ctx.kill()
                 task.cancel()
+            if not self._inflight:
+                self._idle.set()
             self._writers.discard(writer)
             writer.close()
 
@@ -164,18 +349,28 @@ class IngressServer:
                 pass
         finally:
             self._inflight.pop(key, None)
+            if not self._inflight:
+                self._idle.set()
 
 
 class ResponseStream:
-    """Client-side view of one in-flight streamed response."""
+    """Client-side view of one in-flight streamed response.
+
+    ``worker_id`` (set by EndpointClient.direct) rides every failure this
+    stream synthesizes, so the migration layer knows WHICH instance to
+    exclude on replay — including the stall case, where no transport
+    error ever fires.
+    """
 
     _END = object()
 
-    def __init__(self, conn: "_EgressConn", req_id: int):
+    def __init__(self, conn: "_EgressConn", req_id: int, stall_s: float | None = None):
         self._conn = conn
         self._req_id = req_id
         self._queue: asyncio.Queue[Any] = asyncio.Queue()
         self._done = False
+        self._stall_s = stall_s
+        self.worker_id: int | None = None
 
     def _push(self, item: Any) -> None:
         self._queue.put_nowait(item)
@@ -186,7 +381,26 @@ class ResponseStream:
     async def __anext__(self) -> Any:
         if self._done:
             raise StopAsyncIteration
-        item = await self._queue.get()
+        try:
+            # Fast path: a frame is already buffered — no deadline task.
+            item = self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            try:
+                item = await asyncio.wait_for(self._queue.get(), self._stall_s)
+            except asyncio.TimeoutError:
+                # Stalled-but-connected worker: the socket is alive but no
+                # frame arrived within the stall budget. Synthesize the
+                # same failure shape as worker death so migration replays
+                # the request elsewhere, and abandon the stream so a
+                # late-reviving worker cannot double-deliver.
+                self._done = True
+                self._conn.abandon(self._req_id)
+                err = ConnectionError(
+                    f"stream from {self._conn.address} stalled for "
+                    f"{self._stall_s:.1f}s (req {self._req_id})"
+                )
+                err.worker_id = self.worker_id  # type: ignore[attr-defined]
+                raise err from None
         if item is self._END:
             self._done = True
             raise StopAsyncIteration
@@ -205,38 +419,91 @@ class ResponseStream:
 
 
 class _EgressConn:
-    def __init__(self, address: str):
+    def __init__(
+        self,
+        address: str,
+        policy: EgressPolicy | None = None,
+        on_dead: Callable[["_EgressConn"], None] | None = None,
+        on_stall: Callable[[], None] | None = None,
+    ):
         self.address = address
         host, _, port = address.rpartition(":")
         self._host, self._port = host or "127.0.0.1", int(port)
+        self.policy = policy or EgressPolicy()
         self._writer: asyncio.StreamWriter | None = None
         self._streams: dict[int, ResponseStream] = {}
         self._ids = itertools.count(1)
         self._lock = asyncio.Lock()
         self._reader_task: asyncio.Task | None = None
         self.healthy = True
+        self._on_dead = on_dead
+        self._on_stall = on_stall
 
     async def connect(self) -> None:
-        reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        if chaos.active():
+            await chaos.inject("dataplane.connect", self.address)
+        reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self._host, self._port),
+            self.policy.connect_s,
+        )
         self._reader_task = asyncio.create_task(self._recv_loop(reader))
 
     async def send(self, frame: dict) -> None:
         if self._writer is None:
             raise ConnectionError("egress not connected")
+        if chaos.active() and not await chaos.inject("dataplane.send", self.address):
+            return  # frame dropped by the active chaos plan
         async with self._lock:
             await framing.send_frame(self._writer, frame)
 
-    async def request(self, route: str, payload: Any, headers: dict[str, str]) -> ResponseStream:
+    async def request(
+        self,
+        route: str,
+        payload: Any,
+        headers: dict[str, str],
+        worker_id: int | None = None,
+    ) -> ResponseStream:
         req_id = next(self._ids)
-        stream = ResponseStream(self, req_id)
+        stream = ResponseStream(self, req_id, stall_s=self.policy.stall_s)
+        # Attribution BEFORE the frame is written: a refusal/death raced
+        # against the send must already carry the instance id.
+        stream.worker_id = worker_id
         self._streams[req_id] = stream
         await self.send({"t": "req", "i": req_id, "m": route, "h": headers, "p": payload})
         return stream
 
+    def abandon(self, req_id: int) -> None:
+        """Forget one stream (stall eviction): deregister it so late
+        frames are discarded, and best-effort kill the server side."""
+        if self._streams.pop(req_id, None) is None:
+            return
+        if self._on_stall is not None:
+            self._on_stall()
+        if self._writer is not None and self.healthy:
+            spawn_logged(
+                self._kill_quietly(req_id),
+                name=f"dataplane-kill-{req_id}",
+                logger=log,
+            )
+
+    async def _kill_quietly(self, req_id: int) -> None:
+        try:
+            await self.send({"t": "kill", "i": req_id})
+        except (ConnectionError, OSError):
+            pass  # the conn died under us; the server reaps on EOF
+
     async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
         try:
             while True:
+                # Idle multiplexed conn between streams is healthy; the
+                # consumer-facing bound is the per-stream stall deadline
+                # in ResponseStream.
+                # dynalint: unbounded-ok — bounded per stream by the stall deadline
                 msg = await framing.read_frame(reader)
+                if chaos.active() and not await chaos.inject(
+                    "dataplane.recv", self.address
+                ):
+                    continue  # frame dropped by the active chaos plan
                 stream = self._streams.get(msg["i"])
                 if stream is None:
                     continue
@@ -247,16 +514,32 @@ class _EgressConn:
                     stream._push(ResponseStream._END)
                     self._streams.pop(msg["i"], None)
                 elif kind == "err":
-                    stream._push(EngineStreamError(msg["err"]))
+                    if msg["err"] == DRAINING_ERR:
+                        # Graceful drain refusal: retryable, not a
+                        # request failure — migration replays elsewhere.
+                        err: Exception = ConnectionError(
+                            f"worker at {self.address} is draining"
+                        )
+                        err.worker_id = stream.worker_id  # type: ignore[attr-defined]
+                    else:
+                        err = EngineStreamError(msg["err"])
+                    stream._push(err)
                     self._streams.pop(msg["i"], None)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
             self.healthy = False
-            err = ConnectionError(f"connection to {self.address} lost")
-            for stream in self._streams.values():
-                stream._push(err)
+            # Exactly-once failure delivery: drain the registry FIRST so
+            # no other path can push to these streams again, then hand
+            # each its own tagged error.
+            streams = list(self._streams.values())
             self._streams.clear()
+            for stream in streams:
+                err = ConnectionError(f"connection to {self.address} lost")
+                err.worker_id = stream.worker_id  # type: ignore[attr-defined]
+                stream._push(err)
+            if self._on_dead is not None:
+                self._on_dead(self)
 
     def close(self) -> None:
         self.healthy = False
@@ -273,9 +556,44 @@ class EgressClient:
     `tcp/client.rs` (addressed request push + response registration).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, policy: EgressPolicy | None = None) -> None:
+        self.policy = policy or EgressPolicy.from_env()
         self._conns: dict[str, _EgressConn] = {}
         self._locks: dict[str, asyncio.Lock] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._stalls: dict[str, int] = {}
+
+    def _breaker(self, address: str) -> CircuitBreaker:
+        br = self._breakers.get(address)
+        if br is None:
+            br = self._breakers[address] = CircuitBreaker(
+                threshold=self.policy.breaker_threshold,
+                reset_s=self.policy.breaker_reset_s,
+            )
+        return br
+
+    def _on_conn_dead(self, conn: _EgressConn) -> None:
+        """Eager eviction: the reader loop saw the conn die — remove it
+        from the pool NOW (not at the next dial) and count the failure.
+        A conn no longer pooled (replaced, or already evicted by the
+        stall path) is not re-debited."""
+        if self._conns.get(conn.address) is conn:
+            del self._conns[conn.address]
+            self._breaker(conn.address).record_failure()
+
+    def _note_stall(self, address: str) -> None:
+        """A stall counts against the breaker (a wedged worker is as
+        unroutable as a dead one), and the stalled conn is evicted AND
+        closed: its socket is alive but its worker is not answering, so
+        leaving it pooled would route fresh requests into the same
+        stall_s black hole, and its other in-flight streams are doomed
+        anyway — closing fails them over NOW instead of one stall budget
+        each."""
+        self._stalls[address] = self._stalls.get(address, 0) + 1
+        self._breaker(address).record_failure()
+        conn = self._conns.pop(address, None)
+        if conn is not None:
+            conn.close()
 
     async def _get_conn(self, address: str) -> _EgressConn:
         conn = self._conns.get(address)
@@ -286,8 +604,21 @@ class EgressClient:
             conn = self._conns.get(address)
             if conn is not None and conn.healthy:
                 return conn
-            conn = _EgressConn(address)
-            await conn.connect()
+            breaker = self._breaker(address)
+            if not breaker.allow():
+                raise BreakerOpenError(address)
+            conn = _EgressConn(
+                address,
+                policy=self.policy,
+                on_dead=self._on_conn_dead,
+                on_stall=lambda addr=address: self._note_stall(addr),
+            )
+            try:
+                await conn.connect()
+            except (OSError, asyncio.TimeoutError) as e:
+                breaker.record_failure()
+                raise ConnectionError(f"connect to {address} failed: {e}") from e
+            breaker.record_success()
             self._conns[address] = conn
             return conn
 
@@ -297,14 +628,28 @@ class EgressClient:
         route: str,
         payload: Any,
         headers: dict[str, str] | None = None,
+        worker_id: int | None = None,
     ) -> ResponseStream:
         conn = await self._get_conn(address)
-        return await conn.request(route, payload, headers or {})
+        return await conn.request(route, payload, headers or {}, worker_id=worker_id)
+
+    def stats(self) -> dict[str, dict]:
+        """Per-address containment state (breaker + stall counters) for
+        /metrics export and operator introspection."""
+        out: dict[str, dict] = {}
+        for address, br in self._breakers.items():
+            st = br.stats()
+            conn = self._conns.get(address)
+            st["connected"] = bool(conn is not None and conn.healthy)
+            st["stalls_total"] = self._stalls.get(address, 0)
+            out[address] = st
+        return out
 
     def close(self) -> None:
         for conn in self._conns.values():
             conn.close()
         self._conns.clear()
+        self._locks.clear()
 
 
 class EngineStreamError(RuntimeError):

@@ -20,6 +20,8 @@ from typing import Any
 
 import msgpack
 
+from dynamo_tpu.runtime import chaos
+
 _LEN = struct.Struct(">I")
 
 MAX_FRAME = 512 * 1024 * 1024  # 512 MiB hard cap (KV block transfers are big)
@@ -32,12 +34,18 @@ def pack(msg: Any) -> bytes:
 
 async def read_frame(reader: asyncio.StreamReader) -> Any:
     """Read one frame; raises IncompleteReadError / ConnectionError on EOF."""
-    header = await reader.readexactly(4)
-    (length,) = _LEN.unpack(header)
-    if length > MAX_FRAME:
-        raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
-    body = await reader.readexactly(length)
-    return msgpack.unpackb(body, raw=False)
+    while True:
+        header = await reader.readexactly(4)
+        (length,) = _LEN.unpack(header)
+        if length > MAX_FRAME:
+            raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
+        body = await reader.readexactly(length)
+        msg = msgpack.unpackb(body, raw=False)
+        # Codec-level chaos (every plane): a dropped frame is read and
+        # discarded, so the stream stays framed; sever raises here.
+        if chaos.active() and not await chaos.inject("framing.recv"):
+            continue
+        return msg
 
 
 def write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
@@ -45,5 +53,7 @@ def write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
 
 
 async def send_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
+    if chaos.active() and not await chaos.inject("framing.send"):
+        return  # dropped by the active chaos plan
     writer.write(pack(msg))
     await writer.drain()

@@ -183,6 +183,62 @@ def bind_kv_cache_gauges(
         )
 
 
+# Dataplane egress containment gauges: per-address circuit-breaker state
+# and stall counters (EgressClient.stats() keys). Addresses are dynamic —
+# they appear as the pool dials — so these sync via a before_render hook
+# instead of set_function children.
+EGRESS_GAUGES: dict[str, tuple[str, str]] = {
+    "breaker_open": (
+        "egress_breaker_open",
+        "1 when the address's circuit breaker is open (dials fail fast)",
+    ),
+    "breaker_half_open": (
+        "egress_breaker_half_open",
+        "1 while a single half-open probe decides the breaker's fate",
+    ),
+    "consecutive_failures": (
+        "egress_consecutive_failures",
+        "Consecutive connect failures / conn deaths / stalls for the address",
+    ),
+    "opens_total": (
+        "egress_breaker_opens_total",
+        "Times the address's breaker has opened since start",
+    ),
+    "stalls_total": (
+        "egress_stream_stalls_total",
+        "Response streams declared stalled (per-token deadline) for the address",
+    ),
+    "connected": (
+        "egress_connected",
+        "1 while a live pooled connection to the address exists",
+    ),
+}
+
+
+def bind_egress_gauges(status: "SystemStatusServer | None", egress) -> None:
+    """Export the egress pool's per-address breaker/stall state on
+    /metrics (labels: service=dataplane, address=<host:port>). No-op when
+    the status server is disabled."""
+    if status is None:
+        return
+
+    def sync() -> None:
+        for address, st in egress.stats().items():
+            scoped = status.metrics.scoped(service="dataplane", address=address)
+            values = {
+                "breaker_open": 1.0 if st["state"] == "open" else 0.0,
+                "breaker_half_open": 1.0 if st["state"] == "half-open" else 0.0,
+                "consecutive_failures": float(st["consecutive_failures"]),
+                "opens_total": float(st["opens_total"]),
+                "stalls_total": float(st["stalls_total"]),
+                "connected": 1.0 if st["connected"] else 0.0,
+            }
+            for key, (name, doc) in EGRESS_GAUGES.items():
+                scoped.gauge(name, doc).set(values[key])
+
+    status.before_render.append(sync)
+
+
 class SystemStatusServer:
     def __init__(
         self,
@@ -194,6 +250,11 @@ class SystemStatusServer:
         self.host = host
         self.port = port
         self._started_at = time.monotonic()
+        # Hooks run before each /metrics render — for exporters whose
+        # label sets are dynamic (e.g. per-address breaker gauges, where
+        # addresses appear as the egress pool dials new workers) and so
+        # cannot pre-bind set_function children.
+        self.before_render: list[Callable[[], None]] = []
         # endpoint path -> "ready" | "notready"
         self.endpoint_health: dict[str, str] = {}
         self.app = web.Application()
@@ -245,6 +306,8 @@ class SystemStatusServer:
         self.metrics.scoped(service="system").gauge("system_uptime_seconds").set(
             self.uptime_s
         )
+        for hook in self.before_render:
+            hook()
         return web.Response(body=self.metrics.render(), content_type="text/plain")
 
     async def traces(self, request: web.Request) -> web.Response:

@@ -15,7 +15,7 @@ import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
-from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime import chaos, framing
 
 log = logging.getLogger("dynamo_tpu.store.client")
 
@@ -23,6 +23,11 @@ log = logging.getLogger("dynamo_tpu.store.client")
 RECONNECT_BASE_S = 0.2
 RECONNECT_FACTOR = 2.0
 RECONNECT_CAP_S = 2.0
+
+# Dial deadline for one store connect/redial attempt: an unreachable (as
+# opposed to refusing) store must fail the attempt into the backoff loop,
+# not hang it.
+CONNECT_TIMEOUT_S = 5.0
 
 
 def reconnect_delay(attempt: int, rng: random.Random | None = None) -> float:
@@ -65,6 +70,8 @@ class Subscription:
 
     async def __aiter__(self) -> AsyncIterator[Any]:
         while True:
+            # Push stream; consumers needing a deadline use .get(timeout).
+            # dynalint: unbounded-ok — server-push subscription stream
             item = await self.queue.get()
             if item is self._CLOSED:
                 return
@@ -117,7 +124,11 @@ class StoreClient:
     async def connect(self) -> "StoreClient":
         if self._writer is not None:
             return self
-        self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        if chaos.active():
+            await chaos.inject("store.connect", self.address)
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self._host, self._port), CONNECT_TIMEOUT_S
+        )
         self._reader_task = asyncio.create_task(self._recv_loop())
         return self
 
@@ -153,7 +164,15 @@ class StoreClient:
         assert self._reader is not None
         try:
             while True:
+                # Long-lived multiplexed session: idle is healthy, death
+                # surfaces as EOF and enters the reconnect loop; request
+                # futures are the bounded consumer surface.
+                # dynalint: unbounded-ok — session read loop idles between pushes
                 msg = await framing.read_frame(self._reader)
+                if chaos.active() and not await chaos.inject(
+                    "store.frame", self.address
+                ):
+                    continue  # frame dropped by the active chaos plan
                 if "s" in msg:  # server push
                     sub = self._subs.get(msg["s"])
                     if sub is not None:
@@ -198,11 +217,14 @@ class StoreClient:
         attempt = 0
         while not self._closed:
             try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    self._host, self._port
+                if chaos.active():
+                    await chaos.inject("store.connect", self.address)
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self._host, self._port),
+                    CONNECT_TIMEOUT_S,
                 )
                 break
-            except OSError:
+            except (OSError, asyncio.TimeoutError):
                 await asyncio.sleep(reconnect_delay(attempt))
                 attempt += 1
         if self._closed:

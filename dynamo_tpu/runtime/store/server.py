@@ -158,6 +158,7 @@ class StoreServer:
         sender = asyncio.create_task(self._send_loop(conn))
         try:
             while True:
+                # dynalint: unbounded-ok — server read loop idles between requests
                 msg = await framing.read_frame(reader)
                 try:
                     result = await self._dispatch(conn, msg)
@@ -173,6 +174,7 @@ class StoreServer:
     async def _send_loop(self, conn: _Conn) -> None:
         try:
             while True:
+                # dynalint: unbounded-ok — local outbound queue, fed in-process
                 frame = await conn.queue.get()
                 if frame is None:
                     break

@@ -41,26 +41,36 @@ def dynamo_worker(
 
 async def _run(fn: Callable[..., Awaitable[Any]], cfg: RuntimeConfig, *args, **kwargs) -> Any:
     from dynamo_tpu import tracing
+    from dynamo_tpu.runtime import chaos
 
     # Config-file overlays can differ from the env the tracing module
     # read at import — re-apply the resolved values.
     tracing.configure(
         enabled=cfg.trace_enabled, sample=cfg.trace_sample, buffer=cfg.trace_buffer
     )
+    # Fault injection (DYN_CHAOS_PLAN): armed before any connection
+    # exists so even the first store dial is under the plan.
+    chaos.install_from_env()
     runtime = await DistributedRuntime.create(
         cfg.store_address, lease_ttl=cfg.lease_ttl_s, ingress_host=cfg.ingress_host
     )
     if cfg.system_enabled:
-        from dynamo_tpu.runtime.status_server import SystemStatusServer
+        from dynamo_tpu.runtime.status_server import SystemStatusServer, bind_egress_gauges
 
         runtime.status = SystemStatusServer(port=cfg.system_port)
         await runtime.status.start()
+        bind_egress_gauges(runtime.status, runtime.egress)
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        try:
-            loop.add_signal_handler(sig, runtime.signal_shutdown)
-        except NotImplementedError:  # non-main thread
-            pass
+    # SIGINT: immediate shutdown. SIGTERM: graceful drain — deregister
+    # from discovery, stop admitting, finish (or migrate) in-flight
+    # streams within the drain budget, release the lease, then exit.
+    try:
+        loop.add_signal_handler(signal.SIGINT, runtime.signal_shutdown)
+        loop.add_signal_handler(
+            signal.SIGTERM, runtime.request_drain, cfg.drain_timeout_s
+        )
+    except NotImplementedError:  # non-main thread
+        pass
     try:
         return await fn(runtime, *args, **kwargs)
     finally:

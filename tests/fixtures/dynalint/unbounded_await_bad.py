@@ -1,0 +1,24 @@
+"""Seeded unbounded-await violations (4 findings): network awaits with
+no deadline scope and no `# dynalint: unbounded-ok` pragma."""
+
+import asyncio
+
+from dynamo_tpu.runtime import framing
+
+
+async def dial(host, port):
+    reader, writer = await asyncio.open_connection(host, port)   # finding 1
+    msg = await framing.read_frame(reader)                       # finding 2
+    return writer, msg
+
+
+class Stream:
+    def __init__(self):
+        self._queue = asyncio.Queue()
+
+    async def __anext__(self):
+        return await self._queue.get()                           # finding 3
+
+
+async def pop_event(sub):
+    return await sub.queue.get()                                 # finding 4

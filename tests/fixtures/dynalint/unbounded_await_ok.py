@@ -1,0 +1,40 @@
+"""Clean twin: every network await is bounded (wait_for / asyncio.timeout)
+or carries an explicit unbounded-ok pragma; non-network `.get` receivers
+stay quiet."""
+
+import asyncio
+
+from dynamo_tpu.runtime import framing
+
+
+async def dial_bounded(host, port):
+    # wait_for-wrapped: the inner call is an argument, not awaited.
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), 5.0
+    )
+    async with asyncio.timeout(10.0):
+        msg = await framing.read_frame(reader)  # inside a timeout scope
+    return writer, msg
+
+
+class Stream:
+    def __init__(self):
+        self._queue = asyncio.Queue()
+
+    async def __anext__(self):
+        try:
+            return self._queue.get_nowait()  # sync fast path: not an await
+        except asyncio.QueueEmpty:
+            return await asyncio.wait_for(self._queue.get(), 30.0)
+
+
+async def serve_loop(reader):
+    # dynalint: unbounded-ok — server read loop idles between frames
+    return await framing.read_frame(reader)
+
+
+async def not_network(msg, settings):
+    # Plain dict/config `.get` receivers never match the rule.
+    kind = msg.get("t")
+    level = settings.get("level")
+    return kind, level

@@ -1,0 +1,644 @@
+"""Chaos harness + failure containment (ISSUE 6).
+
+Every scenario runs a mocker fleet under a seeded ChaosPlan and asserts
+the containment contract: accepted requests complete with token streams
+BIT-IDENTICAL to the no-fault run, no token lost or duplicated —
+worker-kill mid-decode, a stalled-but-connected engine loop, a flapping
+store session, and a partitioned dataplane all reduce to the same
+client-visible outcome. Plus the unit surface: circuit breaker state
+machine, exactly-once failure delivery, eager conn eviction, graceful
+drain ordering, migration backoff bounds, replay usage accounting, and
+the disabled-chaos no-op guarantee.
+"""
+
+import asyncio
+import random
+import struct
+import time
+from contextlib import suppress
+
+import msgpack
+import pytest
+
+from dynamo_tpu.llm.migration import Migration, MigrationOperator, RouterEgress
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.llm.protocols.common import (
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import DistributedRuntime, chaos
+from dynamo_tpu.runtime.chaos import ChaosPlan, ChaosRule
+from dynamo_tpu.runtime.dataplane import (
+    BreakerOpenError,
+    CircuitBreaker,
+    EgressClient,
+    EgressPolicy,
+    IngressServer,
+)
+from dynamo_tpu.runtime.pipeline import PipelineBuilder
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.runtime.store.client import reconnect_delay
+
+pytestmark = [pytest.mark.integration, pytest.mark.pre_merge]
+
+
+def expected_tokens(n: int) -> list[int]:
+    """The mocker's deterministic 'a'..'z' cycle — the no-fault stream."""
+    return [97 + (i % 26) for i in range(n)]
+
+
+def make_req(rid: str, max_tokens: int = 12) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        model="mock",
+        token_ids=[1, 2, 3, 4],
+        request_id=rid,
+        sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+class Fleet:
+    """Store + N mocker-engine workers + a routing client with the full
+    migration pipeline — the minimal real-runtime fleet every chaos
+    scenario runs against."""
+
+    def __init__(
+        self,
+        n: int = 2,
+        args: MockEngineArgs | None = None,
+        stall_s: float | None = None,
+    ):
+        self.n = n
+        self.args = args or MockEngineArgs(num_kv_blocks=512, block_size=8)
+        self.stall_s = stall_s
+        self.workers: list[tuple[DistributedRuntime, MockTpuEngine]] = []
+
+    async def __aenter__(self) -> "Fleet":
+        self.store = StoreServer()
+        await self.store.start()
+        for i in range(self.n):
+            rt = await DistributedRuntime.create(self.store.address)
+            engine = MockTpuEngine(self.args)
+            engine.chaos_tag = f"w{i}"
+            ep = rt.namespace("chaos").component("w").endpoint("generate")
+
+            async def handler(req, ctx, engine=engine):
+                async for out in engine.generate(req, ctx):
+                    yield out
+
+            await ep.serve(handler)
+            self.workers.append((rt, engine))
+        self.client_rt = await DistributedRuntime.create(self.store.address)
+        if self.stall_s is not None:
+            self.client_rt.egress.policy.stall_s = self.stall_s
+        self.client = await (
+            self.client_rt.namespace("chaos").component("w").endpoint("generate").client()
+        )
+        await self.client.wait_for_instances(self.n, timeout=10)
+        self.migration = Migration(
+            client=self.client, push_router=None, mode="round_robin", limit=3
+        )
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        chaos.uninstall()
+        await self.client.stop()
+        await self.client_rt.shutdown()
+        for rt, _ in self.workers:
+            with suppress(ConnectionError, OSError):
+                await rt.shutdown()
+        await self.store.stop()
+
+    def serving_worker(self) -> tuple[DistributedRuntime, MockTpuEngine]:
+        """The worker whose engine currently holds a running sequence."""
+        for rt, engine in self.workers:
+            if engine._running:
+                return rt, engine
+        raise AssertionError("no worker is serving")
+
+
+# ---------------------------------------------------------------------------
+# Scenario 1: worker killed mid-decode — stream bit-identical, usage sane.
+# ---------------------------------------------------------------------------
+
+
+async def test_worker_kill_mid_decode_bit_identical_stream():
+    # ~20 ms per decode iteration so the kill reliably lands mid-stream.
+    args = MockEngineArgs(num_kv_blocks=512, block_size=8, decode_us_per_seq=20000.0)
+
+    # No-fault baseline first (fresh fleet: no shared state).
+    async with Fleet(1, args, stall_s=5.0) as f:
+        baseline = []
+        async for out in f.migration.generate(make_req("base-1")):
+            baseline.extend(out.token_ids)
+    assert baseline == expected_tokens(12)
+
+    async with Fleet(2, args, stall_s=5.0) as f:
+        tokens: list[int] = []
+        outs: list[LLMEngineOutput] = []
+        killed = False
+        async for out in f.migration.generate(make_req("kill-1")):
+            tokens.extend(out.token_ids)
+            outs.append(out)
+            if not killed and len(tokens) >= 3:
+                killed = True
+                victim, _ = f.serving_worker()
+                await victim.shutdown()  # worker dies with the stream in flight
+        assert killed, "stream finished before the kill landed — slow the mocker"
+        # Bit-identical to the no-fault run: nothing lost, nothing duplicated.
+        assert tokens == baseline
+        # Late-failure replay accounting: the replayed tokens are charged
+        # once — prompt_tokens is the ORIGINAL prompt, completion_tokens
+        # the full client-visible stream (not just the final attempt's).
+        final = outs[-1]
+        assert final.finish_reason is not None
+        assert final.prompt_tokens == 4
+        assert final.completion_tokens == 12
+
+
+# ---------------------------------------------------------------------------
+# Scenario 2: stalled-but-connected worker — stall deadline detects it,
+# migration replays, stream stays bit-identical.
+# ---------------------------------------------------------------------------
+
+
+async def test_stalled_worker_detected_and_migrated_within_budget():
+    args = MockEngineArgs(num_kv_blocks=512, block_size=8, decode_us_per_seq=5000.0)
+    async with Fleet(2, args, stall_s=0.4) as f:
+        tokens: list[int] = []
+        stalled_at = None
+        stalled_tag = None
+        async for out in f.migration.generate(make_req("stall-1")):
+            tokens.extend(out.token_ids)
+            if stalled_at is None and len(tokens) >= 3:
+                _, engine = f.serving_worker()
+                stalled_tag = engine.chaos_tag
+                chaos.install(ChaosPlan([
+                    ChaosRule(
+                        point="engine.step", action="stall",
+                        match=stalled_tag, stall_s=60.0,
+                    ),
+                ], seed=42))
+                stalled_at = time.monotonic()
+        assert stalled_at is not None
+        # The wedged worker never closed its socket — only the per-token
+        # stall deadline can have fired. Detection + migration + replayed
+        # completion must fit a small multiple of the 0.4s budget.
+        assert time.monotonic() - stalled_at < 5.0
+        assert tokens == expected_tokens(12)
+        stats = f.client_rt.egress.stats()
+        assert any(st["stalls_total"] >= 1 for st in stats.values()), stats
+        # The stalled conn was evicted from the pool — a fresh request
+        # must not be routed into the same stall_s black hole.
+        stalled_rt = next(rt for rt, e in f.workers if e.chaos_tag == stalled_tag)
+        assert stalled_rt.ingress.address not in f.client_rt.egress._conns
+        # The migration replayed on the OTHER worker.
+        others = [e for _, e in f.workers if e.chaos_tag != stalled_tag]
+        assert sum(1 for e in others if e._iterations > 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Scenario 3: store session flap — sever the control-plane stream; the
+# session rebuilds (leases re-attached, watches replayed) and the fleet
+# keeps serving.
+# ---------------------------------------------------------------------------
+
+
+async def test_store_flap_session_rebuilds_and_requests_complete():
+    args = MockEngineArgs(num_kv_blocks=512, block_size=8)
+    async with Fleet(1, args, stall_s=5.0) as f:
+        # Sever exactly one inbound store frame: the client runtime's
+        # session drops mid-request and must rebuild.
+        chaos.install(ChaosPlan([
+            ChaosRule(point="store.frame", action="sever", count=1),
+        ], seed=7))
+        with pytest.raises(ConnectionError):
+            await f.client_rt.store.ping()
+        chaos.uninstall()
+        # Reconnect loop redials with jittered backoff; poll until live.
+        for _ in range(200):
+            try:
+                await f.client_rt.store.ping()
+                break
+            except ConnectionError:
+                await asyncio.sleep(0.02)
+        else:
+            raise AssertionError("store session never rebuilt after flap")
+        # The instance watch was REPLAYED, not dropped: a worker joining
+        # after the flap appears through the same subscription object.
+        rt2 = await DistributedRuntime.create(f.store.address)
+        engine2 = MockTpuEngine(args)
+        engine2.chaos_tag = "w-late"
+        ep2 = rt2.namespace("chaos").component("w").endpoint("generate")
+
+        async def handler2(req, ctx):
+            async for out in engine2.generate(req, ctx):
+                yield out
+
+        await ep2.serve(handler2)
+        try:
+            await f.client.wait_for_instances(2, timeout=10)
+            # And requests still stream bit-identically end to end.
+            tokens = []
+            async for out in f.migration.generate(make_req("flap-1")):
+                tokens.extend(out.token_ids)
+            assert tokens == expected_tokens(12)
+        finally:
+            await rt2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Scenario 4: dataplane partition — severed frames from one worker kill
+# the conn; streams fail over by token replay, pool evicts eagerly.
+# ---------------------------------------------------------------------------
+
+
+async def test_dataplane_partition_migrates_and_evicts():
+    args = MockEngineArgs(num_kv_blocks=512, block_size=8, decode_us_per_seq=20000.0)
+    async with Fleet(2, args, stall_s=5.0) as f:
+        tokens: list[int] = []
+        addr = None
+        async for out in f.migration.generate(make_req("part-1")):
+            tokens.extend(out.token_ids)
+            if addr is None and len(tokens) >= 3:
+                victim, _ = f.serving_worker()
+                addr = victim.ingress.address
+                chaos.install(ChaosPlan([
+                    ChaosRule(point="dataplane.recv", action="sever", match=addr),
+                ], seed=3))
+        assert addr is not None
+        assert tokens == expected_tokens(12)
+        stats = f.client_rt.egress.stats()
+        assert stats[addr]["consecutive_failures"] >= 1
+        # Eager eviction: the dead conn left the pool when its reader
+        # died, not lazily at the next dial.
+        assert addr not in f.client_rt.egress._conns
+
+
+# ---------------------------------------------------------------------------
+# Chaos disabled: injection points are no-ops and the wire codec is
+# byte-identical to the raw length-prefixed msgpack framing.
+# ---------------------------------------------------------------------------
+
+
+async def test_chaos_disabled_noop_overhead_and_wire_format():
+    from dynamo_tpu.runtime import framing
+
+    chaos.uninstall()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        await chaos.inject("dataplane.send", "127.0.0.1:1")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 10e-6, f"disabled chaos costs {per_call * 1e6:.2f}µs/frame"
+
+    # Wire format unchanged: 4-byte BE length + msgpack body, nothing
+    # added or reordered by the chaos layer.
+    msg = {"t": "rsp", "i": 1, "p": b"ab"}
+    body = msgpack.packb(msg, use_bin_type=True)
+    assert framing.pack(msg) == struct.pack(">I", len(body)) + body
+
+
+async def test_empty_plan_stream_identical_to_no_plan():
+    args = MockEngineArgs(num_kv_blocks=512, block_size=8)
+    async with Fleet(1, args) as f:
+        base = []
+        async for out in f.migration.generate(make_req("noop-a")):
+            base.extend(out.token_ids)
+        chaos.install(ChaosPlan([], seed=1))  # armed but ruleless
+        withplan = []
+        async for out in f.migration.generate(make_req("noop-b")):
+            withplan.extend(out.token_ids)
+        assert base == withplan == expected_tokens(12)
+
+
+# ---------------------------------------------------------------------------
+# ChaosPlan unit surface: determinism, env loading, validation.
+# ---------------------------------------------------------------------------
+
+
+async def test_chaos_plan_seeded_determinism():
+    async def run(seed: int):
+        plan = ChaosPlan(
+            [ChaosRule(point="framing.send", action="drop", p=0.5)], seed=seed
+        )
+        verdicts = [await plan.fire("framing.send", "t") for _ in range(64)]
+        return verdicts, list(plan.fired)
+
+    a = await run(7)
+    b = await run(7)
+    c = await run(8)
+    assert a == b
+    assert a != c
+
+
+def test_chaos_plan_from_env_and_validation(monkeypatch):
+    monkeypatch.setenv(
+        "DYN_CHAOS_PLAN",
+        '{"seed": 3, "rules": [{"point": "store.frame", "action": "sever", "count": 1}]}',
+    )
+    plan = ChaosPlan.from_env()
+    assert plan is not None and plan.seed == 3
+    assert plan.rules[0].point == "store.frame"
+    monkeypatch.delenv("DYN_CHAOS_PLAN")
+    assert ChaosPlan.from_env() is None
+    with pytest.raises(ValueError, match="unknown chaos point"):
+        ChaosRule(point="nope", action="drop")
+    with pytest.raises(ValueError, match="unknown chaos action"):
+        ChaosRule(point="framing.send", action="explode")
+
+
+async def test_chaos_rule_after_and_count_windows():
+    plan = ChaosPlan([
+        ChaosRule(point="framing.recv", action="drop", after=2, count=2),
+    ])
+    verdicts = [await plan.fire("framing.recv", "") for _ in range(6)]
+    # Hits 1-2 pass (after), 3-4 drop (count), 5-6 pass (exhausted).
+    assert verdicts == [True, True, False, False, True, True]
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: state machine + fail-fast dialing.
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    now = [0.0]
+    br = CircuitBreaker(threshold=3, reset_s=5.0, clock=lambda: now[0])
+    assert br.allow() and br.state == br.CLOSED
+    br.record_failure()
+    br.record_failure()
+    assert br.allow()  # still closed below threshold
+    br.record_failure()
+    assert br.state == br.OPEN and br.opens_total == 1
+    assert not br.allow()  # fail fast while open
+    now[0] = 5.1
+    assert br.allow() and br.state == br.HALF_OPEN  # the single probe
+    assert not br.allow()  # second dial held during the probe
+    # A probe that never reports back (cancelled mid-dial) must not wedge
+    # the breaker: after another reset window a new probe is granted.
+    now[0] = 10.2
+    assert br.allow() and br.state == br.HALF_OPEN
+    br.record_failure()  # probe failed -> re-open, cooldown restarts
+    assert br.state == br.OPEN and br.opens_total == 2
+    now[0] = 15.4
+    assert br.allow() and br.state == br.HALF_OPEN
+    br.record_success()
+    assert br.state == br.CLOSED and br.consecutive_failures == 0
+    assert br.allow()
+
+
+async def test_breaker_opens_after_repeated_connect_failures():
+    egress = EgressClient(
+        EgressPolicy(connect_s=0.5, breaker_threshold=3, breaker_reset_s=60.0)
+    )
+    addr = "127.0.0.1:9"  # nothing listens -> instant refusal
+    for _ in range(3):
+        with pytest.raises(ConnectionError):
+            await egress.request(addr, "x", {})
+    with pytest.raises(BreakerOpenError):
+        await egress.request(addr, "x", {})
+    st = egress.stats()[addr]
+    assert st["state"] == "open"
+    assert st["opens_total"] == 1
+    assert st["consecutive_failures"] == 3
+    egress.close()
+
+
+async def test_breaker_state_exports_on_metrics():
+    from dynamo_tpu.runtime.status_server import SystemStatusServer, bind_egress_gauges
+
+    egress = EgressClient(
+        EgressPolicy(connect_s=0.5, breaker_threshold=1, breaker_reset_s=60.0)
+    )
+    addr = "127.0.0.1:9"
+    with pytest.raises(ConnectionError):
+        await egress.request(addr, "x", {})
+    status = SystemStatusServer()
+    bind_egress_gauges(status, egress)
+    for hook in status.before_render:
+        hook()
+    text = status.metrics.render().decode()
+    assert f'dynamo_egress_breaker_open{{address="{addr}",service="dataplane"}} 1.0' in text
+    assert f'dynamo_egress_breaker_opens_total{{address="{addr}",service="dataplane"}} 1.0' in text
+    egress.close()
+
+
+# ---------------------------------------------------------------------------
+# EgressClient containment details (satellites): exactly-once failure
+# delivery to every in-flight stream, eager eviction, lock cleanup.
+# ---------------------------------------------------------------------------
+
+
+async def test_connection_loss_errors_all_inflight_streams_exactly_once():
+    server = IngressServer()
+
+    async def parked(request, context: Context):
+        yield {"first": True}
+        await asyncio.sleep(3600)  # parked until the server dies
+
+    server.register("t/w/park", parked)
+    await server.start()
+    egress = EgressClient(EgressPolicy(stall_s=None))
+    s1 = await egress.request(server.address, "t/w/park", {})
+    s2 = await egress.request(server.address, "t/w/park", {})
+    assert (await s1.__anext__())["first"]
+    assert (await s2.__anext__())["first"]
+
+    await server.stop()
+
+    for stream in (s1, s2):
+        errors = 0
+        while True:
+            try:
+                await stream.__anext__()
+            except ConnectionError:
+                errors += 1  # exactly one per stream...
+            except StopAsyncIteration:
+                break
+        assert errors == 1
+    # Eager eviction: no dead conn lingers for the next _get_conn.
+    assert egress._conns == {}
+    egress.close()
+    assert egress._locks == {}
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain: deregister first, refuse new work retryably, finish
+# in-flight, then release the shutdown waiter.
+# ---------------------------------------------------------------------------
+
+
+async def test_graceful_drain_finishes_inflight_and_deregisters():
+    async with StoreServer() as store:
+        worker = await DistributedRuntime.create(store.address)
+        client_rt = await DistributedRuntime.create(store.address)
+        try:
+            async def slow(request, context: Context):
+                for i in range(10):
+                    yield {"i": i}
+                    await asyncio.sleep(0.02)
+
+            ep = worker.namespace("t").component("w").endpoint("slow")
+            await ep.serve(slow)
+            client = await client_rt.namespace("t").component("w").endpoint("slow").client()
+            await client.wait_for_instances(1, timeout=5)
+            addr = worker.ingress.address
+
+            stream = await client.round_robin({})
+            got = [await stream.__anext__(), await stream.__anext__()]
+
+            drain_task = asyncio.create_task(worker.drain(timeout=10.0))
+            await asyncio.sleep(0.05)  # deregistration + draining flag land
+
+            # New work is refused RETRYABLY (ConnectionError -> migration
+            # replays elsewhere), not failed.
+            late = await client_rt.egress.request(addr, "t/w/slow", {})
+            with pytest.raises(ConnectionError, match="draining"):
+                await late.__anext__()
+
+            # The in-flight stream runs to completion — nothing lost.
+            rest = [item async for item in stream]
+            assert [g["i"] for g in got] + [r["i"] for r in rest] == list(range(10))
+
+            assert await drain_task is True
+            assert worker._shutdown.is_set()
+            # Discovery is empty: the instance key was deleted up front.
+            assert await client_rt.store.kv_get_prefix("/dynamo/instances/") == {}
+        finally:
+            await client_rt.shutdown()
+            with suppress(ConnectionError, OSError):
+                await worker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Migration pacing (satellite): jittered exponential backoff on the
+# store client's bounded schedule, injectable for determinism.
+# ---------------------------------------------------------------------------
+
+
+def test_reconnect_delay_bounds():
+    rng = random.Random(123)
+    for attempt in range(8):
+        ceiling = min(0.2 * 2.0 ** attempt, 2.0)
+        for _ in range(50):
+            d = reconnect_delay(attempt, rng)
+            assert 0.0 <= d <= ceiling
+
+
+async def test_migration_backoff_is_jittered_and_bounded():
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def pick_instance(self, mode, exclude):
+            return self.calls + 1
+
+        async def direct(self, worker_id, payload, headers=None):
+            self.calls += 1
+            calls = self.calls
+
+            async def stream():
+                yield LLMEngineOutput(token_ids=[calls]).to_wire()
+                if calls <= 2:
+                    raise ConnectionError("down")
+                yield LLMEngineOutput(
+                    token_ids=[99], finish_reason="stop"
+                ).to_wire()
+
+            return stream()
+
+    delays: list[float] = []
+
+    async def capture(d: float) -> None:
+        delays.append(d)
+
+    op = MigrationOperator(limit=3, rng=random.Random(0))
+    op._sleep = capture
+    pipe = PipelineBuilder().link(op).backend(
+        RouterEgress(Flaky(), None, "round_robin")
+    )
+    out = [o async for o in pipe.generate(make_req("backoff-1"), Context())]
+    assert out[-1].finish_reason == "stop"
+    assert len(delays) == 2
+    assert 0.0 <= delays[0] <= 0.2      # attempt 0 ceiling
+    assert 0.0 <= delays[1] <= 0.4      # attempt 1 ceiling
+
+
+# ---------------------------------------------------------------------------
+# Replay accounting under late failure (satellite): a worker dying after
+# N streamed tokens must not re-emit them nor double-charge usage.
+# ---------------------------------------------------------------------------
+
+
+async def test_migration_replay_accounting_under_late_failure():
+    seen_replays: list[dict] = []
+
+    class DieThenFinish:
+        def pick_instance(self, mode, exclude):
+            return 2 if 1 in exclude else 1
+
+        async def direct(self, worker_id, payload, headers=None):
+            pre = PreprocessedRequest.from_wire(payload)
+
+            async def stream():
+                if worker_id == 1:
+                    yield LLMEngineOutput(token_ids=[10, 11, 12]).to_wire()
+                    raise ConnectionError("late death")
+                # Replay-aware worker: the grown prompt carries the
+                # replayed tokens; it emits ONLY the continuation and
+                # bills its own view of the request.
+                seen_replays.append({
+                    "replayed_tokens": pre.replayed_tokens,
+                    "prompt_tail": pre.token_ids[-3:],
+                    "max_tokens": pre.stop.max_tokens,
+                })
+                yield LLMEngineOutput(
+                    token_ids=[13, 14],
+                    finish_reason="stop",
+                    prompt_tokens=len(pre.token_ids),
+                    completion_tokens=2,
+                ).to_wire()
+
+            return stream()
+
+    m = Migration(client=DieThenFinish(), push_router=None, mode="round_robin", limit=2)
+    pre = PreprocessedRequest(
+        model="t", token_ids=[1, 2, 3], request_id="late-1",
+        sampling=SamplingOptions(), stop=StopConditions(max_tokens=5),
+    )
+    outs = [o async for o in m.generate(pre)]
+    tokens = [t for o in outs for t in o.token_ids]
+    # No re-emission of replayed tokens, exact stream.
+    assert tokens == [10, 11, 12, 13, 14]
+    # The replayed attempt was marked and budget-shrunk.
+    assert seen_replays == [{
+        "replayed_tokens": 3, "prompt_tail": [10, 11, 12], "max_tokens": 2,
+    }]
+    # Client-facing usage: original prompt, full completion — each
+    # replayed token charged exactly once.
+    final = outs[-1]
+    assert final.prompt_tokens == 3
+    assert final.completion_tokens == 5
+
+
+# ---------------------------------------------------------------------------
+# Mocker replay continuity: the replayed_tokens marker keeps the
+# synthetic stream on its cycle (what makes fleet replays bit-exact).
+# ---------------------------------------------------------------------------
+
+
+async def test_mocker_replay_base_continues_token_cycle():
+    engine = MockTpuEngine(MockEngineArgs(num_kv_blocks=128, block_size=8))
+    pre = PreprocessedRequest(
+        model="mock", token_ids=[1, 2, 3, 4] + expected_tokens(5),
+        request_id="replay-1", sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=7), replayed_tokens=5,
+    )
+    tokens = []
+    async for out in engine.generate(pre.to_wire(), Context()):
+        tokens.extend(LLMEngineOutput.from_wire(out).token_ids)
+    assert tokens == expected_tokens(12)[5:]

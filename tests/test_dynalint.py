@@ -55,6 +55,16 @@ PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     # double-buffered landing point (_PendingFetch.land — tokens +
     # batched logprobs) and np.asarray over a host block-id list.
     ("dynamo_tpu/engine/core.py", "sync-ok", ""): 3,
+    # Deliberately deadline-free awaits (unbounded-await rule): server
+    # read loops idling between frames, engine-local queues whose
+    # producer is in-process, and push-subscription streams. The
+    # consumer-facing bounds live elsewhere (ResponseStream's per-token
+    # stall deadline, Subscription.get(timeout)).
+    ("dynamo_tpu/engine/engine.py", "unbounded-ok", ""): 1,
+    ("dynamo_tpu/llm/mocker/engine.py", "unbounded-ok", ""): 1,
+    ("dynamo_tpu/runtime/dataplane.py", "unbounded-ok", ""): 2,
+    ("dynamo_tpu/runtime/store/client.py", "unbounded-ok", ""): 2,
+    ("dynamo_tpu/runtime/store/server.py", "unbounded-ok", ""): 2,
     # Best-effort teardown in e2e harnesses: the runtime may already be
     # closed by the time __aexit__ re-closes it.
     ("tests/test_disagg.py", "allow", "broad-except"): 1,
@@ -161,6 +171,12 @@ def test_blocking_host_sync_detector():
     bad = rules_at(FIXTURES / "host_sync_bad.py")
     assert bad == [C.RULE_HOST_SYNC] * 4, bad
     assert rules_at(FIXTURES / "host_sync_ok.py") == []
+
+
+def test_unbounded_await_detector():
+    bad = rules_at(FIXTURES / "unbounded_await_bad.py")
+    assert bad == [C.RULE_UNBOUNDED_AWAIT] * 4, bad
+    assert rules_at(FIXTURES / "unbounded_await_ok.py") == []
 
 
 def test_host_sync_hot_paths_cover_engine_core():

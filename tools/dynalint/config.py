@@ -17,6 +17,7 @@ RULE_LOCK_DISCIPLINE = "lock-discipline"
 RULE_JAX_PITFALL = "jax-pitfall"
 RULE_UNCLOSED_SPAN = "unclosed-span"
 RULE_HOST_SYNC = "blocking-host-sync"
+RULE_UNBOUNDED_AWAIT = "unbounded-await"
 
 ALL_RULES = (
     RULE_FIRE_AND_FORGET,
@@ -26,6 +27,7 @@ ALL_RULES = (
     RULE_JAX_PITFALL,
     RULE_UNCLOSED_SPAN,
     RULE_HOST_SYNC,
+    RULE_UNBOUNDED_AWAIT,
 )
 
 # ---------------------------------------------------------------------------
@@ -137,6 +139,32 @@ HOT_STEP_FUNCS: dict[str, set[str]] = {
     "tests/fixtures/dynalint/host_sync_bad.py": {"plan_step", "dispatch"},
     "tests/fixtures/dynalint/host_sync_ok.py": {"plan_step", "dispatch"},
 }
+
+# ---------------------------------------------------------------------------
+# unbounded-await: network awaits with no deadline. An `await` of one of
+# these calls is a point where a wedged peer can park a coroutine forever
+# — the failure mode ISSUE 6's stall deadlines exist for. Bounded shapes
+# pass: `await asyncio.wait_for(<call>, t)` (the call itself is not
+# awaited) and any await lexically inside `async with asyncio.timeout(t)`.
+# A deliberately unbounded await (server read loops idling between
+# frames, engine-local queues whose producer is in-process) carries a
+# `# dynalint: unbounded-ok` pragma on the line or the line above.
+# ---------------------------------------------------------------------------
+
+# Last-dotted-component call names that hit the network.
+UNBOUNDED_AWAIT_FNS = {"open_connection", "read_frame"}
+
+# `.get()` on a stream-queue receiver: the consumer side of a network-fed
+# queue. Matched when the receiver's last dotted component (sans leading
+# underscores) is one of these (`self._queue.get()`, `sub.queue.get()`,
+# `seq.out.get()`); `msg.get(...)`/`dict.get(...)` receivers don't match.
+UNBOUNDED_QUEUE_RECEIVERS = {"queue", "out"}
+
+# Context managers that bound every await inside them.
+TIMEOUT_SCOPES = {"asyncio.timeout", "asyncio.timeout_at", "async_timeout.timeout"}
+
+# Wrappers that bound the coroutine they are handed.
+TIMEOUT_WRAPPERS = {"asyncio.wait_for", "wait_for"}
 
 # ---------------------------------------------------------------------------
 # jax-pitfall: module roots whose use is flagged in __del__/signal handlers.

@@ -36,7 +36,7 @@ class Finding:
 class Pragma:
     path: str
     line: int
-    kind: str          # "allow" | "holds-lock" | "sync-ok"
+    kind: str          # "allow" | "holds-lock" | "sync-ok" | "unbounded-ok"
     arg: str           # rule name for allow, lock name for holds-lock
     reason: str        # required for allow, empty otherwise
 
@@ -51,6 +51,9 @@ _HOLDS_RE = re.compile(r"dynalint:\s*holds-lock\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)\
 # Intentional host-sync marker (blocking-host-sync rule): bare, no arg —
 # prose may follow after the keyword (`# dynalint: sync-ok — reason`).
 _SYNC_OK_RE = re.compile(r"dynalint:\s*sync-ok\b")
+# Intentional deadline-free network await (unbounded-await rule): bare,
+# no arg — prose may follow (`# dynalint: unbounded-ok — reason`).
+_UNBOUNDED_OK_RE = re.compile(r"dynalint:\s*unbounded-ok\b")
 # A pragma must START the comment (`# dynalint: ...`); "dynalint:"
 # mid-comment is prose about the tool, not a directive.
 _ANY_PRAGMA_RE = re.compile(r"^#+\s*dynalint:")
@@ -125,11 +128,15 @@ class _FileLinter(ast.NodeVisitor):
         self._holds: dict[int, set[str]] = {}
         # sync-ok pragma lines (blocking-host-sync suppressions).
         self._sync_ok: set[int] = set()
+        # unbounded-ok pragma lines (unbounded-await suppressions).
+        self._unbounded_ok: set[int] = set()
         for p in pragmas:
             if p.kind == "allow":
                 self._allow.setdefault(p.line, set()).add(p.arg)
             elif p.kind == "sync-ok":
                 self._sync_ok.add(p.line)
+            elif p.kind == "unbounded-ok":
+                self._unbounded_ok.add(p.line)
             else:
                 self._holds.setdefault(p.line, set()).add(p.arg)
 
@@ -140,6 +147,7 @@ class _FileLinter(ast.NodeVisitor):
         self._held_locks: list[str] = []         # dotted lock exprs held lexically
         self._holds_pragma_stack: list[set[str]] = []
         self._global_decls: list[set[str]] = []  # per-function `global` names
+        self._timeout_depth = 0                  # asyncio.timeout nesting
 
         # GUARDED_BY registry slice for this file.
         self._registry: dict[tuple[str | None, str], str] = {}
@@ -254,12 +262,20 @@ class _FileLinter(ast.NodeVisitor):
 
     def _visit_with(self, node) -> None:
         added = 0
+        timeouts = 0
         for item in node.items:
             d = dotted_name(item.context_expr)
             if d is not None:
                 self._held_locks.append(d)
                 added += 1
+            # `async with asyncio.timeout(t):` bounds every await inside.
+            if isinstance(item.context_expr, ast.Call):
+                cd = dotted_name(item.context_expr.func)
+                if cd in C.TIMEOUT_SCOPES:
+                    timeouts += 1
+        self._timeout_depth += timeouts
         self.generic_visit(node)
+        self._timeout_depth -= timeouts
         if added:
             del self._held_locks[len(self._held_locks) - added:]
 
@@ -346,6 +362,49 @@ class _FileLinter(ast.NodeVisitor):
             "on device state, serializing scheduling with device compute; "
             "move the landing to the commit side, or mark an intentional "
             "sync with `# dynalint: sync-ok`",
+        )
+
+    # -- rule 8: unbounded network awaits ----------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._check_unbounded_await(node)
+        self.generic_visit(node)
+
+    def _check_unbounded_await(self, node: ast.Await) -> None:
+        """``await <network call>`` with no deadline is a point where a
+        wedged peer parks this coroutine forever (the stalled-but-
+        connected failure mode migration can never see). Bounded shapes
+        pass: ``asyncio.wait_for(...)`` (the inner call is an argument,
+        not awaited) and any await inside ``async with asyncio.timeout``.
+        Deliberate unbounded awaits carry `# dynalint: unbounded-ok`."""
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        d = dotted_name(call.func)
+        if d in C.TIMEOUT_WRAPPERS:
+            return
+        last = d.rsplit(".", 1)[-1] if d else None
+        what = None
+        if last in C.UNBOUNDED_AWAIT_FNS:
+            what = f"{last}()"
+        elif last == "get" and isinstance(call.func, ast.Attribute):
+            recv = dotted_name(call.func.value)
+            recv_last = recv.rsplit(".", 1)[-1].lstrip("_") if recv else ""
+            if recv_last in C.UNBOUNDED_QUEUE_RECEIVERS:
+                what = f"{recv}.get()"
+        if what is None:
+            return
+        if self._timeout_depth > 0:
+            return
+        line = node.lineno
+        if line in self._unbounded_ok or line - 1 in self._unbounded_ok:
+            return
+        self.report(
+            node, C.RULE_UNBOUNDED_AWAIT,
+            f"await {what} has no deadline: a wedged peer parks this "
+            "coroutine forever; wrap it in asyncio.wait_for / an "
+            "asyncio.timeout scope, or mark a deliberately unbounded "
+            "await with `# dynalint: unbounded-ok`",
         )
 
     def _check_blocking(self, node: ast.Call) -> None:
@@ -704,6 +763,9 @@ def extract_pragmas(path: str, source: str) -> tuple[list[Pragma], list[Finding]
         if _SYNC_OK_RE.search(text):
             matched = True
             pragmas.append(Pragma(path, line, "sync-ok", "", ""))
+        if _UNBOUNDED_OK_RE.search(text):
+            matched = True
+            pragmas.append(Pragma(path, line, "unbounded-ok", "", ""))
         if not matched:
             errors.append(Finding(
                 path, line, 0, "malformed-pragma",
