@@ -94,8 +94,8 @@ def profile_decode(
                 stop=StopConditions(max_tokens=n_out, ignore_eos=True),
             )
 
-        # Warm the compile path.
-        w = core.add_request(req("w", core.engine.decode_chain))
+        # Warm the compile path (megastep = resolved fused-decode length).
+        w = core.add_request(req("w", core.engine.megastep))
         _drain_one(core, w)
 
         seqs = [core.add_request(req(i, osl)) for i in range(conc)]

@@ -1176,6 +1176,15 @@ def main() -> None:
              "double-buffered host fetch (token stream bit-identical to "
              "'off'; default off)",
     )
+    ap.add_argument(
+        "--megastep-k", type=int, default=None,
+        help="decode megastep: fuse this many decode iterations into ONE "
+             "device dispatch (on-device sampling + per-lane stop flags; "
+             "host drains outputs every k steps). 1 = off (one dispatch "
+             "per token); unset = inherit the legacy decode-chain default "
+             "(8). Token stream is bit-identical for any k; mixed chunked "
+             "steps and spec-decode verify rows always run single-step",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
@@ -1241,6 +1250,7 @@ def main() -> None:
             "max_num_batched_tokens": args.max_num_batched_tokens,
             "spec_decode": args.spec_decode,
             "spec_k": args.spec_k,
+            "megastep_k": args.megastep_k,
             "async_exec": (
                 None if args.async_exec is None else args.async_exec == "on"
             ),

@@ -130,6 +130,12 @@ def main() -> None:
                     help="one-step-ahead overlap model: per-iteration host "
                          "overhead hides under device compute (virtual "
                          "clock; stream stays bit-identical to 'off')")
+    ap.add_argument("--megastep-k", type=int, default=1,
+                    help="decode megastep: decode-only iterations fuse k "
+                         "device steps under ONE per-dispatch host "
+                         "overhead (virtual clock; stream stays bit-"
+                         "identical to k=1). Mixed prefill+decode steps "
+                         "and spec verify rows stay single-step")
     ap.add_argument("--chaos-plan", default="",
                     help="fault-injection plan: inline JSON or @file "
                          "(same format as $DYN_CHAOS_PLAN; see "
@@ -159,6 +165,7 @@ def main() -> None:
         spec_k=args.spec_k,
         spec_acceptance_rate=args.spec_acceptance_rate,
         async_exec=args.async_exec == "on",
+        megastep_k=args.megastep_k,
     )
 
     @dynamo_worker()

@@ -130,12 +130,27 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     # Decode batch buckets: compile decode at these widths only.
     decode_buckets: tuple[int, ...] = (8, 16, 32, 64)
-    # Multi-step decode: chain this many decode+sample steps in ONE device
-    # program (sampled tokens feed back on-device via lax.scan), amortizing
-    # dispatch/host latency. Stop conditions are applied per token on the
-    # host afterwards; near the context edge the engine falls back to
-    # single steps. 1 = classic per-token stepping.
+    # Multi-step decode (LEGACY alias — see megastep_k): chain this many
+    # decode+sample steps in ONE device program (sampled tokens feed back
+    # on-device via lax.scan), amortizing dispatch/host latency. Stop
+    # conditions are applied per token on the host afterwards; near the
+    # context edge the engine falls back to single steps. 1 = classic
+    # per-token stepping.
     decode_chain: int = 8
+    # Decode MEGASTEP (PERF.md r9): fuse this many decode iterations into
+    # ONE device dispatch — an on-device scan over the ragged program
+    # with device-resident sampling ((seed, counter)-keyed per inner
+    # position), per-lane on-device stop flags (EOS / stop ids /
+    # max-tokens; lanes that stop early run masked no-op iterations),
+    # and the host draining outputs every k steps through the
+    # double-buffered fetch. Amortizes the fixed per-dispatch overhead
+    # (58-100 ms on the relay) by k×. The token stream is BIT-IDENTICAL
+    # for any k (greedy and seeded sampling; host stop-scan stays the
+    # authority — host-only stops roll back via num_computed_tokens).
+    # 1 = off (one dispatch per decode token); 0 = inherit the legacy
+    # decode_chain knob. Decode-only steps fuse; mixed chunked steps and
+    # spec-decode verify rows always run single-step.
+    megastep_k: int = 0
 
     # Sequence-parallel long-context prefill: prompts at least this long
     # (with no cached prefix) run as ONE dense ring-attention pass over
@@ -195,6 +210,13 @@ class EngineConfig:
     spec_ngram_min: int = 1
     spec_ngram_max: int = 3
     spec_window: int = 1024
+
+    @property
+    def megastep(self) -> int:
+        """Resolved decode-megastep length (inner iterations per device
+        dispatch): ``megastep_k`` when set (>= 1), else the legacy
+        ``decode_chain`` knob it supersedes."""
+        return self.megastep_k if self.megastep_k >= 1 else self.decode_chain
 
     @property
     def max_blocks_per_seq(self) -> int:

@@ -52,7 +52,8 @@ from dynamo_tpu.engine.model import (
 from dynamo_tpu.engine.sampler import (
     LOGPROBS_K,
     gather_feedback,
-    sample,
+    sample_seeded,
+    stop_flags,
     token_logprobs,
 )
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
@@ -265,52 +266,65 @@ def _lp_entry(token: int, chosen, top_ids, top_lps, k: int) -> dict:
     }
 
 
-def _sample_from_logits(
-    logits, seeds, counters, temperature, top_k, top_p,
-    need_mask: bool = True, all_greedy: bool = False,
-):
-    if all_greedy:
-        return sample(
-            logits, jax.random.PRNGKey(0), temperature, top_k, top_p,
-            need_mask=False, all_greedy=True,
-        )
-    base = jax.random.PRNGKey(0)
-    keys = jax.vmap(
-        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
-    )(seeds, counters)
-    return sample(logits, keys, temperature, top_k, top_p, need_mask=need_mask)
+# Static width of the per-lane on-device stop-watch array ([B, W], -1
+# padded): EOS ids + stop_token_ids. Lanes with more watch ids than fit
+# simply truncate — the device then under-stops (extra masked no-op
+# iterations, exactly the pre-stop-flag behavior) but never over-stops;
+# the host stop-scan stays the authority either way.
+MEGASTEP_WATCH_W = 8
 
 
-def _decode_chain(
+def _megastep_body(
     params, cache, tokens, block_tables, positions, active,
     seeds, counters, temperature, top_k, top_p,
+    watch, budgets, min_left,
     *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
     cfg, engine, mesh=None,
 ):
-    """n_steps fused decode+sample iterations in one program: each step
-    writes the current token's K/V, attends, samples the next token —
-    which feeds the next step on-device. Returns all sampled tokens
-    [n_steps, B]; the host applies stop conditions afterwards. With
-    ``want_logprobs`` (a second compiled variant, chosen per batch like
-    ``need_mask``) each step also emits the chosen-token logprob and
-    LOGPROBS_K alternatives."""
-    step = jnp.asarray(active, jnp.int32)
+    """The decode MEGASTEP: ``n_steps`` fused decode+sample iterations in
+    ONE device dispatch — the single scanned-decode implementation (the
+    legacy waves decode chain and the chunked scheduler's decode-only
+    steps both run this body). Each inner iteration writes the current
+    token's K/V, attends through the same ragged program every other
+    step shape uses (decode_tokens is thin assembly over forward_tokens),
+    samples the next token with per-position ``(seed, counter + i)``
+    keys — which feeds the next iteration on-device, no host round trip
+    — and updates per-lane stop flags: a lane that samples a watched
+    stop id (EOS / stop_token_ids, past its min-tokens floor) or
+    exhausts its generation budget runs its remaining iterations as
+    masked no-ops (K/V writes routed to the garbage block, position
+    frozen, output padded with its last live token).
+
+    Returns all sampled tokens [n_steps, B] (+ logprob arrays with
+    ``want_logprobs``); the host stop-scan stays the AUTHORITY over what
+    is emitted — stops only the host can see (stop strings, truncated
+    watch lists) roll back via the ``num_computed_tokens`` cursor, whose
+    un-advanced tail is never attended and is rewritten by the next
+    dispatch."""
 
     def body(carry, i):
-        toks, cache = carry
+        toks, cache, alive, pos = carry
+        act = active & alive
         logits, cache = decode_tokens(
-            params, cache, toks, block_tables, positions + i * step, active,
-            cfg, engine, mesh,
+            params, cache, toks, block_tables, pos, act, cfg, engine, mesh,
         )
-        nxt = _sample_from_logits(
+        nxt = sample_seeded(
             logits, seeds, counters + i, temperature, top_k, top_p,
-            need_mask, all_greedy,
+            need_mask=need_mask, all_greedy=all_greedy,
         )
-        lp = token_logprobs(logits, nxt) if want_logprobs else None
-        return (nxt, cache), (nxt, lp)
+        # Dead lanes pad the output with their last live token — a
+        # deterministic, pinnable value (the host stop-scan resolves the
+        # repeated stop id to the same stop position).
+        out_tok = jnp.where(act, nxt, toks)
+        lp = token_logprobs(logits, out_tok) if want_logprobs else None
+        alive = alive & ~stop_flags(nxt, watch, budgets, min_left, i)
+        pos = pos + act.astype(jnp.int32)
+        return (out_tok, cache, alive, pos), (out_tok, lp)
 
-    (_, cache), (sampled, lps) = jax.lax.scan(
-        body, (tokens, cache), jnp.arange(n_steps)
+    (_, cache, _, _), (sampled, lps) = jax.lax.scan(
+        body,
+        (tokens, cache, jnp.ones_like(active), positions),
+        jnp.arange(n_steps),
     )
     return _replicate_out(sampled, mesh), _replicate_out(lps, mesh), cache
 
@@ -342,8 +356,9 @@ def _ring_prefill_and_sample(
         params, cache, tokens, write_pages, write_offs, last_row,
         cfg, engine, sp_mesh,
     )
-    toks = _sample_from_logits(
-        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
+    toks = sample_seeded(
+        logits, seeds, counters, temperature, top_k, top_p,
+        need_mask=need_mask, all_greedy=all_greedy,
     )
     lps = token_logprobs(logits, toks) if want_logprobs else None
     return toks, lps, cache
@@ -368,8 +383,9 @@ def _prefill_and_sample(
         mm_embeds=mm_embeds if want_mm else None,
         mm_mask=mm_mask if want_mm else None,
     )
-    toks = _sample_from_logits(
-        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
+    toks = sample_seeded(
+        logits, seeds, counters, temperature, top_k, top_p,
+        need_mask=need_mask, all_greedy=all_greedy,
     )
     lps = token_logprobs(logits, toks) if want_logprobs else None
     return _replicate_out(toks, mesh), _replicate_out(lps, mesh), cache
@@ -392,8 +408,9 @@ def _pp_prefill_and_sample(
         mb_kv_lens, block_tables, mb_cu, num_seqs, mb_last_local,
         mb_last_mask, cfg=cfg, engine=engine, mesh=pp_mesh, n_micro=n_micro,
     )
-    toks = _sample_from_logits(
-        logits, seeds, counters, temperature, top_k, top_p, need_mask, all_greedy
+    toks = sample_seeded(
+        logits, seeds, counters, temperature, top_k, top_p,
+        need_mask=need_mask, all_greedy=all_greedy,
     )
     lps = token_logprobs(logits, toks) if want_logprobs else None
     return (
@@ -415,8 +432,11 @@ def _pp_decode_chain(
     rides the ring: group ``g``'s next token is sampled when it drains
     stage ``pp-1`` at round ``g + t*M + pp - 1`` and re-enters stage 0 at
     round ``g + (t+1)*M`` — legal exactly when ``M >= pp`` (enforced by
-    EngineCore). Same contract as :func:`_decode_chain`: returns sampled
-    ``[n_steps, B]`` (+ logprobs) and the cache.
+    EngineCore). Same output contract as :func:`_megastep_body`: returns
+    sampled ``[n_steps, B]`` (+ logprobs) and the cache, though the
+    wavefront keeps every lane live for the whole chain (no on-device
+    stop flags yet — the host stop-scan discards overshoot, exactly the
+    pre-megastep rollback).
 
     No GPU schedule looks like this — it exists because under jit the
     whole chain is ONE XLA program and ppermute edges are ICI
@@ -463,9 +483,9 @@ def _pp_decode_chain(
         ec = jnp.maximum(e, 0)
         ge = ec % M
         te = ec // M
-        nxt = _sample_from_logits(
+        nxt = sample_seeded(
             logits, seeds_g[ge], cnt_g[ge] + te, temp_g[ge], k_g[ge], p_g[ge],
-            need_mask, all_greedy,
+            need_mask=need_mask, all_greedy=all_greedy,
         )
         new_tok = jnp.where(ev, nxt, store[ge])
         store = store.at[ge].set(new_tok)
@@ -563,6 +583,11 @@ class EngineCore:
             )
         if engine_cfg.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {engine_cfg.spec_k}")
+        if engine_cfg.megastep_k < 0:
+            raise ValueError(
+                f"megastep_k must be >= 0 (0 inherits decode_chain, 1 "
+                f"disables fusion), got {engine_cfg.megastep_k}"
+            )
         if engine_cfg.spec_decode != "off" and pp_mesh is not None:
             raise ValueError(
                 "speculative decoding under pipeline parallelism is not "
@@ -854,6 +879,14 @@ class EngineCore:
             "commits": 0,
             "drains": 0,
             "last_host_gap_ms": 0.0,
+            # Megastep observability: dispatches that fused k > 1 decode
+            # iterations vs everything else (prefill waves, mixed steps,
+            # verify rows, k == 1 decode), plus committed (client-
+            # visible) tokens — the dispatches_per_token gauge divides
+            # these, and < 1.0 is the amortization working.
+            "megastep_dispatches": 0,
+            "single_step_dispatches": 0,
+            "committed_tokens": 0,
         }
         # Test hook: set to [] to record ("dispatch", n) / ("land", n)
         # events — the pipelining contract is that dispatch n+1 precedes
@@ -894,7 +927,7 @@ class EngineCore:
             )
         self._ring_prefills = 0  # observability: ring-path invocations
         self._decode = jax.jit(
-            partial(_decode_chain, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
+            partial(_megastep_body, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
             static_argnames=("n_steps", "need_mask", "all_greedy", "want_logprobs"),
             donate_argnums=(1,),
         )
@@ -1432,6 +1465,7 @@ class EngineCore:
                 want_logprobs=want_lp,
                 want_mm=want_mm,
             )
+        self.exec_stats["single_step_dispatches"] += 1
         return _PendingFetch(
             self, toks, lps, sr=(S, R) if n_sample is not None else None
         )
@@ -1705,19 +1739,25 @@ class EngineCore:
         seq.block_ids = seq.block_ids[: seq.committed_blocks]
         seq.pinned_hashes = []
 
-    def _run_decode(
+    def _dispatch_megastep(
         self, seqs: list[Sequence], n_steps: int,
         feed_lanes: list[int | None] | None = None,
     ) -> _PendingFetch:
-        """Dispatch one fused decode+sample chain. ``feed_lanes`` (aligned
-        with seqs) carries device-resident token feedback: a non-None
-        entry is the flat index of that lane's pending token in the
-        in-flight step's sampled output, gathered on device instead of
-        round-tripping through the host. Cursor/counter inputs read
-        through the optimistic overlay. Returns a pending fetch whose
+        """Assemble and enqueue one decode megastep: ``n_steps`` fused
+        decode+sample iterations over these lanes in ONE device dispatch
+        (:func:`_megastep_body`). ``feed_lanes`` (aligned with seqs)
+        carries device-resident token feedback: a non-None entry is the
+        flat index of that lane's pending token in the in-flight step's
+        sampled output, gathered on device instead of round-tripping
+        through the host. Cursor/counter inputs read through the
+        optimistic overlay. Per-lane stop inputs (watch ids, remaining
+        generation budget, min-tokens floor) arm the on-device stop
+        flags so lanes that finish early run masked no-ops instead of
+        writing K/V past their stop. Returns a pending fetch whose
         ``land()`` yields ([n_steps, B] tokens, lp arrays or None)."""
         B = self._decode_width(len(seqs))
         seqs = seqs[:B]
+        W = MEGASTEP_WATCH_W
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         tables = np.full(
@@ -1729,6 +1769,10 @@ class EngineCore:
         top_p = np.ones(B, np.float32)
         seeds = np.zeros(B, np.int32)
         counters = np.zeros(B, np.int32)
+        watch = np.full((B, W), -1, np.int32)
+        # Padded lanes never hit their budget (gen <= n_steps < n_steps+1).
+        budgets = np.full(B, n_steps + 1, np.int32)
+        min_left = np.zeros(B, np.int32)
         feed_idx = None
         if feed_lanes is not None and any(f is not None for f in feed_lanes):
             feed_idx = np.full(B, -1, np.int32)
@@ -1745,6 +1789,19 @@ class EngineCore:
             top_p[i] = seq.sampling.top_p
             seeds[i] = seq.seed
             counters[i] = self._eff_generated(seq)
+            wl: list[int] = []
+            if not seq.stop.ignore_eos:
+                wl.extend(sorted(self.eos_token_ids))
+            wl.extend(seq.stop.stop_token_ids)
+            watch[i, : min(W, len(wl))] = wl[:W]
+            if seq.stop.max_tokens is not None:
+                budgets[i] = max(
+                    1, seq.stop.max_tokens - self._eff_generated(seq)
+                )
+            if seq.stop.min_tokens:
+                min_left[i] = max(
+                    0, seq.stop.min_tokens - self._eff_generated(seq)
+                )
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
         )
@@ -1755,24 +1812,51 @@ class EngineCore:
             tok_in = self._feed(
                 self._inflight.feed_tokens, tok_in, jnp.asarray(feed_idx)
             )
-        decode_fn = self._decode_pp if self.pp_mesh is not None else self._decode
-        out, lps, self.cache = decode_fn(
-            self.params,
-            self.cache,
-            tok_in,
-            self._put_batch(tables),
-            self._put_batch(positions),
-            self._put_batch(active),
-            self._put_batch(seeds),
-            self._put_batch(counters),
-            self._put_batch(temp),
-            self._put_batch(top_k),
-            self._put_batch(top_p),
-            n_steps=n_steps,
-            need_mask=need_mask and not all_greedy,
-            all_greedy=all_greedy,
-            want_logprobs=want_lp,
-        )
+        if self.pp_mesh is not None:
+            # The pp wavefront chain has no stop flags yet (the ring-fed
+            # schedule complicates per-lane masking); overshoot rolls
+            # back on the host exactly as before.
+            out, lps, self.cache = self._decode_pp(
+                self.params,
+                self.cache,
+                tok_in,
+                self._put_batch(tables),
+                self._put_batch(positions),
+                self._put_batch(active),
+                self._put_batch(seeds),
+                self._put_batch(counters),
+                self._put_batch(temp),
+                self._put_batch(top_k),
+                self._put_batch(top_p),
+                n_steps=n_steps,
+                need_mask=need_mask and not all_greedy,
+                all_greedy=all_greedy,
+                want_logprobs=want_lp,
+            )
+        else:
+            out, lps, self.cache = self._decode(
+                self.params,
+                self.cache,
+                tok_in,
+                self._put_batch(tables),
+                self._put_batch(positions),
+                self._put_batch(active),
+                self._put_batch(seeds),
+                self._put_batch(counters),
+                self._put_batch(temp),
+                self._put_batch(top_k),
+                self._put_batch(top_p),
+                self._put_batch(watch),
+                self._put_batch(budgets),
+                self._put_batch(min_left),
+                n_steps=n_steps,
+                need_mask=need_mask and not all_greedy,
+                all_greedy=all_greedy,
+                want_logprobs=want_lp,
+            )
+        self.exec_stats[
+            "megastep_dispatches" if n_steps > 1 else "single_step_dispatches"
+        ] += 1
         return _PendingFetch(self, out, lps)  # [n_steps, B] on land()
 
     # -- the iteration -----------------------------------------------------
@@ -1915,13 +1999,16 @@ class EngineCore:
     def _plan_decode(self) -> _PlannedStep | None:
         """Plan one decode iteration: speculating lanes peel off into a
         batched verify dispatch (draft tokens verify as ragged q_len=k+1
-        rows); the rest ride one fused decode+sample chain. Both
+        rows — verify rows always run single-step, k is forced to 1 for
+        that dispatch); the rest ride one decode megastep. Both
         dispatches share one planned step — their commits run in order.
 
         ALL block growth happens before ANY dispatch: block pressure must
         surface (preemption, or _NeedDrain under async) while this plan
         has enqueued nothing, so a drain never abandons an already-
-        dispatched device step."""
+        dispatched device step — and so a megastep can never exhaust
+        blocks MID-dispatch: every lane's k tokens of block headroom are
+        reserved here, at plan time, by construction."""
         decoding = self._decode_candidates()
         if not decoding:
             return None
@@ -1944,7 +2031,7 @@ class EngineCore:
         # A verify preemption may have evicted a chain candidate.
         chain_ready = [s for s in chain_ready if s in self.running]
         if chain_ready:
-            cplan = self._plan_chain(chain_ready, n_steps)
+            cplan = self._plan_megastep(chain_ready, n_steps)
             if cplan is not None:
                 parts.append(cplan)
         return self._merge_plans(parts)
@@ -1979,18 +2066,20 @@ class EngineCore:
             and all(not p.feed_index for p in parts),
         )
 
-    def _plan_chain(
+    def _plan_megastep(
         self, ready: list[Sequence], n_steps: int
     ) -> _PlannedStep | None:
-        """Plan one fused decode+sample chain over non-speculating lanes
-        (the caller already grew their blocks — _plan_decode front-loads
-        growth before any dispatch); the commit side scans stops, commits
-        K/V bookkeeping, and emits whole-chain chunks."""
+        """Plan one decode megastep over non-speculating lanes: k fused
+        decode+sample iterations per dispatch (the caller already grew
+        their blocks — _plan_decode front-loads k tokens of headroom per
+        lane before any dispatch, so mid-megastep block exhaustion is
+        impossible by construction); the commit side scans stops,
+        commits K/V bookkeeping, and emits whole-megastep chunks."""
         if not ready:
             return None
         t_decode = time.time()
         feed_lanes = [self._feed_src(s) for s in ready]
-        pend = self._run_decode(ready, n_steps, feed_lanes=feed_lanes)
+        pend = self._dispatch_megastep(ready, n_steps, feed_lanes=feed_lanes)
         adv = {
             s.request_id: (0, n_steps, n_steps) for s in ready
         }
@@ -2038,14 +2127,28 @@ class EngineCore:
                     self._finish(seq)
                 else:
                     seq.pending = emitted[-1]
+            t_done = time.time()
             self._tracer.record(
-                "engine_decode_step", t_decode, time.time(),
+                "engine_decode_step", t_decode, t_done,
                 attrs={
                     "seqs": len(ready), "chain": n_steps,
                     "tokens": emitted_total,
                 },
                 stat=True,
             )
+            if n_steps > 1:
+                # Megastep observability: one span per multi-iteration
+                # dispatch carrying the inner-iteration count — the
+                # dispatch-amortization evidence (k iterations, one
+                # fixed overhead) bench and /traces consumers read.
+                self._tracer.record(
+                    "engine_megastep", t_decode, t_done,
+                    attrs={
+                        "seqs": len(ready), "inner_steps": n_steps,
+                        "tokens": emitted_total,
+                    },
+                    stat=True,
+                )
             return outputs
 
         return _PlannedStep(
@@ -2488,13 +2591,17 @@ class EngineCore:
         return k, finish
 
     def _chain_length(self, seqs: list[Sequence]) -> int:
-        """Fused decode steps this iteration: the configured chain, capped
-        by the context edge (hard limit — no writes past the block table)
-        and by the batch's LARGEST remaining generation budget (with every
-        lane's budget nearly spent, long chains are pure overshoot — the
-        short-budget tool-call workload). Snapped down to a power of two
-        so the compiled-program count stays O(log chain); per-lane
-        overshoot within a chain is discarded by the host stop-scan."""
+        """Inner iterations of this megastep: the resolved megastep k
+        (``--megastep-k``, falling back to the legacy decode_chain knob),
+        capped by the context edge (hard limit — no writes past the
+        block table) and by the batch's LARGEST remaining generation
+        budget (with every lane's budget nearly spent, long megasteps
+        are pure overshoot — the short-budget tool-call workload).
+        Snapped down to a power of two so the compiled-program count
+        stays O(log k); per-lane overshoot within a megastep is masked
+        on device by the stop flags and discarded by the host
+        stop-scan."""
+        k_cfg = self.engine.megastep
         ctx_cap = min(
             self.engine.max_model_len - self._eff_processed(s) for s in seqs
         )
@@ -2502,19 +2609,19 @@ class EngineCore:
             (
                 s.stop.max_tokens - self._eff_generated(s)
                 if s.stop.max_tokens is not None
-                else self.engine.decode_chain
+                else k_cfg
             )
             for s in seqs
         )
-        n = max(1, min(self.engine.decode_chain, ctx_cap, budget_cap))
-        if n == self.engine.decode_chain:
+        n = max(1, min(k_cfg, ctx_cap, budget_cap))
+        if n == k_cfg:
             return n
         # Snap to a power of two (bounded compiled-program count). Round
         # UP when the overshoot is small (<=1/3): a budget of 127 should
-        # run one 128-step chain, not a 64+32+16+... cascade of fixed
+        # run one 128-step megastep, not a 64+32+16+... cascade of fixed
         # per-invocation overheads.
         up = 1 << (n - 1).bit_length()
-        if up <= min(self.engine.decode_chain, ctx_cap) and up * 3 <= n * 4:
+        if up <= min(k_cfg, ctx_cap) and up * 3 <= n * 4:
             return up
         return 1 << (n.bit_length() - 1)
 
@@ -2529,6 +2636,7 @@ class EngineCore:
         (stop already decided by _scan_stop — ``tokens`` is exactly what
         the client gets)."""
         seq.out_tokens.extend(tokens)
+        self.exec_stats["committed_tokens"] += len(tokens)
         out = LLMEngineOutput(token_ids=tokens)
         if lp_entries:
             out.logprobs = lp_entries
@@ -2554,6 +2662,7 @@ class EngineCore:
         """Emit the newest sampled token. ``seq.generated`` already counts
         it, on both the prefill and decode paths."""
         seq.out_tokens.append(token)
+        self.exec_stats["committed_tokens"] += 1
         finish = self._check_stop(seq, token)
         out = LLMEngineOutput(token_ids=[token])
         if lp is not None:
@@ -2986,6 +3095,11 @@ class EngineCore:
         st["token_budget"] = self.engine.token_budget
         st["async_exec"] = 1 if self.engine.async_exec else 0
         st.update(self.exec_stats)
+        st["megastep_k"] = self.engine.megastep
+        toks = self.exec_stats["committed_tokens"]
+        st["dispatches_per_token"] = (
+            self.exec_stats["dispatches"] / toks if toks else 0.0
+        )
         return st
 
     def kv_cache_stats(self) -> dict:

@@ -49,6 +49,57 @@ def gather_feedback(
     return jnp.where(src_idx >= 0, fed, host_tokens)
 
 
+def sample_seeded(
+    logits: jax.Array,        # [B, V] float32
+    seeds: jax.Array,         # [B] int32 — per-lane request seeds
+    counters: jax.Array,      # [B] int32 — per-lane position counters
+    temperature: jax.Array,   # [B] float32; 0 => greedy
+    top_k: jax.Array,         # [B] int32
+    top_p: jax.Array,         # [B] float32
+    *,
+    need_mask: bool = True,
+    all_greedy: bool = False,
+) -> jax.Array:               # [B] int32
+    """THE seeded-sampling entry every compiled program uses — prefill
+    waves, decode megasteps, pp wavefronts, ring prefill, verify rows.
+    Each lane's PRNG key is ``fold_in(fold_in(key0, seed), counter)``, so
+    a seeded request reproduces bit-for-bit regardless of batch
+    neighbors, scheduler, chain length, or pipelining: any path that
+    samples position ``counter`` of request ``seed`` draws the same
+    token. Scanned callers pass ``counters + i`` per inner iteration —
+    which is why megastep output at k=8 matches k=1 exactly."""
+    if all_greedy:
+        return sample(
+            logits, jax.random.PRNGKey(0), temperature, top_k, top_p,
+            need_mask=False, all_greedy=True,
+        )
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
+    )(seeds, counters)
+    return sample(logits, keys, temperature, top_k, top_p, need_mask=need_mask)
+
+
+def stop_flags(
+    sampled: jax.Array,    # [B] int32 — tokens just sampled at inner step i
+    watch: jax.Array,      # [B, W] int32 — per-lane stop ids, -1 padded
+    budgets: jax.Array,    # [B] int32 — remaining max-tokens generation budget
+    min_left: jax.Array,   # [B] int32 — tokens until min_tokens is satisfied
+    i: jax.Array,          # scalar int32 — 0-based inner iteration
+) -> jax.Array:            # [B] bool — True where the lane stops HERE
+    """On-device per-lane stop detection for the decode megastep: a lane
+    that samples a watched id (EOS / stop_token_ids, once past its
+    min-tokens floor) or exhausts its generation budget goes dead, and
+    its remaining inner iterations run as masked no-ops (no K/V write,
+    frozen position). The HOST stop-scan stays the authority — the
+    device watch set may be a subset (host-only stop strings, truncated
+    watch lists), so flags here may under-stop but never over-stop."""
+    gen = i + 1  # tokens this chain has produced for the lane, inclusive
+    watch_hit = (sampled[:, None] == watch).any(axis=1) & (gen >= min_left)
+    budget_hit = gen >= budgets
+    return watch_hit | budget_hit
+
+
 def token_logprobs(
     logits: jax.Array,   # [B, V] float32 (raw, pre-temperature)
     tokens: jax.Array,   # [B] int32 — the sampled/chosen tokens

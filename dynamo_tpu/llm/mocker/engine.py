@@ -78,6 +78,15 @@ class MockEngineArgs:
     spec_decode: str = "off"
     spec_k: int = 4
     spec_acceptance_rate: float = 0.6
+    # Decode megastep (mirrors EngineConfig.megastep_k): decode-only
+    # iterations fuse k device steps under ONE per-dispatch host overhead
+    # (base_iter_us) — each decode lane runs up to k inner iterations and
+    # the device term prices k lane-iterations per lane (lanes that stop
+    # early still pay the masked no-op iterations, like the real scan).
+    # Mixed prefill+decode iterations and spec verify rows stay
+    # single-step (the real engine's first cut does the same). Token
+    # VALUES are unchanged — the stream is bit-identical to k=1.
+    megastep_k: int = 1
 
 
 @dataclass
@@ -139,6 +148,10 @@ class MockTpuEngine:
                 f"unknown spec_decode {self.args.spec_decode!r} "
                 "(expected 'off' or 'ngram')"
             )
+        if self.args.megastep_k < 1:
+            raise ValueError(
+                f"megastep_k must be >= 1, got {self.args.megastep_k}"
+            )
         self._spec_default = (
             SpecConfig(k=self.args.spec_k)
             if self.args.spec_decode != "off"
@@ -190,6 +203,14 @@ class MockTpuEngine:
             "last_step_batched_tokens": 0,
             "last_step_budget_utilization": 0.0,
             "chunked_prefills_in_flight": 0,
+            # Megastep observability, mirroring EngineCore.exec_stats:
+            # iterations that fused k > 1 decode steps under one dispatch
+            # overhead vs everything else, plus emitted tokens (the
+            # dispatches_per_token gauge divides these).
+            "dispatches": 0,
+            "megastep_dispatches": 0,
+            "single_step_dispatches": 0,
+            "committed_tokens": 0,
         }
 
     # -- public engine surface --------------------------------------------
@@ -292,6 +313,11 @@ class MockTpuEngine:
         st["chunked_scheduling"] = 1 if self.args.scheduling == "chunked" else 0
         st["token_budget"] = self.args.max_num_batched_tokens
         st["async_exec"] = 1 if self.args.async_exec else 0
+        st["megastep_k"] = self.args.megastep_k
+        toks = self.sched_stats["committed_tokens"]
+        st["dispatches_per_token"] = (
+            self.sched_stats["dispatches"] / toks if toks else 0.0
+        )
         return st
 
     def spec_decode_stats(self) -> dict:
@@ -467,9 +493,26 @@ class MockTpuEngine:
         in-flight decode stalls — the real engine's wave scheduler."""
         budget = self.args.max_num_batched_tokens
         chunk_cap = self.args.prefill_chunk or budget
-        prefill_only = self.args.scheduling == "waves" and any(
+        any_prefill = any(
             not s.prefill_done and not s.cancelled for s in self._running
         )
+        prefill_only = self.args.scheduling == "waves" and any_prefill
+        # Decode MEGASTEP (first cut mirrors the real engine): only
+        # decode-ONLY iterations fuse — any prefill work this iteration
+        # forces k=1 (a mixed step), and spec verify lanes always run
+        # single-step. k caps at the batch's largest remaining budget,
+        # like EngineCore._chain_length.
+        k_mega = 1
+        if self.args.megastep_k > 1 and not any_prefill:
+            remaining = [
+                max(1, s.max_tokens - s.generated)
+                for s in self._running
+                if s.prefill_done and not s.cancelled and not s.spec_k
+            ]
+            if remaining:
+                k_mega = min(self.args.megastep_k, max(remaining))
+        mega_lanes = 0
+        tokens_emitted = 0
         prefill_tokens = 0
         decode_seqs = 0
         # Simulated verify accounting: drafted tokens are priced like
@@ -511,11 +554,17 @@ class MockTpuEngine:
             if prefill_only:
                 continue  # waves: decodes stall for the whole wave
 
-            # Decode: one token per iteration — or, speculating, a verify
-            # row emitting 1 + accepted tokens (acceptance simulated,
-            # token VALUES unchanged: the stream is bit-identical to spec
-            # off, only the chunking and the virtual clock move).
-            decode_seqs += 1
+            # Decode: one token per iteration — or a MEGASTEP of up to
+            # k_mega fused inner iterations under one dispatch overhead —
+            # or, speculating, a verify row emitting 1 + accepted tokens
+            # (acceptance simulated; verify rows force k=1). Token VALUES
+            # are unchanged in every mode: the stream is bit-identical,
+            # only the chunking and the virtual clock move.
+            inner = 1 if seq.spec_k else k_mega
+            decode_seqs += inner  # lane-iterations: device term prices
+            #                       masked no-ops too, like the real scan
+            if inner > 1:
+                mega_lanes += 1
             drafted = min(
                 seq.spec_k, max(0, budget - prefill_tokens - spec_tokens)
             )
@@ -527,7 +576,7 @@ class MockTpuEngine:
             emitted: list[int] = []
             finish = None
             stalled = False
-            for _ in range(1 + accepted):
+            for _ in range((1 + accepted) if seq.spec_k else inner):
                 # 'a'..'z' cycle (ByteTokenizer); replay_base keeps a
                 # migrated continuation on the original cycle position.
                 token = 97 + ((seq.replay_base + seq.generated) % 26)
@@ -550,9 +599,12 @@ class MockTpuEngine:
                 if finish is not None:
                     break
             if stalled:
-                decode_seqs -= 1
+                decode_seqs -= inner
+                if inner > 1:
+                    mega_lanes -= 1
                 self.sched_stats["decode_stalls"] += 1
                 continue  # stalled this iteration (preemption-lite)
+            tokens_emitted += len(emitted)
             if drafted:
                 # Charge + account the verify row only once it actually
                 # ran (the real engine drops the draft under block
@@ -602,6 +654,25 @@ class MockTpuEngine:
                 stat=True,
             )
         st = self.sched_stats
+        if prefill_tokens or decode_seqs or spec_rows:
+            st["dispatches"] += 1
+            if mega_lanes:
+                st["megastep_dispatches"] += 1
+                now = time.time()
+                # Same span name + attrs as EngineCore's megastep commit
+                # (zero-width on the mocker's free host clock) so /traces
+                # consumers and the smoke tool see identical series.
+                self._tracer.record(
+                    "engine_megastep", now, now,
+                    attrs={
+                        "seqs": mega_lanes, "inner_steps": k_mega,
+                        "tokens": tokens_emitted,
+                    },
+                    stat=True,
+                )
+            else:
+                st["single_step_dispatches"] += 1
+        st["committed_tokens"] += tokens_emitted
         if prefill_tokens and decode_seqs:
             st["mixed_steps"] += 1
         batched = prefill_tokens + spec_tokens + decode_seqs

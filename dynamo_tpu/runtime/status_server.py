@@ -67,6 +67,26 @@ SCHEDULER_GAUGES: dict[str, tuple[str, str]] = {
         "scheduler_token_budget",
         "Resolved per-step batched-token budget",
     ),
+    # Decode megastep (PERF.md r9): the dispatch-amortization evidence.
+    "megastep_k": (
+        "scheduler_megastep_k",
+        "Resolved decode-megastep length (inner iterations per dispatch)",
+    ),
+    "megastep_dispatches": (
+        "scheduler_megastep_dispatches_total",
+        "Device dispatches that fused k > 1 decode iterations",
+    ),
+    "single_step_dispatches": (
+        "scheduler_single_step_dispatches_total",
+        "Single-iteration device dispatches (prefill waves, mixed steps, "
+        "verify rows, k == 1 decode)",
+    ),
+    "dispatches_per_token": (
+        "engine_dispatches_per_token",
+        "Device dispatches / committed (client-visible) tokens since "
+        "start — < 1.0 means multi-token dispatches are amortizing the "
+        "fixed per-dispatch overhead",
+    ),
 }
 
 

@@ -181,10 +181,15 @@ def test_unbounded_await_detector():
 
 def test_host_sync_hot_paths_cover_engine_core():
     # The rule was built for the async engine's plan/dispatch side
-    # (ISSUE 5): the registry must keep covering those functions.
+    # (ISSUE 5); the megastep plan/dispatch path (ISSUE 7) rides the
+    # same registry — a blocking sync inside a k-iteration dispatch
+    # would serialize k steps of host work with device compute.
     assert "dynamo_tpu/engine/core.py" in C.HOT_STEP_FUNCS
     funcs = C.HOT_STEP_FUNCS["dynamo_tpu/engine/core.py"]
-    assert {"_dispatch_ragged", "_run_decode", "_plan_step"} <= funcs
+    assert {
+        "_dispatch_ragged", "_dispatch_megastep", "_plan_megastep",
+        "_plan_step",
+    } <= funcs
 
 
 def test_malformed_pragmas_are_findings():

@@ -131,9 +131,9 @@ HOST_SYNC_ASARRAY_ROOTS = {"np", "numpy"}
 HOT_STEP_FUNCS: dict[str, set[str]] = {
     "dynamo_tpu/engine/core.py": {
         "_plan_step", "_plan_waves", "_plan_prefill_wave", "_plan_decode",
-        "_plan_chain", "_plan_verify", "_plan_mixed", "_merge_plans",
-        "_dispatch_ragged", "_run_decode", "_grow_or_preempt", "_admit",
-        "land",
+        "_plan_megastep", "_plan_verify", "_plan_mixed", "_merge_plans",
+        "_dispatch_ragged", "_dispatch_megastep", "_grow_or_preempt",
+        "_admit", "land",
     },
     # Detector fixtures (linted directly by tests; excluded from the tree).
     "tests/fixtures/dynalint/host_sync_bad.py": {"plan_step", "dispatch"},

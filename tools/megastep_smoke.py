@@ -1,0 +1,151 @@
+"""Megastep smoke: a mocker-backed frontend with ``--megastep-k 8``
+streams BIT-IDENTICAL output to a twin deployment running single-step
+(k=1), and the k=8 worker records ``engine_megastep`` stat spans (the
+per-dispatch fusion evidence) that the k=1 worker must not.
+
+This is the user-visible contract of device-side multi-step decode
+(ISSUE 7): fusing k decode iterations into one device dispatch changes
+HOW OFTEN the host and device talk — one fixed dispatch overhead per k
+tokens instead of per token — never which tokens are emitted. The same
+greedy request runs against a k=8 deployment and a k=1 deployment
+(fresh store + worker + frontend each, so no state leaks between the
+two), and the full streamed text must match byte for byte.
+
+CI usage (`.github/workflows/ci.yml` megastep-smoke step) and local:
+
+    python tools/megastep_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def stream_text(session, url: str, body: dict) -> str:
+    """POST a streaming chat completion; return the concatenated content."""
+    import json
+
+    parts: list[str] = []
+    async with session.post(url, json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:") or "[DONE]" in line:
+                continue
+            chunk = json.loads(line[len("data:"):])
+            for choice in chunk.get("choices", []):
+                parts.append((choice.get("delta") or {}).get("content") or "")
+    return "".join(parts)
+
+
+async def run_one(megastep_k: int) -> tuple[str, int]:
+    """Boot store + mocker (megastep k) + frontend, stream one greedy
+    request, and return (streamed text, engine_megastep span count)."""
+    import aiohttp
+
+    from dynamo_tpu import tracing
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    tracing.configure(enabled=True, sample=1.0)
+    collector = tracing.get_collector()
+    collector.clear()
+
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    served = asyncio.Event()
+    worker = asyncio.create_task(
+        run_mocker(
+            worker_rt,
+            model_name="mock",
+            engine_args=MockEngineArgs(
+                num_kv_blocks=8192,
+                block_size=8,
+                megastep_k=megastep_k,
+                speedup_ratio=50.0,
+            ),
+            served_event=served,
+        )
+    )
+    await asyncio.wait_for(served.wait(), 30)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+
+        text = await stream_text(
+            s, f"{base}/v1/chat/completions",
+            {
+                "model": "mock",
+                "messages": [{"role": "user", "content": "megastep smoke test"}],
+                "max_tokens": 32,
+                "temperature": 0,
+                "stream": True,
+            },
+        )
+
+    megasteps = [
+        sp for sp in collector.stats() if sp.name == "engine_megastep"
+    ]
+    if megastep_k > 1:
+        assert megasteps, "k>1 worker recorded no engine_megastep spans"
+        assert all(
+            sp.attrs.get("inner_steps", 0) > 1 for sp in megasteps
+        ), "engine_megastep span missing the inner-iteration count"
+    else:
+        assert not megasteps, "k=1 worker reported fused megasteps"
+
+    for task in (worker, frontend):
+        task.cancel()
+    for rt in (worker_rt, front_rt):
+        await rt.shutdown()
+    await store.stop()
+    return text, len(megasteps)
+
+
+async def run() -> None:
+    text_k8, megasteps = await run_one(8)
+    text_k1, _ = await run_one(1)
+    assert text_k8, "megastep deployment streamed nothing"
+    assert text_k8 == text_k1, (
+        f"megastep k=8 stream diverged from k=1:\n  k8: {text_k8!r}\n"
+        f"  k1: {text_k1!r}"
+    )
+    print(
+        f"megastep-smoke OK: {len(text_k8)} chars bit-identical k=8 vs "
+        f"k=1; {megasteps} engine_megastep spans recorded", flush=True,
+    )
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
