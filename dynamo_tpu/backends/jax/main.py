@@ -75,7 +75,10 @@ async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None
                 core.cfg.num_layers, core.engine.block_size,
                 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
             ],
-            "dtype": np.dtype(core.cfg.jax_dtype).name,
+            # "int8" pages ship as the canonical packed buffer (int8 kv
+            # bytes + f32 scales, engine/kv_quant.py); a mixed-dtype
+            # consumer fails fast at import_blocks.
+            "dtype": core.kv_wire_dtype,
         }
         sent = 0
         for s in range(0, len(hashes), chunk):
@@ -112,11 +115,13 @@ async def _pull_peer_prefix(
     if not want:
         return 0
     # Defaults overridden by the server's geometry frame (a peer on a
-    # different precision reports its own dtype; import_blocks casts).
+    # different float precision reports its own dtype; import_blocks
+    # casts — but an int8-vs-float mismatch fails the import fast, and
+    # the pull degrades to local recompute).
     shape = [
         core.cfg.num_layers, bs, 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
     ]
-    dtype = np.dtype(core.cfg.jax_dtype).name
+    dtype = core.kv_wire_dtype
     imported = 0
     try:
         # Hard deadline: a stalled peer must degrade to local recompute,
@@ -1188,6 +1193,16 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
+    ap.add_argument(
+        "--kv-dtype", default=None, choices=["bf16", "int8"],
+        help="paged KV cache storage dtype: 'int8' stores per-block "
+             "quantized pages with f32 scale metadata (~1.94x resident "
+             "blocks at a fixed HBM budget, ~0.52x decode KV bytes; "
+             "quantized ONCE at block-write time, bit-stable across "
+             "host/disk tiers and peer transfers). Default bf16 — the "
+             "classic path, byte-for-byte untouched. Align across any "
+             "fleet that transfers KV",
+    )
     ap.add_argument("--model-path", default=None,
                     help="HF checkpoint directory (llama/qwen2 family); "
                          "overrides --preset and defaults the tokenizer "
@@ -1251,6 +1266,7 @@ def main() -> None:
             "spec_decode": args.spec_decode,
             "spec_k": args.spec_k,
             "megastep_k": args.megastep_k,
+            "kv_dtype": args.kv_dtype,
             "async_exec": (
                 None if args.async_exec is None else args.async_exec == "on"
             ),

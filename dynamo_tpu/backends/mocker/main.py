@@ -136,6 +136,17 @@ def main() -> None:
                          "overhead (virtual clock; stream stays bit-"
                          "identical to k=1). Mixed prefill+decode steps "
                          "and spec verify rows stay single-step")
+    ap.add_argument("--kv-dtype", default="bf16", choices=["bf16", "int8"],
+                    help="simulated KV cache dtype (mirrors the jax "
+                         "worker's --kv-dtype): int8 halves the priced "
+                         "per-block KV read bytes on the virtual clock "
+                         "and reports int8 gauges on /metrics; token "
+                         "values never change")
+    ap.add_argument("--kv-read-us-per-block", type=float, default=0.0,
+                    help="virtual-clock cost of reading one resident "
+                         "bf16 KV block per decode lane-iteration "
+                         "(scaled by the kv dtype's byte ratio; 0 = "
+                         "legacy timing, KV traffic unpriced)")
     ap.add_argument("--chaos-plan", default="",
                     help="fault-injection plan: inline JSON or @file "
                          "(same format as $DYN_CHAOS_PLAN; see "
@@ -166,6 +177,8 @@ def main() -> None:
         spec_acceptance_rate=args.spec_acceptance_rate,
         async_exec=args.async_exec == "on",
         megastep_k=args.megastep_k,
+        kv_dtype=args.kv_dtype,
+        kv_read_us_per_block=args.kv_read_us_per_block,
     )
 
     @dynamo_worker()

@@ -588,6 +588,41 @@ class EngineCore:
                 f"megastep_k must be >= 0 (0 inherits decode_chain, 1 "
                 f"disables fusion), got {engine_cfg.megastep_k}"
             )
+        from dynamo_tpu.engine.kv_quant import KV_DTYPES
+
+        if engine_cfg.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {engine_cfg.kv_dtype!r} "
+                f"(expected one of {KV_DTYPES})"
+            )
+        if engine_cfg.kv_quantized and pp_mesh is not None:
+            raise ValueError(
+                "kv_dtype='int8' under pipeline parallelism is not wired "
+                "yet (the pp-stacked cache layout has no scale pages); "
+                "run quantized KV on a tp/dp, sp, or single-chip engine"
+            )
+        if (
+            engine_cfg.kv_quantized
+            and jax.default_backend() == "tpu"
+            and model_cfg.head_dim % 128 == 0
+            and engine_cfg.block_size % 8 == 0
+        ):
+            # The TPU serving attention (library ragged kernel) cannot
+            # read int8 pages directly; the first cut dequantizes ONE
+            # LAYER's referenced (or, when smaller, all) pages to the
+            # model dtype before each call. That transient is bounded
+            # (~1/num_layers of a bf16 cache) but it is extra read
+            # traffic — capacity win only. Say so once, loudly, so the
+            # doubled-capacity deployment knows what it bought.
+            log.warning(
+                "kv_dtype=int8 on TPU: serving attention dequantizes "
+                "per-layer pages before the library kernel (capacity "
+                "win, no traffic win; transient ~1/%d of a bf16 cache "
+                "per call). The int8-page DMA kernel is the first-party "
+                "decode path (DYNAMO_TPU_PAGED_ATTN=pallas) — see "
+                "PERF.md round 10.",
+                model_cfg.num_layers,
+            )
         if engine_cfg.spec_decode != "off" and pp_mesh is not None:
             raise ValueError(
                 "speculative decoding under pipeline parallelism is not "
@@ -743,7 +778,11 @@ class EngineCore:
             self.params = params
             self.cache = jax.jit(
                 partial(init_cache, model_cfg, engine_cfg),
-                out_shardings=cache_sharding(mesh),
+                out_shardings=cache_sharding(
+                    mesh,
+                    quantized=engine_cfg.kv_quantized,
+                    num_layers=model_cfg.num_layers,
+                ),
             )()
         else:
             if params is not None:
@@ -792,18 +831,40 @@ class EngineCore:
         # descriptors, offload tiers, and cross-core transfers are
         # byte-compatible across cache layouts (per-layer tuple vs the
         # pp-stacked array).
+        from dynamo_tpu.engine.kv_quant import is_quantized_cache
+
         def _slice_page_fn(cache, bid):
             if isinstance(cache, tuple):
+                if is_quantized_cache(cache):  # int8: kv + scale pages
+                    return {
+                        "kv": jnp.stack([c["kv"][bid] for c in cache]),
+                        "scale": jnp.stack([c["scale"][bid] for c in cache]),
+                    }
                 return jnp.stack([c[bid] for c in cache])        # [L, ps, 2kv, d]
             return cache[:, bid]
 
         def _gather_pages_fn(cache, ids):
             if isinstance(cache, tuple):
+                if is_quantized_cache(cache):
+                    return {
+                        "kv": jnp.stack([c["kv"][ids] for c in cache], axis=1),
+                        "scale": jnp.stack(
+                            [c["scale"][ids] for c in cache], axis=1
+                        ),
+                    }  # leaves [n, L, ...]
                 return jnp.stack([c[ids] for c in cache], axis=1)  # [n, L, ...]
             return jnp.moveaxis(cache[:, ids], 1, 0)
 
         def _scatter_pages_fn(cache, ids, pages):
             if isinstance(cache, tuple):
+                if is_quantized_cache(cache):
+                    return tuple(
+                        {
+                            "kv": c["kv"].at[ids].set(pages["kv"][:, l]),
+                            "scale": c["scale"].at[ids].set(pages["scale"][:, l]),
+                        }
+                        for l, c in enumerate(cache)
+                    )
                 return tuple(
                     c.at[ids].set(pages[:, l]) for l, c in enumerate(cache)
                 )
@@ -811,6 +872,11 @@ class EngineCore:
 
         def _copy_pages_fn(src, dst, sids, dids):
             if isinstance(dst, tuple):
+                if is_quantized_cache(dst):
+                    return tuple(
+                        {k: d[k].at[dids].set(s[k][sids]) for k in d}
+                        for s, d in zip(src, dst)
+                    )
                 return tuple(
                     d.at[dids].set(s[sids]) for s, d in zip(src, dst)
                 )
@@ -1212,11 +1278,68 @@ class EngineCore:
         page = self._slice_page(self.cache, jnp.int32(block_id))
         self.offload.submit(block_hash, parent, page)
 
+    @property
+    def kv_wire_dtype(self) -> str:
+        """The dtype name KV pages carry on every tier and wire: "int8"
+        for quantized caches (packed pages — engine/kv_quant.py), else
+        the model dtype's numpy name."""
+        if self.engine.kv_quantized:
+            return "int8"
+        return np.dtype(self.cfg.jax_dtype).name
+
+    def _page_geometry(self) -> tuple[int, int, int, int]:
+        return (
+            self.cfg.num_layers,
+            self.engine.block_size,
+            self.cfg.num_kv_heads,
+            self.cfg.head_dim,
+        )
+
+    def _stage_page(self, kv: np.ndarray):
+        """One host-side page (the canonical tier/wire representation —
+        packed uint8 for int8, a plain [L, ps, 2kv, d] array otherwise)
+        as the device pytree `_scatter_pages` expects, leading axis [1]."""
+        if self.engine.kv_quantized:
+            from dynamo_tpu.engine.kv_quant import unpack_kv_page
+
+            q8, sc = unpack_kv_page(kv, *self._page_geometry())
+            return {"kv": q8[None], "scale": sc[None]}
+        return np.asarray(kv)[None]
+
+    def _stack_staged(self, pages: list):
+        """Stack per-block staged pytrees ([1, L, ...] leaves) into one
+        scatter batch ([n, L, ...] leaves)."""
+        if self.engine.kv_quantized:
+            return {
+                "kv": jnp.asarray(np.concatenate([p["kv"] for p in pages])),
+                "scale": jnp.asarray(
+                    np.concatenate([p["scale"] for p in pages])
+                ),
+            }
+        return jnp.asarray(np.concatenate(pages))
+
+    def _fetch_page_bytes(self, pages_dev, n: int) -> list[bytes]:
+        """Land a device gather of ``n`` pages and serialize each block to
+        its canonical wire bytes (packed int8+scales for quantized caches
+        — BIT-stable across every hop by construction)."""
+        if isinstance(pages_dev, dict):
+            from dynamo_tpu.engine.kv_quant import pack_kv_page
+
+            kv_h = fetch_replicated(pages_dev["kv"])
+            sc_h = fetch_replicated(pages_dev["scale"])
+            return [
+                pack_kv_page(kv_h[i], sc_h[i]).tobytes() for i in range(n)
+            ]
+        pages = fetch_replicated(pages_dev)
+        return [np.ascontiguousarray(pages[i]).tobytes() for i in range(n)]
+
     def _onboard_from_host(
         self, hashes: list[int], cached_ids: list[int], ncached: int, cap: int
     ) -> tuple[list[int], int]:
         """Extend a device-cached prefix with offload-tier hits: promote
-        each consecutive host/disk block back to HBM and pin it."""
+        each consecutive host/disk block back to HBM and pin it. The
+        staged bytes scatter back EXACTLY as stored (int8 pages are
+        unpacked, never re-quantized)."""
         while ncached < cap and self.offload.contains(hashes[ncached]):
             h = hashes[ncached]
             got = self.offload.fetch(h)
@@ -1230,7 +1353,7 @@ class EngineCore:
                 break
             self.cache = self._scatter_pages(
                 self.cache, jnp.asarray([bid], jnp.int32),
-                jnp.asarray(kv)[None],
+                self._stack_staged([self._stage_page(kv)]),
             )
             self.allocator.register_inactive(bid, h, parent_hash, emit=False)
             cached_ids.extend(self.allocator.acquire_cached([h]))
@@ -2590,6 +2713,13 @@ class EngineCore:
         finish = stop.check_token(int(toks[k - 1]), seq.generated + k, self.eos_token_ids)
         return k, finish
 
+    def _watch_len(self, seq: Sequence) -> int:
+        """Ids this lane's on-device stop watch would need to hold."""
+        n = len(seq.stop.stop_token_ids)
+        if not seq.stop.ignore_eos:
+            n += len(self.eos_token_ids)
+        return n
+
     def _chain_length(self, seqs: list[Sequence]) -> int:
         """Inner iterations of this megastep: the resolved megastep k
         (``--megastep-k``, falling back to the legacy decode_chain knob),
@@ -2600,8 +2730,29 @@ class EngineCore:
         Snapped down to a power of two so the compiled-program count
         stays O(log k); per-lane overshoot within a megastep is masked
         on device by the stop flags and discarded by the host
-        stop-scan."""
+        stop-scan.
+
+        A lane whose stop watch exceeds the device's MEGASTEP_WATCH_W
+        slots forces the batch to k=1 instead of silently truncating the
+        watch: at k=1 the host stop-scan (which checks the FULL list)
+        runs after every token, so the truncated device flags can never
+        cause masked-no-op waste or surprise K/V rollbacks mid-chain."""
         k_cfg = self.engine.megastep
+        if k_cfg > 1 and any(
+            self._watch_len(s) > MEGASTEP_WATCH_W for s in seqs
+        ):
+            if not getattr(self, "_watch_overflow_warned", False):
+                self._watch_overflow_warned = True
+                over = next(
+                    s for s in seqs if self._watch_len(s) > MEGASTEP_WATCH_W
+                )
+                log.warning(
+                    "request %s watches %d stop ids but the device stop "
+                    "watch holds %d: forcing megastep k=1 for its batches "
+                    "(host-side stop scan covers the full list)",
+                    over.request_id, self._watch_len(over), MEGASTEP_WATCH_W,
+                )
+            return 1
         ctx_cap = min(
             self.engine.max_model_len - self._eff_processed(s) for s in seqs
         )
@@ -2747,7 +2898,7 @@ class EngineCore:
                 2 * self.cfg.num_kv_heads,
                 self.cfg.head_dim,
             ]
-            dtype = np.dtype(self.cfg.jax_dtype).name
+            dtype = self.kv_wire_dtype
             # Producer layout version: staged pages are always the FULL
             # combined [L, bs, 2kv, d] page regardless of the producer's
             # mesh (read_held_pages gathers across shards), so a consumer
@@ -2762,7 +2913,16 @@ class EngineCore:
                 "kind": "combined_kv_page",
                 "block_size": self.engine.block_size,
                 "tp": int(self.mesh.shape["tp"]) if self.mesh is not None else 1,
+                # int8 pages travel as the canonical packed buffer: int8
+                # kv bytes then f32 per-slot-per-head scales
+                # (engine/kv_quant.py). Mixed-dtype consumers fail fast
+                # at import — re-quantizing would break the
+                # quantize-once bit-stability invariant.
+                "kv_dtype": self.engine.kv_dtype,
             }
+            if self.engine.kv_quantized:
+                layout["scale_dtype"] = "float32"
+                layout["scale_shape"] = shape[:-1]
             descs: list[dict] = []
             parent: int | None = None
             for i in range(seq.committed_blocks):
@@ -2791,12 +2951,16 @@ class EngineCore:
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
             self._touch_hold(request_id)
-            ids = seq.block_ids[start : start + count]
+            # COMMITTED blocks only: export_descriptors describes exactly
+            # seq.committed_blocks entries, and the consumer zips data
+            # frames against them — shipping the trailing uncommitted
+            # partial block (opened by the held request's first generated
+            # token) used to misalign the two and fail the whole import.
+            ids = seq.block_ids[: seq.committed_blocks][start : start + count]
             if not ids:
                 return []
             pages_dev = self._gather_pages(self.cache, jnp.asarray(ids, jnp.int32))
-        pages = fetch_replicated(pages_dev)
-        return [np.ascontiguousarray(p).tobytes() for p in pages]
+        return self._fetch_page_bytes(pages_dev, len(ids))
 
     def read_cached_pages(self, hashes: list[int]) -> list[bytes]:
         """Non-destructive read of the longest locally-held prefix of a
@@ -2835,17 +2999,21 @@ class EngineCore:
                     self.cache, jnp.asarray(padded, jnp.int32)
                 )
         try:
-            dev_pages = (
-                fetch_replicated(pages_dev) if pages_dev is not None else None
+            dev_bytes = (
+                self._fetch_page_bytes(pages_dev, len(dev_hashes))
+                if pages_dev is not None
+                else None
             )
             out: list[bytes] = []
             for kind, ref in where:
                 if kind == "dev":
-                    out.append(np.ascontiguousarray(dev_pages[ref]).tobytes())
+                    out.append(dev_bytes[ref])
                 else:
                     kv = self.offload.peek(ref)
                     if kv is None:
                         break  # evicted between contains() and peek()
+                    # Offload tiers store the canonical wire buffer
+                    # (packed int8+scales when quantized) — ship verbatim.
                     out.append(np.ascontiguousarray(kv).tobytes())
             return out
         finally:
@@ -2883,7 +3051,14 @@ class EngineCore:
         blocks actually imported (already-cached hashes are skipped). One
         batched scatter per call — the step lock is held only to splice
         the device write and allocator state, never during host staging
-        (the caller already has the bytes in hand)."""
+        (the caller already has the bytes in hand).
+
+        Quantized (int8) pages arrive as the canonical packed buffer and
+        scatter bit-for-bit — NEVER re-quantized. A dtype mismatch where
+        either side is int8 fails fast: silently casting would either
+        re-quantize (generational drift) or serve garbage scales. Pure
+        float mismatches (bf16 producer, fp32 debug consumer) keep the
+        existing host-side cast."""
         import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 
         expected = (
@@ -2893,7 +3068,7 @@ class EngineCore:
             self.cfg.head_dim,
         )
         local_dtype = np.dtype(self.cfg.jax_dtype)
-        staged: list[tuple[int, int | None, np.ndarray]] = []
+        staged: list[tuple[int, int | None, Any]] = []
         for blk in blocks:
             shape = tuple(blk["shape"])
             if shape != expected:
@@ -2915,18 +3090,33 @@ class EngineCore:
                     f"incompatible KV page geometry {shape} vs local "
                     f"{expected} (different model config?)"
                 )
-            dtype = np.dtype(blk["dtype"])
-            page = np.frombuffer(blk["kv"], dtype=dtype).reshape(shape)
-            if dtype != local_dtype:
-                # Cross-precision fleet (e.g. bf16 prefill feeding an fp32
-                # debug decode): cast on host rather than letting the
-                # scatter silently promote the whole cache.
-                page = page.astype(local_dtype)
+            wire_dtype = str(blk["dtype"])
+            if (wire_dtype == "int8") != self.engine.kv_quantized:
+                raise ValueError(
+                    f"KV dtype mismatch: producer pages are {wire_dtype!r} "
+                    f"but this worker's kv_dtype is "
+                    f"{self.engine.kv_dtype!r} — refusing to import "
+                    "(re-quantizing would break the quantize-once "
+                    "invariant; align --kv-dtype across the fleet)"
+                )
+            if self.engine.kv_quantized:
+                page = self._stage_page(
+                    np.frombuffer(blk["kv"], np.uint8)
+                )  # validates the packed size against local geometry
+            else:
+                dtype = np.dtype(wire_dtype)
+                page = np.frombuffer(blk["kv"], dtype=dtype).reshape(shape)
+                if dtype != local_dtype:
+                    # Cross-precision fleet (e.g. bf16 prefill feeding an
+                    # fp32 debug decode): cast on host rather than letting
+                    # the scatter silently promote the whole cache.
+                    page = page.astype(local_dtype)
+                page = page[None]
             staged.append((blk["hash"], blk["parent"], page))
 
         with self._step_lock:
             ids: list[int] = []
-            pages: list[np.ndarray] = []
+            pages: list = []
             pending: list[tuple[int, int, int | None]] = []
             skipped = 0
             for h, parent, page in staged:
@@ -2944,7 +3134,7 @@ class EngineCore:
                 self.cache = self._scatter_pages(
                     self.cache,
                     jnp.asarray(ids, jnp.int32),
-                    jnp.asarray(np.stack(pages)),
+                    self._stack_staged(pages),
                 )
                 for bid, h, parent in pending:
                     self.allocator.register_inactive(bid, h, parent)
@@ -2986,6 +3176,13 @@ class EngineCore:
             raise ValueError(
                 "direct import needs matching cache layouts (per-layer "
                 "tuple vs pp-stacked); use the staged wire path instead"
+            )
+        if src.engine.kv_dtype != self.engine.kv_dtype:
+            raise ValueError(
+                f"KV dtype mismatch: source core stores "
+                f"{src.engine.kv_dtype!r} pages but this core is "
+                f"{self.engine.kv_dtype!r} — refusing direct import "
+                "(align --kv-dtype across the fleet)"
             )
         descs = src.export_descriptors(request_id)
         first, second = (src, self) if id(src) < id(self) else (self, src)
@@ -3109,8 +3306,22 @@ class EngineCore:
         disagg local-vs-remote decisions — counted since the prefix cache
         landed, never surfaced before); ``admitted_*`` count admitted
         sequences and whether their prefix was served from cache."""
+        from dynamo_tpu.engine.kv_quant import kv_page_bytes
+
         a = self.allocator
         return {
+            # Quantized-KV observability (ISSUE 8): the capacity doubling
+            # must be visible on /metrics, not just asserted in tests.
+            "kv_dtype": self.engine.kv_dtype,
+            "kv_dtype_int8": 1 if self.engine.kv_quantized else 0,
+            "bytes_per_block": kv_page_bytes(
+                self.cfg.num_layers, self.engine.block_size,
+                self.cfg.num_kv_heads, self.cfg.head_dim,
+                self.engine.kv_dtype,
+                np.dtype(self.cfg.jax_dtype).itemsize,
+            ),
+            "capacity_blocks": a.capacity,
+            "resident_blocks": a.used_blocks,
             "prefix_queries": a.prefix_queries,
             "prefix_hits": a.prefix_hits,
             "prefix_hit_rate": (

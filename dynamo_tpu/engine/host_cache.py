@@ -29,7 +29,12 @@ class HostPoolStats:
 @dataclass
 class _HostBlock:
     parent_hash: int | None
-    kv: np.ndarray  # combined page [L, block_size, 2*n_kv, d]
+    # Combined page [L, block_size, 2*n_kv, d] — or, for quantized KV
+    # caches, the canonical packed uint8 buffer (int8 payload + f32
+    # scales, engine/kv_quant.py). Either way the pool stores EXACTLY
+    # the bytes it was handed and hands them back verbatim: tier
+    # residency never re-encodes a block.
+    kv: np.ndarray
 
 
 class HostKvPool:

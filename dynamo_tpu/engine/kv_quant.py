@@ -1,0 +1,138 @@
+"""Per-block int8 KV quantization: layout, scales, and the canonical
+packed page representation every tier and wire transfer shares.
+
+Design (ISSUE 8; TokenStack and the KV-management survey both treat KV
+compression as the primary capacity lever):
+
+- **Quantize ONCE, at block-write time.** Every K/V row is quantized
+  symmetrically per (token slot, combined head) — amax over ``head_dim``
+  — exactly when it is scattered into its page by the forward pass, and
+  NEVER re-quantized afterwards: offload, onboard, disagg transfer, and
+  peer pulls all move the int8 bytes + scales verbatim, so there is no
+  generational drift. Scale granularity is per-slot-within-block rather
+  than one scale per whole block because decode streams tokens into a
+  partial block one at a time; a true per-block amax would force
+  re-quantizing earlier slots when a later token raises the max —
+  violating quantize-once. The scales still live in block-shaped pages
+  (``[n_pages, page_size, 2*n_kv]``) carried alongside the KV pages, so
+  every place a block lives or moves handles one (kv page, scale page)
+  pair.
+- **Device layout**: a quantized layer cache is ``{"kv": int8
+  [n_pages, ps, 2*n_kv, d], "scale": f32 [n_pages, ps, 2*n_kv]}`` —
+  the per-layer tuple structure of :func:`model.init_cache` is
+  unchanged, each element just becomes this dict. The bf16 path is
+  byte-for-byte untouched (plain arrays stay plain arrays).
+- **Host/wire layout**: ONE contiguous byte buffer per block —
+  ``int8 kv bytes [L, ps, 2kv, d]`` followed by ``f32 scale bytes
+  [L, ps, 2kv]`` (:func:`pack_kv_page`). Host tier, disk tier, and the
+  kv_transfer/kv_fetch wire all carry this buffer verbatim, which makes
+  the bit-stability invariant trivially testable: the packed bytes must
+  be identical at every hop.
+
+Capacity: an int8 page is ``(d + 4) / (2 d)`` the size of a bf16 page
+(0.516x at head_dim 128, scales included) — 1.94x more resident blocks
+at a fixed HBM budget (:func:`kv_page_bytes`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+KV_DTYPES = ("bf16", "int8")
+
+# f32 scale per (slot, combined head).
+SCALE_BYTES = 4
+
+# Guard against zero rows (all-zero K/V quantizes to zeros with this
+# floor instead of dividing by zero).
+_SCALE_FLOOR = 1e-8
+
+
+def quantize_kv(kvn):
+    """Quantize interleaved K/V rows ``[..., 2*n_kv, d]`` (jittable).
+
+    Returns ``(int8 [..., 2*n_kv, d], f32 scales [..., 2*n_kv])`` with
+    symmetric per-(row, head) scales: ``kv ~= q * scale[..., None]``.
+    """
+    import jax.numpy as jnp
+
+    kv32 = kvn.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(kv32), axis=-1) / 127.0
+    scale = jnp.maximum(scale, _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(kv32 / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv` (jittable): f32 ``q * scale``."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def is_quantized_cache(cache) -> bool:
+    """True when a per-layer cache tuple holds quantized {kv, scale}
+    entries (the pp-stacked array cache is never quantized — EngineCore
+    rejects the combination at construction)."""
+    return (
+        isinstance(cache, tuple)
+        and len(cache) > 0
+        and isinstance(cache[0], dict)
+    )
+
+
+def kv_page_bytes(
+    num_layers: int, block_size: int, num_kv_heads: int, head_dim: int,
+    kv_dtype: str, model_itemsize: int = 2,
+) -> int:
+    """Total bytes one KV block occupies across all layers, scale
+    metadata included — the capacity denominator (``HBM budget // this``
+    = resident blocks) and the /metrics bytes-per-block gauge."""
+    slots = num_layers * block_size * 2 * num_kv_heads
+    if kv_dtype == "int8":
+        return slots * (head_dim + SCALE_BYTES)
+    return slots * head_dim * model_itemsize
+
+
+def kv_byte_ratio(kv_dtype: str, head_dim: int = 128, model_itemsize: int = 2) -> float:
+    """Bytes moved per KV element relative to the bf16 page (scales
+    included): 1.0 for bf16, ``(d + 4) / (2 d)`` ~= 0.516 for int8 at
+    head_dim 128. The mocker prices decode KV traffic with this."""
+    if kv_dtype == "int8":
+        return (head_dim + SCALE_BYTES) / (head_dim * model_itemsize)
+    return 1.0
+
+
+# -- canonical host/wire packing --------------------------------------------
+
+def pack_kv_page(kv_int8: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Pack one block's quantized page into the canonical 1-D uint8
+    buffer: int8 kv bytes ``[L, ps, 2kv, d]`` then f32 scale bytes
+    ``[L, ps, 2kv]``. Every tier and transfer stores/ships this buffer
+    verbatim (quantize once — the bytes never change after the write)."""
+    kv_b = np.ascontiguousarray(kv_int8, dtype=np.int8).view(np.uint8).reshape(-1)
+    sc_b = (
+        np.ascontiguousarray(scales, dtype=np.float32).view(np.uint8).reshape(-1)
+    )
+    return np.concatenate([kv_b, sc_b])
+
+
+def unpack_kv_page(
+    buf: np.ndarray | bytes, num_layers: int, block_size: int,
+    num_kv_heads: int, head_dim: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`pack_kv_page`: returns ``(int8 [L, ps, 2kv, d],
+    f32 scales [L, ps, 2kv])`` views over the buffer."""
+    raw = np.frombuffer(bytes(buf), np.uint8) if isinstance(buf, (bytes, bytearray)) else np.asarray(buf, np.uint8)
+    comb = 2 * num_kv_heads
+    kv_n = num_layers * block_size * comb * head_dim
+    sc_n = num_layers * block_size * comb * SCALE_BYTES
+    if raw.size != kv_n + sc_n:
+        raise ValueError(
+            f"packed int8 KV page of {raw.size} bytes does not match the "
+            f"local geometry ({kv_n} kv + {sc_n} scale bytes); "
+            "mixed-geometry transfer?"
+        )
+    kv = raw[:kv_n].view(np.int8).reshape(num_layers, block_size, comb, head_dim)
+    scales = raw[kv_n:].view(np.float32).reshape(num_layers, block_size, comb)
+    return kv, scales

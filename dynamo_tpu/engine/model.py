@@ -263,7 +263,13 @@ def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple:
     XLA materialize a per-layer copy each step; separate buffers give
     the kernel aliased views for free. Pipeline parallelism keeps the
     stacked layout (:func:`init_cache_stacked`) — its stage sharding IS
-    the layer axis."""
+    the layer axis.
+
+    With ``engine.kv_dtype == "int8"`` each layer entry is instead a
+    ``{"kv": int8 pages, "scale": f32 [n_pages, ps, 2*n_kv]}`` dict —
+    symmetric per-slot-per-head quantized storage with the scale pages
+    carried alongside (engine/kv_quant.py); the tuple structure and
+    every index in it are unchanged."""
     dtype = dtype or cfg.jax_dtype
     shape = (
         engine.num_kv_blocks + 1,
@@ -271,6 +277,14 @@ def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple:
         2 * cfg.num_kv_heads,
         cfg.head_dim,
     )
+    if engine.kv_quantized:
+        return tuple(
+            {
+                "kv": jnp.zeros(shape, jnp.int8),
+                "scale": jnp.zeros(shape[:-1], jnp.float32),
+            }
+            for _ in range(cfg.num_layers)
+        )
     return tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers))
 
 
@@ -550,6 +564,24 @@ def _logits(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     return _dot(x, params["lm_head"])
 
 
+def write_kv(cache_l, write_pages: jax.Array, write_offs: jax.Array, kvn: jax.Array):
+    """Scatter this step's interleaved K/V rows ``[T, 2*n_kv, d]`` into
+    one layer's pages. Plain caches write the rows as-is; quantized
+    caches ({"kv", "scale"} — engine/kv_quant.py) quantize HERE, at
+    block-write time, the one and only quantization a row ever sees
+    (every later move — offload, onboard, transfer — copies the int8
+    bytes and scales verbatim)."""
+    if isinstance(cache_l, dict):
+        from dynamo_tpu.engine.kv_quant import quantize_kv
+
+        q8, sc = quantize_kv(kvn)
+        return {
+            "kv": cache_l["kv"].at[write_pages, write_offs].set(q8),
+            "scale": cache_l["scale"].at[write_pages, write_offs].set(sc),
+        }
+    return cache_l.at[write_pages, write_offs].set(kvn)
+
+
 def _interleave_kv(k: jax.Array, v: jax.Array, cfg: ModelConfig) -> jax.Array:
     """[T, kv_size] x2 -> [T, 2*n_kv, d] with K at even, V at odd heads."""
     T = k.shape[0]
@@ -600,16 +632,20 @@ def dense_layer(
     q = rope_apply(q.reshape(T, cfg.num_heads, cfg.head_dim), *rope_cs)
     k = rope_apply(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), *rope_cs)
     kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-    cache_l = cache_l.at[write_pages, write_offs].set(kvn)
+    cache_l = write_kv(cache_l, write_pages, write_offs, kvn)
+    if isinstance(cache_l, dict):
+        kv_pages, kv_scales = cache_l["kv"], cache_l["scale"]
+    else:
+        kv_pages, kv_scales = cache_l, None
     if mesh is not None:
         attn = sharded_ragged_attention(
-            mesh, q, cache_l, kv_lens, block_tables, cu_q_lens,
-            num_seqs, sm_scale=sm_scale,
+            mesh, q, kv_pages, kv_lens, block_tables, cu_q_lens,
+            num_seqs, sm_scale=sm_scale, kv_scales=kv_scales,
         )
     else:
         attn = ragged_paged_attention(
-            q, cache_l, kv_lens, block_tables, cu_q_lens, num_seqs,
-            sm_scale=sm_scale,
+            q, kv_pages, kv_lens, block_tables, cu_q_lens, num_seqs,
+            sm_scale=sm_scale, kv_scales=kv_scales,
         )
     x = x + _dot(attn.reshape(T, cfg.q_size), lp["wo"]).astype(x.dtype)
     x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps), lp, cfg, tp, mesh)
@@ -739,7 +775,7 @@ def forward_ring_prefill(
         k = rope_apply(k.reshape(T, cfg.num_kv_heads, cfg.head_dim), *rope_cs)
         v3 = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
         kvn = _interleave_kv(k.reshape(T, cfg.kv_size), v, cfg)
-        layer_caches[l] = layer_caches[l].at[write_pages, write_offs].set(kvn)
+        layer_caches[l] = write_kv(layer_caches[l], write_pages, write_offs, kvn)
         attn = ring_attention(q, k, v3, mesh=sp_mesh, axis_name=axis_name)
         attn = attn.reshape(T, cfg.q_size)
         x = x + _dot(attn, lp["wo"]).astype(x.dtype)

@@ -25,6 +25,7 @@ Design:
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 from collections import OrderedDict
@@ -85,7 +86,22 @@ class DiskKvPool:
                     log.warning("disk tier: failed to unlink block %x", old)
                 self.stats.evictions += 1
                 evicted.append(old)
-            np.save(self._path(block_hash), kv)
+            # Tmp-file + atomic rename: a crash mid-write must never
+            # leave a torn .npy at the final path — a later peek()/pop()
+            # would onboard the truncated bytes as corrupt KV. The tmp
+            # name is pid-tagged so a concurrent writer of the same hash
+            # (two pools sharing a directory) cannot collide; os.replace
+            # is atomic on POSIX, so readers see the old state or the
+            # full new file, never a partial one.
+            path = self._path(block_hash)
+            tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+            try:
+                with open(tmp, "wb") as f:
+                    np.save(f, kv)
+                os.replace(tmp, path)
+            except BaseException:
+                tmp.unlink(missing_ok=True)
+                raise
             self._index[block_hash] = parent_hash
             self.stats.offloads += 1
         if evicted:
@@ -155,7 +171,18 @@ class OffloadEngine:
                 return
             block_hash, parent, page = item
             try:
-                arr = np.asarray(page)  # lands the device slice
+                if isinstance(page, dict):
+                    # Quantized page ({kv, scale} device slices): land
+                    # both and pack into the canonical tier/wire buffer —
+                    # the int8 bytes written at block-write time move
+                    # verbatim, never re-quantized.
+                    from dynamo_tpu.engine.kv_quant import pack_kv_page
+
+                    arr = pack_kv_page(
+                        np.asarray(page["kv"]), np.asarray(page["scale"])
+                    )
+                else:
+                    arr = np.asarray(page)  # lands the device slice
             except Exception:  # noqa: BLE001 — engine may have shut down
                 log.exception("offload transfer failed for block %x", block_hash)
                 arr = None

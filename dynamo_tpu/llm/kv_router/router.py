@@ -28,6 +28,15 @@ from dynamo_tpu.tokens import compute_seq_hashes
 log = logging.getLogger("dynamo_tpu.kv_router")
 
 
+def best_peer_hint(overlaps: dict[int, int]) -> tuple[int, int]:
+    """The peer worth pulling a cached prefix from: most overlap blocks,
+    ties broken DETERMINISTICALLY by lowest worker_id. A bare
+    ``max(..., key=value)`` breaks ties by dict insertion order, which
+    varies with KV-event arrival — routing traces and chaos replays must
+    reproduce, so the tie-break is pinned (test_kv_router)."""
+    return max(overlaps.items(), key=lambda kv: (kv[1], -kv[0]))
+
+
 class KvRouter:
     def __init__(
         self,
@@ -216,9 +225,7 @@ class KvPushRouter:
         # hint lets the chosen worker pull the peer's blocks (device or
         # offload tiers) over the data plane instead of recomputing.
         if selection.overlaps:
-            peer, blocks = max(
-                selection.overlaps.items(), key=lambda kv: kv[1]
-            )
+            peer, blocks = best_peer_hint(selection.overlaps)
             if peer != selection.worker_id and blocks > selection.overlap_blocks:
                 payload["kv_transfer_params"] = dict(
                     payload.get("kv_transfer_params") or {},

@@ -87,6 +87,16 @@ class MockEngineArgs:
     # single-step (the real engine's first cut does the same). Token
     # VALUES are unchanged — the stream is bit-identical to k=1.
     megastep_k: int = 1
+    # Quantized KV cache (mirrors EngineConfig.kv_dtype): decode
+    # attention is DMA-latency-bound (PERF.md), so the cost model prices
+    # per-lane-iteration KV traffic as resident_blocks x
+    # kv_read_us_per_block x the dtype's byte ratio (engine/kv_quant.py:
+    # 1.0 for bf16, ~0.516 for int8 at head_dim 128, scales included).
+    # kv_read_us_per_block=0 (default) keeps every existing timing
+    # bit-identical; bench.py run_kvquant_ab sets it for the A/B. Token
+    # VALUES never change — only the virtual clock and capacity move.
+    kv_dtype: str = "bf16"
+    kv_read_us_per_block: float = 0.0
 
 
 @dataclass
@@ -152,6 +162,17 @@ class MockTpuEngine:
             raise ValueError(
                 f"megastep_k must be >= 1, got {self.args.megastep_k}"
             )
+        from dynamo_tpu.engine.kv_quant import KV_DTYPES, kv_byte_ratio
+
+        if self.args.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {self.args.kv_dtype!r} "
+                f"(expected one of {KV_DTYPES})"
+            )
+        # Bytes moved per resident KV block relative to bf16 (int8 pages
+        # + f32 scales ~0.516x at the nominal head_dim 128).
+        self._kv_byte_ratio = kv_byte_ratio(self.args.kv_dtype)
+        self._last_kv_blocks_read = 0
         self._spec_default = (
             SpecConfig(k=self.args.spec_k)
             if self.args.spec_decode != "off"
@@ -331,9 +352,21 @@ class MockTpuEngine:
     def kv_cache_stats(self) -> dict:
         """Prefix-cache gauges, same keys as EngineCore.kv_cache_stats:
         ``prefix_*`` are match_prefix probe counters, ``admitted_*`` count
-        admitted sequences whose prefix was served from cache."""
+        admitted sequences whose prefix was served from cache.
+        bytes_per_block uses the mocker's nominal llama3-8b geometry
+        (L=32, n_kv=8, d=128) so the dtype capacity delta is observable
+        on /metrics just like a real worker's."""
+        from dynamo_tpu.engine.kv_quant import kv_page_bytes
+
         st = self.kv.stats
         return {
+            "kv_dtype": self.args.kv_dtype,
+            "kv_dtype_int8": 1 if self.args.kv_dtype == "int8" else 0,
+            "bytes_per_block": kv_page_bytes(
+                32, self.args.block_size, 8, 128, self.args.kv_dtype
+            ),
+            "capacity_blocks": self.kv.capacity,
+            "resident_blocks": self.kv.used_blocks,
             "prefix_queries": st.prefix_queries,
             "prefix_hits": st.prefix_hits,
             "prefix_hit_rate": (
@@ -380,7 +413,9 @@ class MockTpuEngine:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.create_task(self._sim_loop())
 
-    def iter_time_s(self, prefill_tokens: int, decode_seqs: int) -> float:
+    def iter_time_s(
+        self, prefill_tokens: int, decode_seqs: int, kv_blocks_read: int = 0
+    ) -> float:
         """Virtual-clock cost of one iteration under the overlap model:
         with async execution, the fixed host overhead runs one step ahead
         and hides under device compute (bounded by the larger term). The
@@ -389,11 +424,19 @@ class MockTpuEngine:
         iteration (it knows the split exactly), while the real engine's
         ``host_gap`` is the wall-clock gap between consecutive dispatch
         enqueues (it cannot see device occupancy) — same name, related
-        but not identical quantities; compare trends, not absolutes."""
+        but not identical quantities; compare trends, not absolutes.
+
+        ``kv_blocks_read`` prices the DMA-bound decode KV traffic
+        (resident blocks read per lane-iteration), scaled by the
+        configured kv_dtype's byte ratio — int8 halves this term, which
+        is exactly the int8-page win bench.py run_kvquant_ab measures."""
         host_s = self.args.base_iter_us / 1e6
         device_s = (
             prefill_tokens * self.args.prefill_us_per_token
             + decode_seqs * self.args.decode_us_per_seq
+            + kv_blocks_read
+            * self.args.kv_read_us_per_block
+            * self._kv_byte_ratio
         ) / 1e6
         if self.args.async_exec:
             total = max(host_s, device_s)
@@ -428,7 +471,11 @@ class MockTpuEngine:
             self._admit()
             prefill_tokens, decode_seqs = self._step()
             self._iterations += 1
-            await asyncio.sleep(self.iter_time_s(prefill_tokens, decode_seqs))
+            await asyncio.sleep(
+                self.iter_time_s(
+                    prefill_tokens, decode_seqs, self._last_kv_blocks_read
+                )
+            )
 
     def _admit(self) -> None:
         watermark_blocks = self.args.watermark * self.kv.capacity
@@ -515,6 +562,7 @@ class MockTpuEngine:
         tokens_emitted = 0
         prefill_tokens = 0
         decode_seqs = 0
+        kv_blocks_read = 0  # resident blocks read by decode lane-iterations
         # Simulated verify accounting: drafted tokens are priced like
         # prefill tokens (each is one extra target forward in the verify
         # row) and count against the shared step budget.
@@ -563,6 +611,12 @@ class MockTpuEngine:
             inner = 1 if seq.spec_k else k_mega
             decode_seqs += inner  # lane-iterations: device term prices
             #                       masked no-ops too, like the real scan
+            # KV traffic term: each lane-iteration's attention reads the
+            # lane's whole resident context (DMA-bound decode).
+            lane_blocks = inner * (
+                -(-(seq.prefilled + seq.generated) // self.args.block_size)
+            )
+            kv_blocks_read += lane_blocks
             if inner > 1:
                 mega_lanes += 1
             drafted = min(
@@ -600,6 +654,7 @@ class MockTpuEngine:
                     break
             if stalled:
                 decode_seqs -= inner
+                kv_blocks_read -= lane_blocks
                 if inner > 1:
                     mega_lanes -= 1
                 self.sched_stats["decode_stalls"] += 1
@@ -681,6 +736,7 @@ class MockTpuEngine:
         st["chunked_prefills_in_flight"] = sum(
             1 for s in self._running if not s.prefill_done and s.t_first_sched
         )
+        self._last_kv_blocks_read = kv_blocks_read
         return prefill_tokens + spec_tokens, decode_seqs
 
     def _check_stop(self, seq: _Seq, token: int) -> str | None:

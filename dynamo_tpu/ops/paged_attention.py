@@ -42,6 +42,8 @@ def paged_attention_reference(
     scale: float | None = None,
     k_self: jax.Array | None = None,  # [B, n_kv, d]: the current token's K/V,
     v_self: jax.Array | None = None,  # attended without being in the cache yet
+    k_scale: jax.Array | None = None,  # [n_kv, total_slots] f32: int8 caches'
+    v_scale: jax.Array | None = None,  # per-slot-per-head dequant scales
 ) -> jax.Array:              # [B, n_q, d]
     B, n_q, d = q.shape
     n_kv = k_cache.shape[0]
@@ -54,6 +56,13 @@ def paged_attention_reference(
     slots = (block_tables[:, :, None] * block_size + offsets[None, None, :]).reshape(B, S)
     k = k_cache[:, slots]  # [n_kv, B, S, d]
     v = v_cache[:, slots]
+    if k_scale is not None:
+        # int8 cache: dequant fused into the gather (the gather itself
+        # moved half the bytes of the bf16 layout).
+        from dynamo_tpu.engine.kv_quant import dequantize_kv
+
+        k = dequantize_kv(k, k_scale[:, slots])
+        v = dequantize_kv(v, v_scale[:, slots])
 
     qg = q.reshape(B, n_kv, group, d).astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -82,6 +91,10 @@ def _paged_attn_kernel(
     q_ref,             # [1, 1, group, d] VMEM (this sequence, this kv head)
     k_hbm,             # [n_kv, total_slots, d] ANY/HBM
     v_hbm,
+    k_scale_hbm,       # [n_kv, n_blocks, block_size] ANY/HBM (int8 only;
+    v_scale_hbm,       # dummy otherwise) — page-shaped so the DMA indexes
+    #                    a whole page on an untiled axis and never slices
+    #                    the minor (lane) dim at non-128 offsets
     k_self_ref,        # [1, 1, 1, d] VMEM — current token's K, this head
     v_self_ref,
     # output
@@ -90,10 +103,11 @@ def _paged_attn_kernel(
     k_page,            # [2, block_size, d] VMEM double buffer
     v_page,
     sem,               # DMA sems [2, 2]
-    *,
+    *quant_scratch,    # with_quant: k_sc, v_sc ([2, block_size] f32), sc_sem
     block_size: int,
     scale: float,
     with_self: bool,
+    with_quant: bool,
 ):
     # One grid instance = one (sequence, kv head): all matmuls are plain 2D
     # (Mosaic's tpu.matmul does not support mismatched batch dims).
@@ -102,26 +116,44 @@ def _paged_attn_kernel(
     seq_len = seq_lens_ref[b]
     num_blocks = jax.lax.div(seq_len + block_size - 1, block_size)
     group, d = q_ref.shape[2], q_ref.shape[3]
+    if with_quant:
+        k_sc, v_sc, sc_sem = quant_scratch
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, d]
 
     def page_dma(slot, blk_idx):
         page = block_tables_ref[b, blk_idx]
         start = page * block_size
-        kd = pltpu.make_async_copy(
-            k_hbm.at[h, pl.ds(start, block_size)], k_page.at[slot], sem.at[slot, 0]
-        )
-        vd = pltpu.make_async_copy(
-            v_hbm.at[h, pl.ds(start, block_size)], v_page.at[slot], sem.at[slot, 1]
-        )
-        return kd, vd
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[h, pl.ds(start, block_size)], k_page.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[h, pl.ds(start, block_size)], v_page.at[slot], sem.at[slot, 1]
+            ),
+        ]
+        if with_quant:
+            # int8 pages halve the bulk DMA above; the scale tiles ride
+            # alongside (block_size f32 each — noise next to the page).
+            # Whole-page rows indexed on the untiled block axis, so no
+            # dynamic minor-dim slicing (Mosaic lane alignment).
+            copies.append(
+                pltpu.make_async_copy(
+                    k_scale_hbm.at[h, page], k_sc.at[slot], sc_sem.at[slot, 0]
+                )
+            )
+            copies.append(
+                pltpu.make_async_copy(
+                    v_scale_hbm.at[h, page], v_sc.at[slot], sc_sem.at[slot, 1]
+                )
+            )
+        return copies
 
     # Warm up the pipeline with the first page.
     @pl.when(num_blocks > 0)
     def _():
-        kd, vd = page_dma(0, 0)
-        kd.start()
-        vd.start()
+        for c in page_dma(0, 0):
+            c.start()
 
     def body(i, carry):
         m, l, acc = carry  # [group, 1], [group, 1], [group, d]
@@ -129,16 +161,18 @@ def _paged_attn_kernel(
 
         @pl.when(i + 1 < num_blocks)
         def _():
-            kd, vd = page_dma(1 - slot, i + 1)
-            kd.start()
-            vd.start()
+            for c in page_dma(1 - slot, i + 1):
+                c.start()
 
-        kd, vd = page_dma(slot, i)
-        kd.wait()
-        vd.wait()
+        for c in page_dma(slot, i):
+            c.wait()
 
         k = k_page[slot].astype(jnp.float32)   # [bs, d]
         v = v_page[slot].astype(jnp.float32)
+        if with_quant:
+            # Dequant in-VMEM, after the halved page copy landed.
+            k = k * k_sc[slot][:, None]
+            v = v * v_sc[slot][:, None]
         # s[g, t] = q[g, :] . k[t, :]
         s = jax.lax.dot_general(
             q, k,
@@ -189,6 +223,8 @@ def paged_attention_pallas(
     scale: float | None = None,
     k_self: jax.Array | None = None,
     v_self: jax.Array | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     B, n_q, d = q.shape
@@ -199,9 +235,22 @@ def paged_attention_pallas(
     group = n_q // n_kv
     qg = q.reshape(B, n_kv, group, d)
     with_self = k_self is not None
+    with_quant = k_scale is not None
+    self_dtype = jnp.float32 if with_quant else k_cache.dtype
     if not with_self:
-        k_self = jnp.zeros((B, n_kv, d), k_cache.dtype)
-        v_self = jnp.zeros((B, n_kv, d), v_cache.dtype)
+        k_self = jnp.zeros((B, n_kv, d), self_dtype)
+        v_self = jnp.zeros((B, n_kv, d), self_dtype)
+    if with_quant:
+        # Page-shaped scale layout for the kernel: the DMA then indexes
+        # [head, page] and copies a whole block_size row — no dynamic
+        # slicing of the minor (lane) dimension, which f32 tiling would
+        # reject at non-128-aligned offsets.
+        k_scale = k_scale.reshape(n_kv, -1, block_size)
+        v_scale = v_scale.reshape(n_kv, -1, block_size)
+    else:
+        # Tiny dummies (never DMA'd — with_quant is static).
+        k_scale = jnp.zeros((n_kv, 1, 1), jnp.float32)
+        v_scale = jnp.zeros((n_kv, 1, 1), jnp.float32)
     # 4D so the tiled trailing dims are (1, d) == the array dims — the
     # head index stays on an untiled axis (Mosaic alignment rules).
     k_self4 = k_self.reshape(B, n_kv, 1, d)
@@ -212,10 +261,22 @@ def paged_attention_pallas(
         block_size=block_size,
         scale=scale,
         with_self=with_self,
+        with_quant=with_quant,
     )
     self_spec = pl.BlockSpec(
         (1, 1, 1, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
     )
+    scratch = [
+        pltpu.VMEM((2, block_size, d), k_cache.dtype),
+        pltpu.VMEM((2, block_size, d), v_cache.dtype),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    if with_quant:
+        scratch += [
+            pltpu.VMEM((2, block_size), jnp.float32),
+            pltpu.VMEM((2, block_size), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, n_kv),
@@ -225,17 +286,15 @@ def paged_attention_pallas(
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
             self_spec,
             self_spec,
         ],
         out_specs=pl.BlockSpec(
             (1, 1, group, d), lambda b, h, *_: (b, h, 0, 0), memory_space=pltpu.VMEM
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, d), k_cache.dtype),
-            pltpu.VMEM((2, block_size, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         kernel,
@@ -244,7 +303,7 @@ def paged_attention_pallas(
         interpret=interpret,
     )(
         block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-        qg, k_cache, v_cache, k_self4, v_self4,
+        qg, k_cache, v_cache, k_scale, v_scale, k_self4, v_self4,
     )
     return out.reshape(B, n_q, d)
 
@@ -252,21 +311,28 @@ def paged_attention_pallas(
 def pallas_supported(head_dim: int, block_size: int, dtype) -> bool:
     """TPU tiling constraints on the page DMA: lane dim (head_dim) must be
     a multiple of 128 and the sublane slice (block_size) a multiple of the
-    dtype's min tile."""
-    sublane = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    dtype's min tile (int8 pages tile at 32 sublanes)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    sublane = {1: 32, 2: 16}.get(itemsize, 8)
     return head_dim % 128 == 0 and block_size % sublane == 0
 
 
 def paged_attention(
     q, k_cache, v_cache, block_tables, seq_lens, *, block_size, scale=None,
-    k_self=None, v_self=None,
+    k_self=None, v_self=None, k_scale=None, v_scale=None,
 ) -> jax.Array:
     """Dispatch: XLA gather path by default — measured faster than the
     current Pallas kernel at serving context lengths (the kernel's
     (batch x head) grid runs serially per TensorCore; its page DMAs are
     latency-bound). ``DYNAMO_TPU_PAGED_ATTN=pallas`` opts into the kernel
     (wins when live context is a small fraction of the table span; also
-    the base for the next-round ragged multi-page kernel)."""
+    the base for the next-round ragged multi-page kernel).
+
+    ``k_scale``/``v_scale`` mark int8 caches: the kernel DMAs the halved
+    int8 pages plus their per-slot scale tiles and dequantizes in-VMEM
+    after the copy — decode attention is DMA-latency-bound (PERF.md), so
+    the halved page copy is exactly where int8 can beat the bf16 path;
+    the XLA path fuses the dequant into its gather."""
     if (
         jax.default_backend() == "tpu"
         and os.environ.get("DYNAMO_TPU_PAGED_ATTN", "xla") == "pallas"
@@ -275,8 +341,10 @@ def paged_attention(
         return paged_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,
             block_size=block_size, scale=scale, k_self=k_self, v_self=v_self,
+            k_scale=k_scale, v_scale=v_scale,
         )
     return paged_attention_reference(
         q, k_cache, v_cache, block_tables, seq_lens,
         block_size=block_size, scale=scale, k_self=k_self, v_self=v_self,
+        k_scale=k_scale, v_scale=v_scale,
     )

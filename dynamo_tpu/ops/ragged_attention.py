@@ -69,6 +69,7 @@ def ragged_paged_attention_ref(
     num_seqs: jax.Array,      # [1] i32
     *,
     sm_scale: float,
+    kv_scales: jax.Array | None = None,  # [n_pages, page_size, 2*n_kv] f32
 ) -> jax.Array:               # [T, n_q, d]
     T, n_q, d = q.shape
     n_pages, page_size, n_comb, _ = kv_pages.shape
@@ -91,8 +92,18 @@ def ragged_paged_attention_ref(
     slots = (tables_t[:, :, None] * page_size + offs[None, None, :]).reshape(T, span)
     flat = kv_pages.reshape(n_pages * page_size, n_comb, d)
     kv = flat[slots]                                     # [T, span, 2*n_kv, d]
-    k = kv[:, :, 0::2, :].astype(jnp.float32)            # [T, span, n_kv, d]
-    v = kv[:, :, 1::2, :].astype(jnp.float32)
+    if kv_scales is not None:
+        # Fused dequant-on-gather (int8 pages): the gather above moved
+        # HALF the bytes a bf16 cache would; the dequant multiplies the
+        # gathered values by their per-slot-per-head scales in registers.
+        from dynamo_tpu.engine.kv_quant import dequantize_kv
+
+        scf = kv_scales.reshape(n_pages * page_size, n_comb)[slots]
+        kvf = dequantize_kv(kv, scf)
+    else:
+        kvf = kv.astype(jnp.float32)
+    k = kvf[:, :, 0::2, :]                               # [T, span, n_kv, d]
+    v = kvf[:, :, 1::2, :]
 
     qg = q.reshape(T, n_kv, group, d).astype(jnp.float32)
     s = jnp.einsum("thgd,tshd->thgs", qg, k) * sm_scale  # [T, n_kv, group, span]
@@ -107,17 +118,51 @@ def ragged_paged_attention_ref(
 
 
 def ragged_paged_attention(
-    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale: float
+    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *,
+    sm_scale: float, kv_scales=None,
 ) -> jax.Array:
     """Backend dispatch: Pallas kernel on TPU, jnp reference elsewhere.
 
     The kernel wants MXU/VPU-aligned shapes (head_dim % 128, page_size %
     8); models outside that (e.g. the byte-sized test presets) run the
     XLA reference path even on TPU — the kernel's trace-time asserts are
-    not a serving error."""
+    not a serving error.
+
+    ``kv_scales`` marks an int8 cache (``kv_pages`` int8 + per-slot-per-
+    head f32 scales). The reference path fuses dequant into its gather
+    (halved gather bytes). The TPU library kernel takes real-valued
+    pages, so the int8 serving path dequantizes the REFERENCED pages
+    before the call when that is smaller than the whole cache, else the
+    whole cache — the honest first-cut fallback (capacity win, no
+    traffic win); the traffic win lives in the extended first-party
+    decode kernel (ops/paged_attention.py, int8 page DMA + in-VMEM
+    dequant), opted into via DYNAMO_TPU_PAGED_ATTN=pallas and measured
+    by bench.py run_kvquant_ab."""
     d = q.shape[-1]
     page_size = kv_pages.shape[1]
     if jax.default_backend() == "tpu" and d % 128 == 0 and page_size % 8 == 0:
+        if kv_scales is not None:
+            from dynamo_tpu.engine.kv_quant import dequantize_kv
+
+            n_pages = kv_pages.shape[0]
+            S, pages_per_seq = page_indices.shape
+            if S * pages_per_seq < n_pages:
+                # Dequant-on-gather: materialize only the pages this
+                # batch references, renumbering the tables to match.
+                ids = page_indices.reshape(-1)
+                kv_pages = dequantize_kv(kv_pages[ids], kv_scales[ids]).astype(
+                    q.dtype
+                )
+                page_indices = jnp.arange(
+                    S * pages_per_seq, dtype=jnp.int32
+                ).reshape(S, pages_per_seq)
+            else:
+                # Whole-LAYER dequant (this function sees one layer's
+                # pages): transient = n_pages bf16 rows for one layer,
+                # ~1/num_layers of a full bf16 cache — bounded, but the
+                # read traffic is a capacity-only fallback (see docstring).
+                kv_pages = dequantize_kv(kv_pages, kv_scales).astype(q.dtype)
+            kv_scales = None
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention as _kernel,
         )
@@ -145,17 +190,41 @@ def ragged_paged_attention(
             sm_scale=sm_scale, **kw,
         )
     return ragged_paged_attention_ref(
-        q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, sm_scale=sm_scale
+        q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
+        sm_scale=sm_scale, kv_scales=kv_scales,
     )
 
 
 def sharded_ragged_attention(
     mesh: Mesh,
-    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale: float
+    q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *,
+    sm_scale: float, kv_scales=None,
 ) -> jax.Array:
     """Ragged attention under tensor parallelism: heads split over the
     mesh's ``tp`` axis, zero collectives (each shard owns its q heads and
-    the matching combined-KV block; dp replicates)."""
+    the matching combined-KV block; dp replicates). int8 caches shard
+    their scale pages on the same combined-head axis as the KV pages."""
+    if kv_scales is not None:
+        fn = functools.partial(ragged_paged_attention, sm_scale=sm_scale)
+
+        def quant_fn(q, kv_pages, kv_scales, kv_lens, page_indices, cu, ns):
+            return fn(
+                q, kv_pages, kv_lens, page_indices, cu, ns,
+                kv_scales=kv_scales,
+            )
+
+        return jax.shard_map(
+            quant_fn,
+            mesh=mesh,
+            in_specs=(
+                P(None, "tp", None),          # q: heads sharded
+                P(None, None, "tp", None),    # kv_pages: combined heads
+                P(None, None, "tp"),          # kv_scales: combined heads
+                P(), P(), P(), P(),
+            ),
+            out_specs=P(None, "tp", None),
+            check_vma=False,
+        )(q, kv_pages, kv_scales, kv_lens, page_indices, cu_q_lens, num_seqs)
     fn = functools.partial(
         ragged_paged_attention, sm_scale=sm_scale
     )

@@ -98,11 +98,22 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     )
 
 
-def cache_sharding(mesh: Mesh) -> NamedSharding:
+def cache_sharding(mesh: Mesh, quantized: bool = False, num_layers: int = 0):
     """Per-layer cache pages [n_pages, page_size, 2*n_kv, d] —
     combined-head axis on tp. One sharding covers every element of the
-    per-layer tuple (model.init_cache) as a pytree prefix."""
-    return NamedSharding(mesh, P(None, None, "tp", None))
+    per-layer tuple (model.init_cache) as a pytree prefix.
+
+    ``quantized`` (int8 KV, engine/kv_quant.py): each layer entry is a
+    {"kv": 4-D, "scale": 3-D} dict, so the prefix trick no longer fits
+    one rank — return the full per-layer tuple (``num_layers`` entries),
+    scale pages sharded on the same combined-head axis."""
+    if not quantized:
+        return NamedSharding(mesh, P(None, None, "tp", None))
+    entry = {
+        "kv": NamedSharding(mesh, P(None, None, "tp", None)),
+        "scale": NamedSharding(mesh, P(None, None, "tp")),
+    }
+    return tuple(dict(entry) for _ in range(num_layers))
 
 
 def decode_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:

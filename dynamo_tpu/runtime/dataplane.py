@@ -50,6 +50,17 @@ on, reference RetryManager `lib/llm/src/migration.rs:26`):
 * **Drain-aware errors.** A draining server (graceful SIGTERM) answers
   new requests with a distinguished err frame that the client surfaces
   as ``ConnectionError`` — i.e. "retry elsewhere", not "request failed".
+
+KV-page payload contract (the ``kv_transfer``/``kv_fetch`` endpoints
+that ride this plane): a block's page bytes are OPAQUE to the transport
+but self-describing at the endpoint layer — every stream opens with a
+geometry/descriptor frame carrying ``shape``, ``dtype``, and (for
+kv_transfer) a ``layout`` map with ``kv_dtype``. ``dtype == "int8"``
+(quantized KV cache, engine/kv_quant.py) means each block is the
+canonical packed buffer: int8 kv bytes ``[L, bs, 2kv, d]`` followed by
+f32 per-slot-per-head scales ``[L, bs, 2kv]``. Consumers import the
+buffer verbatim (quantize-once bit-stability); a dtype mismatch where
+either side is int8 fails the import fast instead of re-quantizing.
 """
 
 from __future__ import annotations

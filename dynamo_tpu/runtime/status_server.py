@@ -160,6 +160,26 @@ def bind_spec_gauges(
 # allocator has counted prefix queries/hits since the prefix cache
 # landed, but never surfaced them on /metrics.
 KV_CACHE_GAUGES: dict[str, tuple[str, str]] = {
+    # Quantized-KV capacity observability (ISSUE 8): the int8 capacity
+    # doubling must be readable off /metrics, not just asserted in tests.
+    "kv_dtype_int8": (
+        "kv_cache_dtype_int8",
+        "1 when the paged KV cache stores int8 pages + scale metadata "
+        "(kv_dtype=int8), 0 for the bf16/model-dtype layout",
+    ),
+    "bytes_per_block": (
+        "kv_cache_bytes_per_block",
+        "Bytes one KV block occupies across all layers, scale metadata "
+        "included (int8 is ~0.52x the bf16 page at head_dim 128)",
+    ),
+    "capacity_blocks": (
+        "kv_cache_capacity_blocks",
+        "Total resident-block capacity of the device KV pool",
+    ),
+    "resident_blocks": (
+        "kv_cache_resident_blocks",
+        "KV blocks currently resident (pinned + cached)",
+    ),
     "prefix_queries": (
         "kv_prefix_cache_queries_total",
         "match_prefix probes (router overlap scoring, disagg "
@@ -192,8 +212,11 @@ KV_CACHE_GAUGES: dict[str, tuple[str, str]] = {
 def bind_kv_cache_gauges(
     status: "SystemStatusServer | None", kv_cache_stats: Callable[[], dict]
 ) -> None:
-    """Export a worker's prefix-cache gauges on /metrics (same scrape-time
-    evaluation as the scheduler gauges)."""
+    """Export a worker's prefix-cache + KV-layout gauges on /metrics
+    (same scrape-time evaluation as the scheduler gauges). The cache
+    dtype also exports as a labeled info gauge —
+    ``kv_cache_dtype{kv_dtype="int8"} 1`` — the Prometheus idiom for
+    string-valued facts."""
     if status is None:
         return
     scoped = status.metrics.scoped(service="engine")
@@ -201,6 +224,12 @@ def bind_kv_cache_gauges(
         scoped.gauge(name, doc).set_function(
             lambda k=key: float(kv_cache_stats().get(k, 0) or 0)
         )
+    dtype = str(kv_cache_stats().get("kv_dtype", "") or "")
+    if dtype:
+        status.metrics.scoped(service="engine", kv_dtype=dtype).gauge(
+            "kv_cache_dtype",
+            "KV cache storage dtype as an info gauge (value label)",
+        ).set(1.0)
 
 
 # Dataplane egress containment gauges: per-address circuit-breaker state

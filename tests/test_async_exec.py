@@ -375,7 +375,10 @@ def test_plan_commit_and_host_gap_spans_recorded():
 def test_kv_cache_stats_surface():
     core = EngineCore(CFG, tiny_engine(), seed=0)
     st = core.kv_cache_stats()
-    assert all(v == 0 for v in st.values())
+    # Counter/usage series start at zero; the static layout facts
+    # (kv_dtype, bytes_per_block, capacity_blocks) are nonzero by design.
+    static = {"kv_dtype", "kv_dtype_int8", "bytes_per_block", "capacity_blocks"}
+    assert all(v == 0 for k, v in st.items() if k not in static)
     prompt = list(range(3, 63))
     s1 = core.add_request(_req(prompt, "w", max_tokens=3))
     drive(core, [s1])

@@ -6,6 +6,7 @@ determinism tests (`tests/kvbm/test_determinism.py`).
 """
 
 import numpy as np
+import pytest
 
 from dynamo_tpu.engine import EngineCore, tiny_engine, tiny_model
 from dynamo_tpu.llm.protocols.common import (
@@ -245,6 +246,49 @@ def test_offload_engine_preserves_bytes_across_tiers(tmp_path):
         assert p == want_parent[h]
         assert np.asarray(kv).tobytes() == page.tobytes()
     eng.close()
+
+
+def test_disk_put_survives_crash_mid_write(tmp_path, monkeypatch):
+    """ISSUE 8 satellite: DiskKvPool.put writes via tmp file +
+    os.replace, so a crash mid-write can never leave a torn block at the
+    final path that a later peek()/pop() would onboard as corrupt KV.
+    Simulated partial write: np.save dumps half the bytes, then dies."""
+    from dynamo_tpu.engine import offload as offload_mod
+    from dynamo_tpu.engine.offload import DiskKvPool
+
+    disk = DiskKvPool(tmp_path / "g3", 8)
+    page = np.arange(2 * 8 * 4 * 16, dtype=np.float32).reshape(2, 8, 4, 16)
+
+    real_save = offload_mod.np.save
+
+    def torn_save(f, arr):
+        # Write a believable partial .npy (header + some data), then die
+        # the way ENOSPC / SIGKILL would.
+        import io
+
+        buf = io.BytesIO()
+        real_save(buf, arr)
+        f.write(buf.getvalue()[: buf.getbuffer().nbytes // 2])
+        raise OSError("simulated crash mid-write")
+
+    monkeypatch.setattr(offload_mod.np, "save", torn_save)
+    with pytest.raises(OSError):
+        disk.put(0xBAD, None, page)
+    monkeypatch.setattr(offload_mod.np, "save", real_save)
+
+    # Nothing torn is visible: not indexed, not readable, no final file,
+    # and the tmp file was cleaned up.
+    assert 0xBAD not in disk
+    assert disk.peek(0xBAD) is None and disk.pop(0xBAD) is None
+    assert not disk._path(0xBAD).exists()
+    assert not list((tmp_path / "g3").glob("*.tmp"))
+
+    # The pool still works after the failed write, and a retry of the
+    # SAME hash lands the full bytes.
+    disk.put(0xBAD, None, page)
+    assert disk.peek(0xBAD).tobytes() == page.tobytes()
+    got = disk.pop(0xBAD)
+    assert got is not None and got[1].tobytes() == page.tobytes()
 
 
 def test_offload_does_not_block_step():

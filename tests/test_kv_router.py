@@ -29,6 +29,23 @@ def removed(worker, event_id, hashes):
     return RouterEvent(worker, event_id, KvCacheEvent("removed", tuple(hashes)))
 
 
+def test_peer_prefix_tie_breaks_by_lowest_worker_id():
+    """ISSUE 8 satellite: two peers with EQUAL overlap must resolve to
+    the same peer every time — dict insertion order (KV-event arrival
+    order) must not pick the hint, or routing traces and chaos replays
+    stop reproducing."""
+    from dynamo_tpu.llm.kv_router.router import best_peer_hint
+
+    assert best_peer_hint({7: 5, 3: 5}) == (3, 5)
+    assert best_peer_hint({3: 5, 7: 5}) == (3, 5)  # insertion order flipped
+    # Higher overlap still wins regardless of id.
+    assert best_peer_hint({3: 5, 7: 9}) == (7, 9)
+    assert best_peer_hint({7: 9, 3: 5}) == (7, 9)
+    # Three-way tie: lowest id, any insertion order.
+    for order in ({5: 2, 1: 2, 9: 2}, {9: 2, 5: 2, 1: 2}, {1: 2, 9: 2, 5: 2}):
+        assert best_peer_hint(order) == (1, 2)
+
+
 def test_radix_matches_contiguous_prefix():
     t = RadixTree()
     h = compute_seq_hashes(list(range(128)), 32)  # 4 blocks

@@ -269,6 +269,46 @@ def test_host_only_stop_rolls_back_at_megastep_boundary():
     assert run(8, async_exec=True) == (d1, f1)
 
 
+def test_watch_overflow_forces_single_step():
+    """ISSUE 8 satellite: a request watching MORE stop ids than the
+    device's MEGASTEP_WATCH_W slots must not silently truncate the
+    watch — its megasteps run at k=1, where the host stop-scan (which
+    checks the FULL list) sees every token before the next dispatch.
+    9 stop ids inside a configured k=8 megastep: correct stream, correct
+    finish, and ZERO fused dispatches."""
+    probe = EngineCore(CFG, tiny_engine(megastep_k=1), seed=0)
+    s = probe.add_request(_req([9, 9, 9], "p", max_tokens=20, ignore_eos=True))
+    d, _, _ = drive(probe, [s])
+    stop_tok = d["p"][5]
+    # W decoys + the real stop id = W+1 watch entries: one over the slots.
+    stop_ids = list(range(300, 300 + MEGASTEP_WATCH_W)) + [stop_tok]
+    assert len(stop_ids) == MEGASTEP_WATCH_W + 1
+
+    core = EngineCore(CFG, tiny_engine(megastep_k=8), seed=0)
+    seq = core.add_request(_req(
+        [9, 9, 9], "x", max_tokens=20, stop_token_ids=stop_ids,
+        ignore_eos=True,
+    ))
+    done, fins, _ = drive(core, [seq])
+    assert done == {"x": d["p"][:6]}
+    assert fins == {"x": "stop"}
+    # The overflow forced every decode dispatch to k=1 — no fused
+    # megasteps ran, so the truncated device watch never decided anything.
+    assert core.exec_stats["megastep_dispatches"] == 0
+    assert core.exec_stats["single_step_dispatches"] > 0
+
+    # Control: the same stream with a watch that FITS stays fused.
+    core8 = EngineCore(CFG, tiny_engine(megastep_k=8), seed=0)
+    seq8 = core8.add_request(_req(
+        [9, 9, 9], "y", max_tokens=20,
+        stop_token_ids=stop_ids[1:],  # exactly W ids, real stop included
+        ignore_eos=True,
+    ))
+    done8, fins8, _ = drive(core8, [seq8])
+    assert done8 == {"y": d["p"][:6]} and fins8 == {"y": "stop"}
+    assert core8.exec_stats["megastep_dispatches"] >= 1
+
+
 def test_cancel_mid_megastep_discards_in_flight_tokens():
     """Host-side aborts (client disconnect, detokenizer stop-string
     match) cancel between steps: the in-flight megastep's tokens for

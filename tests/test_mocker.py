@@ -89,6 +89,59 @@ def test_kv_manager_dedup_on_commit():
 # -- engine -------------------------------------------------------------------
 
 
+def test_kv_dtype_prices_halved_bytes_and_identical_tokens():
+    """ISSUE 8: with the KV-read term priced, an int8 mocker's decode
+    iterations cost ~0.52x the bf16 ones on the virtual clock (the
+    DMA-bound decode model), while token VALUES are bit-identical; the
+    default kv_read_us_per_block=0 keeps legacy timing untouched."""
+    from dynamo_tpu.engine.kv_quant import kv_byte_ratio
+    from dynamo_tpu.tokens import TokenBlockSequence
+    from dynamo_tpu.llm.mocker.engine import _Seq
+
+    def run(kv_dtype, kv_us):
+        args = MockEngineArgs(
+            num_kv_blocks=256, block_size=4, max_num_seqs=4,
+            enable_prefix_caching=False, kv_dtype=kv_dtype,
+            kv_read_us_per_block=kv_us,
+        )
+        eng = MockTpuEngine(args)
+        prompt = [1] * 16
+        s = _Seq(
+            request_id="s", prompt=prompt, max_tokens=8, out=asyncio.Queue(),
+            seq=TokenBlockSequence(prompt, args.block_size),
+            prompt_hashes=compute_seq_hashes(prompt, args.block_size),
+            stop=StopConditions(max_tokens=8, ignore_eos=True),
+        )
+        eng._waiting.append(s)
+        vt = 0.0
+        toks = []
+        while s in eng._waiting or s in eng._running:
+            eng._admit()
+            p, d = eng._step()
+            vt += eng.iter_time_s(p, d, eng._last_kv_blocks_read)
+            while not s.out.empty():
+                item = s.out.get_nowait()
+                if isinstance(item, dict):
+                    toks.extend(item.get("token_ids") or [])
+        return vt, toks
+
+    t_bf, toks_bf = run("bf16", 100.0)
+    t_i8, toks_i8 = run("int8", 100.0)
+    assert toks_bf == toks_i8, "kv dtype changed token values"
+    assert t_i8 < t_bf, "int8 KV reads were not priced cheaper"
+    # The delta is exactly the byte ratio applied to the KV term.
+    ratio = kv_byte_ratio("int8")
+    t0, _ = run("bf16", 0.0)
+    assert t_i8 - t0 == pytest.approx((t_bf - t0) * ratio, rel=1e-6)
+    # And unpriced (default) int8 matches legacy timing exactly.
+    assert run("int8", 0.0)[0] == pytest.approx(t0, rel=1e-9)
+    # Gauges surface the dtype + halved bytes per block.
+    st = MockTpuEngine(MockEngineArgs(kv_dtype="int8")).kv_cache_stats()
+    st_bf = MockTpuEngine(MockEngineArgs()).kv_cache_stats()
+    assert st["kv_dtype_int8"] == 1 and st_bf["kv_dtype_int8"] == 0
+    assert st["bytes_per_block"] < st_bf["bytes_per_block"]
+
+
 async def test_engine_generates_to_max_tokens():
     engine = MockTpuEngine(FAST)
     outs = [o async for o in engine.generate(make_request([1] * 10, max_tokens=6), Context())]

@@ -30,8 +30,9 @@ class PeerCluster:
     """Two aggregated jax workers with tiny device pools + host/disk
     offload tiers, plus a frontend (KV routing)."""
 
-    def __init__(self, tmp_path):
+    def __init__(self, tmp_path, kv_dtype: str = "bf16"):
         self.tmp_path = tmp_path
+        self.kv_dtype = kv_dtype
         self.store = StoreServer()
         self.runtimes: list[DistributedRuntime] = []
         self.worker_ids: list[int] = []
@@ -56,6 +57,7 @@ class PeerCluster:
                             "host_kv_blocks": 8,
                             "disk_kv_dir": str(self.tmp_path / f"disk{i}"),
                             "disk_kv_blocks": 64,
+                            "kv_dtype": self.kv_dtype,
                         },
                     )
                 )
@@ -174,3 +176,74 @@ async def test_peer_pull_avoids_recompute_after_offload(tmp_path):
         assert cached > 0, "pulled prefix was not prefix-cache-hit"
         # The pull is non-destructive: A still holds its tiers.
         assert len(a_core.host_pool) + len(a_core.disk_pool) > 0
+
+
+async def test_kv_fetch_serves_int8_packed_pages(tmp_path):
+    """ISSUE 8: an int8 fleet's ``kv_fetch`` endpoint announces
+    dtype="int8" in its geometry frame and streams the canonical packed
+    pages (int8 bytes + scales) — byte-identical to the producer's
+    device content — and the peer imports them verbatim and serves the
+    prefix with the same greedy output. (Exercises the SERVER half of
+    the peer pull directly; the asyncio.timeout client half is covered
+    by test_peer_pull_avoids_recompute_after_offload on 3.11+.)"""
+    from dynamo_tpu.tokens import compute_seq_hashes
+
+    prompt = list(range(1, 90))  # 11 complete 8-token blocks
+    async with PeerCluster(tmp_path, kv_dtype="int8") as c:
+        served = c.service.manager.get("peer")
+        push = served.push_router
+        a_id = c.worker_ids[0]
+        a_core, b_core = c.cores[0], c.cores[1]
+        assert a_core.engine.kv_quantized
+
+        want = await _route(
+            push, _pre(prompt, "seed"),
+            router_overrides={"backend_instance_id": a_id},
+        )
+        assert len(want) == 4
+
+        bs = a_core.engine.block_size
+        hashes = compute_seq_hashes(prompt, bs)[: (len(prompt) - 1) // bs]
+        local = a_core.read_cached_pages(hashes)
+        assert len(local) == len(hashes)
+
+        fetch_client = await (
+            c.runtimes[0].namespace("dynamo").component("backend")
+            .endpoint("kv_fetch").client()
+        )
+        await fetch_client.wait_for_instances(2)
+        stream = await fetch_client.direct(a_id, {"hashes": hashes})
+        dtype = None
+        pages: list[bytes] = []
+        async for frame in stream:
+            if "dtype" in frame:
+                dtype = frame["dtype"]
+            if "kv" in frame:
+                pages.extend(frame["kv"])
+        assert dtype == "int8", "geometry frame did not announce int8"
+        assert [bytes(p) for p in pages] == local, (
+            "wire pages diverged from the producer's device bytes"
+        )
+
+        # The consumer-side import (what _pull_peer_prefix does with
+        # these frames) lands them bit-identically and serves the prefix.
+        shape = [
+            a_core.cfg.num_layers, bs,
+            2 * a_core.cfg.num_kv_heads, a_core.cfg.head_dim,
+        ]
+        blocks = [
+            {
+                "hash": h,
+                "parent": hashes[i - 1] if i else None,
+                "shape": shape, "dtype": "int8", "kv": kv,
+            }
+            for i, (h, kv) in enumerate(zip(hashes, pages))
+        ]
+        res = b_core.import_blocks(blocks)
+        assert res.imported == len(blocks) and res.dropped == 0
+        assert b_core.read_cached_pages(hashes) == local
+        got = await _route(
+            push, _pre(prompt, "peer-serve"),
+            router_overrides={"backend_instance_id": c.worker_ids[1]},
+        )
+        assert got == want, "int8 peer-served decode diverged"

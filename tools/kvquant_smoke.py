@@ -1,0 +1,151 @@
+"""Quantized-KV smoke: a mocker-backed frontend deployed with
+``--kv-dtype int8`` serves a streaming request end to end, and the
+worker's /metrics reports the int8 layout — ``kv_cache_dtype_int8 1``,
+the labeled ``kv_cache_dtype{kv_dtype="int8"}`` info gauge, and a
+bytes-per-block strictly under the bf16 page.
+
+This is the user-visible contract of the quantized KV cache (ISSUE 8):
+flipping the storage dtype is a deployment knob whose capacity effect is
+OBSERVABLE on the metrics surface, and never changes which tokens a
+request streams (the mocker twin at bf16 must match byte for byte; the
+real engine's quality guard and byte-stability invariants are pinned by
+tests/test_kv_quant.py).
+
+CI usage (`.github/workflows/ci.yml` kvquant-smoke step) and local:
+
+    python tools/kvquant_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.megastep_smoke import stream_text  # noqa: E402
+
+
+async def run_one(kv_dtype: str) -> tuple[str, str]:
+    """Boot store + mocker (kv_dtype) + frontend with a live status
+    server, stream one greedy request, and return (streamed text, the
+    worker's /metrics text)."""
+    import aiohttp
+
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.status_server import SystemStatusServer
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    status = SystemStatusServer(host="127.0.0.1", port=0)
+    await status.start()
+    worker_rt.status = status  # bind_kv_cache_gauges hooks in run_mocker
+    served = asyncio.Event()
+    worker = asyncio.create_task(
+        run_mocker(
+            worker_rt,
+            model_name="mock",
+            engine_args=MockEngineArgs(
+                num_kv_blocks=4096,
+                block_size=8,
+                speedup_ratio=50.0,
+                kv_dtype=kv_dtype,
+                kv_read_us_per_block=5.0,
+            ),
+            served_event=served,
+        )
+    )
+    await asyncio.wait_for(served.wait(), 30)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+
+        text = await stream_text(
+            s, f"{base}/v1/chat/completions",
+            {
+                "model": "mock",
+                "messages": [{"role": "user", "content": "kv quant smoke"}],
+                "max_tokens": 32,
+                "temperature": 0,
+                "stream": True,
+            },
+        )
+        async with s.get(
+            f"http://127.0.0.1:{status.port}/metrics"
+        ) as r:
+            assert r.status == 200
+            metrics = await r.text()
+
+    for task in (worker, frontend):
+        task.cancel()
+    for rt in (worker_rt, front_rt):
+        await rt.shutdown()
+    await status.stop()
+    await store.stop()
+    return text, metrics
+
+
+def _gauge_value(metrics: str, name: str, must_contain: str = "") -> float:
+    for line in metrics.splitlines():
+        if line.startswith(name) and must_contain in line:
+            return float(line.rsplit(None, 1)[-1])
+    raise AssertionError(f"gauge {name!r} ({must_contain!r}) not on /metrics")
+
+
+async def run() -> None:
+    text_i8, m_i8 = await run_one("int8")
+    assert text_i8, "int8 deployment streamed nothing"
+    assert _gauge_value(m_i8, "dynamo_kv_cache_dtype_int8") == 1.0
+    assert _gauge_value(m_i8, "dynamo_kv_cache_dtype", 'kv_dtype="int8"') == 1.0
+    bytes_i8 = _gauge_value(m_i8, "dynamo_kv_cache_bytes_per_block")
+    cap_i8 = _gauge_value(m_i8, "dynamo_kv_cache_capacity_blocks")
+    assert cap_i8 > 0
+
+    text_bf, m_bf = await run_one("bf16")
+    assert _gauge_value(m_bf, "dynamo_kv_cache_dtype_int8") == 0.0
+    bytes_bf = _gauge_value(m_bf, "dynamo_kv_cache_bytes_per_block")
+    assert bytes_i8 < bytes_bf, (
+        f"int8 bytes/block {bytes_i8} not under bf16 {bytes_bf}"
+    )
+    assert text_i8 == text_bf, (
+        f"kv_dtype changed the stream:\n  int8: {text_i8!r}\n"
+        f"  bf16: {text_bf!r}"
+    )
+    print(
+        f"kvquant-smoke OK: {len(text_i8)} chars bit-identical int8 vs "
+        f"bf16; /metrics reports int8 at {bytes_i8:.0f} B/block vs bf16 "
+        f"{bytes_bf:.0f} ({bytes_i8 / bytes_bf:.3f}x)", flush=True,
+    )
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
