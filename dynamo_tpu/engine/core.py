@@ -1304,7 +1304,7 @@ class EngineCore:
 
             q8, sc = unpack_kv_page(kv, *self._page_geometry())
             return {"kv": q8[None], "scale": sc[None]}
-        return np.asarray(kv)[None]
+        return np.asarray(kv)[None]  # dynalint: sync-ok — host tier page, not a device array
 
     def _stack_staged(self, pages: list):
         """Stack per-block staged pytrees ([1, L, ...] leaves) into one
@@ -1631,6 +1631,7 @@ class EngineCore:
             if done:
                 feed_index[seq.request_id] = i
 
+        # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
             toks, lps = pend.land()
             outputs: list[tuple[Sequence, LLMEngineOutput]] = []
@@ -1697,6 +1698,7 @@ class EngineCore:
             lp = _lp_entry(int(toks[i]), lps[0][i], lps[1][i], lps[2][i], seq.logprobs)
         return int(toks[i]), lp
 
+    # dynalint: holds-lock(_step_lock) — called from _plan_waves on the step path
     def _maybe_ring_prefill(self, prefills: list[Sequence]):
         """Dispatch one eligible long prompt to the sequence-parallel ring
         path (dense ring-attention prefill over the sp mesh; the paged
@@ -1722,6 +1724,7 @@ class EngineCore:
             return self._run_ring_prefill(seq, T)
         return None
 
+    # dynalint: holds-lock(_step_lock) — synchronous ring path inside the step
     def _run_ring_prefill(self, seq: Sequence, T: int):
         self._mark_first_sched(seq, time.time())
         bs = self.engine.block_size
@@ -1730,7 +1733,7 @@ class EngineCore:
         tokens[:P_len] = seq.prompt
         pos = np.arange(T, dtype=np.int32)
         write_pages = np.full(T, self.engine.garbage_block, np.int32)
-        ids = np.asarray(seq.block_ids, np.int32)
+        ids = np.asarray(seq.block_ids, np.int32)  # dynalint: sync-ok — host list, not a device array
         write_pages[:P_len] = ids[pos[:P_len] // bs]
         write_offs = pos % bs
         want_lp = seq.logprobs is not None
@@ -1758,6 +1761,7 @@ class EngineCore:
                 "ring prefill active: %d-token prompt over sp=%d",
                 P_len, int(self.sp_mesh.shape["sp"]),
             )
+        # dynacheck: allow-transitive-blocking(ring prefill is deliberately synchronous — sp engines keep the classic loop, and the single long prompt IS the step)
         tok = int(fetch_replicated(toks)[0])
         completed = seq.hashed.extend(seq.prompt)
         self._commit_completed(seq, completed)
@@ -1766,6 +1770,7 @@ class EngineCore:
         seq.generated += 1
         lp = None
         if want_lp and lps is not None:
+            # dynacheck: allow-transitive-blocking(same synchronous ring path — logprob landing rides the already-landed step)
             lps = tuple(fetch_replicated_many(lps))
             lp = _lp_entry(tok, lps[0][0], lps[1][0], lps[2][0], seq.logprobs)
         out = self._emit(seq, tok, lp)
@@ -1997,6 +2002,7 @@ class EngineCore:
         with self._step_lock:
             return self._step_locked()
 
+    # dynalint: holds-lock(_step_lock) — step() locks before dispatching here
     def _step_locked(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         if self.engine.async_exec:
             outputs = self._step_async()
@@ -2013,6 +2019,7 @@ class EngineCore:
             self._t_prev_dispatch = 0.0
         return outputs
 
+    # dynalint: holds-lock(_step_lock) — only called from _step_locked
     def _step_async(self) -> list[tuple[Sequence, LLMEngineOutput]]:
         """One-step-ahead iteration: plan and enqueue the next step while
         the previous one executes on device, then commit the previous
@@ -2043,6 +2050,7 @@ class EngineCore:
         prev, self._inflight = self._inflight, None
         return prev.commit() if prev is not None else []
 
+    # dynalint: holds-lock(_step_lock) — step path only (sync and async loops)
     def _plan_step(self) -> _PlannedStep | None:
         """Plan + dispatch one engine iteration (no commit): drain
         intake, admit under the watermark, then assemble and enqueue the
@@ -2078,6 +2086,7 @@ class EngineCore:
             )
         return plan
 
+    # dynalint: holds-lock(_step_lock) — called from _plan_step
     def _plan_waves(self) -> _PlannedStep | None:
         """Prefill-priority scheduling: one monolithic prefill wave
         strictly before any decode (the classic vLLM-default shape)."""
@@ -2213,6 +2222,7 @@ class EngineCore:
             s.request_id: (n_steps - 1) * B + i for i, s in enumerate(ready)
         }
 
+        # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
             outputs: list[tuple[Sequence, LLMEngineOutput]] = []
             emitted_total = 0
@@ -2314,6 +2324,7 @@ class EngineCore:
             context, d_cap, sc.ngram_min, sc.ngram_max, sc.window
         )
 
+    # dynalint: holds-lock(_step_lock) — verify commits run inside the step
     def _apply_verify_row(
         self, seq: Sequence, draft: list[int], row_toks, lps, i: int
     ) -> tuple[LLMEngineOutput, int, int]:
@@ -2433,6 +2444,7 @@ class EngineCore:
             else {}
         )
 
+        # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
             outputs: list[tuple[Sequence, LLMEngineOutput]] = []
             toks, lps = pend.land()
@@ -2596,6 +2608,7 @@ class EngineCore:
                 if done and deterministic:
                     feed_index[seq.request_id] = i
 
+        # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
             outputs: list[tuple[Sequence, LLMEngineOutput]] = []
             toks2, lps2 = pend.land()
@@ -3140,6 +3153,7 @@ class EngineCore:
                     self.allocator.register_inactive(bid, h, parent)
             return self._account_transfer(len(staged), len(ids), skipped)
 
+    # dynalint: holds-lock(_step_lock) — every import endpoint locks first
     def _account_transfer(self, total: int, imported: int, skipped: int) -> ImportResult:
         """Update transfer_stats for one import call (caller holds the
         step lock) and return the per-call outcome."""
@@ -3186,6 +3200,7 @@ class EngineCore:
             )
         descs = src.export_descriptors(request_id)
         first, second = (src, self) if id(src) < id(self) else (self, src)
+        # dynacheck: allow-lock-order(global id()-ordered acquisition — mutual pulls always take the lower-id core's lock first, so the pair can never deadlock)
         with first._step_lock, second._step_lock:
             seq = src._held.get(request_id)
             if seq is None:

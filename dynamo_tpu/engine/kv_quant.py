@@ -123,7 +123,11 @@ def unpack_kv_page(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Inverse of :func:`pack_kv_page`: returns ``(int8 [L, ps, 2kv, d],
     f32 scales [L, ps, 2kv])`` views over the buffer."""
-    raw = np.frombuffer(bytes(buf), np.uint8) if isinstance(buf, (bytes, bytearray)) else np.asarray(buf, np.uint8)
+    raw = (
+        np.frombuffer(bytes(buf), np.uint8)
+        if isinstance(buf, (bytes, bytearray))
+        else np.asarray(buf, np.uint8)  # dynalint: sync-ok — packed host buffer, not a device array
+    )
     comb = 2 * num_kv_heads
     kv_n = num_layers * block_size * comb * head_dim
     sc_n = num_layers * block_size * comb * SCALE_BYTES

@@ -175,8 +175,8 @@ def plan_microbatches(
         last_local=np.zeros((M, S), np.int32),
         last_mask=np.zeros((M, S), bool),
     )
-    cu = np.asarray(cu_q_lens, np.int64)
-    kv = np.asarray(kv_lens, np.int64)
+    cu = np.asarray(cu_q_lens, np.int64)  # dynalint: sync-ok — host plan arrays, not device arrays
+    kv = np.asarray(kv_lens, np.int64)  # dynalint: sync-ok — host plan arrays, not device arrays
     for m in range(M):
         lo_c, hi_c = m * Tm, (m + 1) * Tm
         q_in_chunk = np.maximum(

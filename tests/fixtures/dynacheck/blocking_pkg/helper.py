@@ -1,0 +1,13 @@
+"""The helper the hot path reaches: a host sync (np.asarray over what
+could be a device array) plus a sleep, both invisible to a
+single-function pass at the hot function."""
+
+import time
+
+import numpy as np
+
+
+def assemble_tables(rows):
+    tables = np.asarray(rows)
+    time.sleep(0.001)
+    return tables

@@ -1,0 +1,16 @@
+"""Transitive-blocking fixture: the hot plan path reaches a device sync
+and an event-loop blocker TWO call frames down — dynalint's direct-site
+rule sees nothing here."""
+
+from tests.fixtures.dynacheck.blocking_pkg.helper import assemble_tables
+
+
+def plan_step(rows):
+    total = 0
+    for row in rows:
+        total += stage_row(row)
+    return assemble_tables(rows), total
+
+
+def stage_row(row):
+    return len(row)

@@ -1,0 +1,38 @@
+"""Coroutine-leak fixture: project-local async defs created but never
+awaited, spawned, returned, or reused — plus clean shapes that must NOT
+be flagged."""
+
+import asyncio
+
+
+async def flush_queue(items):
+    for item in items:
+        await asyncio.sleep(0)
+    return len(items)
+
+
+def drops_coroutine(items):
+    flush_queue(items)  # leak: created and immediately dropped
+    return True
+
+
+def binds_and_forgets(items):
+    pending = flush_queue(items)  # leak: bound but never used again
+    return len(items)
+
+
+async def clean_awaits(items):
+    return await flush_queue(items)
+
+
+def clean_spawns(items):
+    return asyncio.create_task(flush_queue(items))
+
+
+def clean_returns(items):
+    return flush_queue(items)  # caller awaits the tail call
+
+
+async def clean_bound_then_awaited(items):
+    coro = flush_queue(items)
+    return await coro

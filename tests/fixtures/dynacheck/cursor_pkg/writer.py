@@ -1,0 +1,22 @@
+"""Cursor-discipline fixture: writes to protocol state
+(num_computed_tokens cursor, pinned hashes, refcounts) from functions
+that are not audited commit/rollback/release entry points."""
+
+
+def fast_forward(seq, n):
+    seq.processed += n          # cursor write outside the audited set
+    return seq
+
+
+def prune_pins(seq):
+    seq.pinned_hashes.clear()   # pin mutation outside the audited set
+    return seq
+
+
+def bump_ref(blk):
+    blk.refcount += 1           # refcount write outside the allocator
+    return blk
+
+
+def reads_are_fine(seq):
+    return seq.processed + len(seq.pinned_hashes)

@@ -1,0 +1,11 @@
+"""Deadlock fixture, egress side: takes lock B then lock A — the
+opposite order of engine_side.py. Together they form a B->A / A->B
+cycle across modules and call frames."""
+
+from tests.fixtures.dynacheck.deadlock_pkg.engine_side import EngineSide
+
+
+def reversed_order(engine: EngineSide):
+    with engine._block:
+        with engine._alock:
+            pass

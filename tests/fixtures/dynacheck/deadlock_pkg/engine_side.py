@@ -1,0 +1,29 @@
+"""Deadlock fixture, engine side: takes lock A then (via a helper call
+two frames deep) lock B. The egress side takes them in the opposite
+order — dynacheck must extract the cross-module cycle."""
+
+import threading
+
+
+class EngineSide:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def outer(self, other):
+        with self._alock:
+            self.middle(other)
+
+    def middle(self, other):
+        # The second acquisition lives a call frame down — a
+        # single-function pass cannot see the A->B edge.
+        other.take_b()
+
+
+class HelperSide:
+    def __init__(self, engine: "EngineSide"):
+        self.engine = engine
+
+    def take_b(self):
+        with self.engine._block:
+            pass

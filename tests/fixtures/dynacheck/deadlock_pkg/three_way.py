@@ -1,0 +1,28 @@
+"""Three-lock deadlock fixture: X -> Y -> Z -> X across three functions.
+The cycle's node set sorts differently from its edge order, which is
+exactly the shape that must be reported (not crash) — the witness lookup
+must follow actual graph edges, not consecutive sorted pairs."""
+
+import threading
+
+
+class ThreeWay:
+    def __init__(self):
+        self._xlock = threading.Lock()
+        self._ylock = threading.Lock()
+        self._zlock = threading.Lock()
+
+    def x_then_y(self):
+        with self._xlock:
+            with self._ylock:
+                pass
+
+    def y_then_z(self):
+        with self._ylock:
+            with self._zlock:
+                pass
+
+    def z_then_x(self):
+        with self._zlock:
+            with self._xlock:
+                pass

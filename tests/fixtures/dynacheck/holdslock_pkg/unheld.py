@@ -1,0 +1,22 @@
+"""holds-lock-unverified fixture: a helper annotated as requiring the
+lock, called from one context that really holds it and one that does
+not — only the second is a finding."""
+
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.table = {}
+
+    # dynalint: holds-lock(_lock)
+    def mutate_locked(self, k, v):
+        self.table[k] = v
+
+    def good_caller(self, k, v):
+        with self._lock:
+            self.mutate_locked(k, v)
+
+    def bad_caller(self, k, v):
+        self.mutate_locked(k, v)  # annotation violated: no lock held

@@ -1,0 +1,46 @@
+"""The unpragma'd twin of pragma_multiline_ok.py: identical multi-line
+statements with no pragmas — every violation must still fire (the span
+anchoring must not silently widen into blanket suppression), and a
+pragma INSIDE a function body must not cover sibling statements."""
+
+import time
+
+import requests
+
+
+async def wrapped_call_still_flagged(log):
+    result = log.wrap(
+        time.sleep(
+            1.0
+        ),
+    )
+    return result
+
+
+async def comprehension_still_flagged(items):
+    return [
+        requests.get(url)
+        for url in items
+    ]
+
+
+async def pragma_does_not_blanket_the_function(log):
+    # dynalint: allow-blocking-in-async(fixture: covers only this statement)
+    time.sleep(1.0)
+    time.sleep(2.0)  # must still be flagged: the pragma above covers one statement
+    return log
+
+
+async def trailing_pragma_does_not_bleed_to_sibling(log):
+    time.sleep(
+        1.0
+    )  # dynalint: allow-blocking-in-async(fixture: trailing pragma on the last span line)
+    time.sleep(2.0)  # must still be flagged: the next sibling is not covered
+    return log
+
+
+async def header_pragma_does_not_cover_body(
+    log,
+):  # dynalint: allow-blocking-in-async(fixture: multi-line def header — pragma anchors to the header, not the body)
+    time.sleep(1.0)  # must still be flagged: body is not header
+    return log

@@ -1,0 +1,35 @@
+"""Pragmas on multi-line statements: every violation here is suppressed
+by a pragma anchored somewhere on the statement's line span — the
+opening line, the line above, or an argument line — even though the
+flagged AST node reports a different lineno. The line-based matcher
+missed all of these."""
+
+import time
+
+import requests
+
+
+async def pragma_on_opening_line(log):
+    # The flagged node (time.sleep) sits on the argument line, two lines
+    # below the pragma'd opening line of the wrapped call.
+    result = log.wrap(  # dynalint: allow-blocking-in-async(fixture: pragma on the opening line of a wrapped call)
+        time.sleep(
+            1.0
+        ),
+    )
+    return result
+
+
+async def pragma_above_wrapped_statement(items):
+    # dynalint: allow-blocking-in-async(fixture: pragma above a statement whose flagged node is on a later line)
+    return [
+        requests.get(url)
+        for url in items
+    ]
+
+
+async def pragma_on_argument_line(log):
+    result = log.wrap(
+        time.sleep(2.0),  # dynalint: allow-blocking-in-async(fixture: pragma on the argument line covers the statement)
+    )
+    return result

@@ -49,12 +49,25 @@ GRANDFATHERED: dict[tuple[str, str], int] = {}
 # Every in-source pragma, pinned: {(path, kind, arg): count}.
 PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     # EngineCore helpers called only from under _step_lock (step path and
-    # the disagg transfer endpoints lock before calling).
-    ("dynamo_tpu/engine/core.py", "holds-lock", "_step_lock"): 3,
+    # the disagg transfer endpoints lock before calling). Grown by the
+    # dynacheck holds-lock-unverified sweep (ISSUE 9): every annotation
+    # is now CHECKED along call paths, so the whole plan/commit chain
+    # carries it explicitly — _step_locked/_step_async/_plan_step/
+    # _plan_waves/_maybe_ring_prefill/_run_ring_prefill, the four
+    # per-scheduler commit closures, _apply_verify_row, _account_transfer,
+    # plus the original _finish/_sweep_expired_holds/transfer endpoints.
+    ("dynamo_tpu/engine/core.py", "holds-lock", "_step_lock"): 15,
     # Intentional syncs inside blocking-host-sync hot paths: the
     # double-buffered landing point (_PendingFetch.land — tokens +
-    # batched logprobs) and np.asarray over a host block-id list.
-    ("dynamo_tpu/engine/core.py", "sync-ok", ""): 3,
+    # batched logprobs), np.asarray over host block-id lists (dispatch
+    # assembly + ring prefill), and the host-tier page staging in
+    # _stage_page (host buffer, not a device array).
+    ("dynamo_tpu/engine/core.py", "sync-ok", ""): 5,
+    # Host-buffer asarray sites cleared by the dynacheck transitive-
+    # blocking sweep: packed-page unpacking and pp microbatch planning
+    # operate on host arrays only.
+    ("dynamo_tpu/engine/kv_quant.py", "sync-ok", ""): 1,
+    ("dynamo_tpu/parallel/pipeline.py", "sync-ok", ""): 2,
     # Deliberately deadline-free awaits (unbounded-await rule): server
     # read loops idling between frames, engine-local queues whose
     # producer is in-process, and push-subscription streams. The
@@ -190,6 +203,23 @@ def test_host_sync_hot_paths_cover_engine_core():
         "_dispatch_ragged", "_dispatch_megastep", "_plan_megastep",
         "_plan_step",
     } <= funcs
+
+
+def test_pragma_spans_cover_multiline_statements():
+    # The line-based matcher missed a pragma on the opening line of a
+    # wrapped call whenever the flagged node reported a later lineno;
+    # pragmas now anchor to the statement's FULL line span (ISSUE 9).
+    ok = lint_file(FIXTURES / "pragma_multiline_ok.py", REPO)
+    assert ok.findings == [], [str(f) for f in ok.findings]
+    assert len(ok.pragmas) == 3
+    # ...and the span anchoring neither mutes unpragma'd statements nor
+    # lets a pragma bleed beyond its own statement: a pragma inside a
+    # function body must not blanket its siblings, a TRAILING pragma on
+    # the last line of a multi-line statement must not cover the next
+    # sibling statement, and a pragma on a multi-line def/with HEADER
+    # line must not cover the first body statement.
+    bad = rules_at(FIXTURES / "pragma_multiline_bad.py")
+    assert bad == [C.RULE_BLOCKING_IN_ASYNC] * 5, bad
 
 
 def test_malformed_pragmas_are_findings():

@@ -1,0 +1,114 @@
+"""CLI: ``python -m tools.dynacheck`` (the CI gate).
+
+Runs both engines over ``dynamo_tpu/`` by default. Exit 0 when the tree
+is clean (zero unpragma'd interprocedural findings AND zero model
+invariant violations), 1 on findings/violations, 2 on usage errors.
+
+``--engine a|b`` narrows to one engine; ``--rules`` narrows Engine A to
+a comma-separated subset; ``--pragmas`` prints the in-source suppression
+inventory (what tests/test_dynacheck.py pins); ``--no-cache`` bypasses
+the source-hash keyed Engine A cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from tools.dynacheck import cache as CA
+from tools.dynacheck import config as C
+from tools.dynacheck.callgraph import build_project, iter_py_files
+from tools.dynacheck.explore import explore
+from tools.dynacheck.interproc import run_all
+from tools.dynacheck.report import Report, stats_for
+
+
+def run(
+    paths: list[Path],
+    repo_root: Path,
+    engine: str = "all",
+    rules: set[str] | None = None,
+    use_cache: bool = True,
+) -> Report:
+    report = Report()
+    if engine in ("a", "all"):
+        files = iter_py_files(paths, repo_root)
+        key = CA.tree_key(files, repo_root) if use_cache else None
+        cached = CA.load(repo_root, key) if key else None
+        if cached is not None:
+            findings, pragmas, functions, edges = cached
+        else:
+            project = build_project(paths, repo_root)
+            findings = run_all(project)
+            pragmas = list(project.pragmas)
+            functions, edges = stats_for(project)
+            if key:
+                CA.store(repo_root, key, findings, pragmas, functions, edges)
+        if rules is not None:
+            findings = [
+                f for f in findings
+                if f.rule in rules or f.rule == "malformed-pragma"
+            ]
+        report.findings = findings
+        report.pragmas = pragmas
+        report.functions = functions
+        report.resolved_edges = edges
+    if engine in ("b", "all"):
+        from tools.dynacheck.models import ALL_MODELS
+
+        for model_cls in ALL_MODELS:
+            report.models.append(explore(model_cls()))
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynacheck",
+        description="dynamo-tpu interprocedural analysis + invariant models",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=list(C.DEFAULT_PATHS),
+        help="files or directories to analyze (default: dynamo_tpu/)",
+    )
+    ap.add_argument("--engine", choices=("a", "b", "all"), default="all")
+    ap.add_argument(
+        "--rules", default=None,
+        help=f"comma-separated subset of: {', '.join(C.ALL_RULES)}",
+    )
+    ap.add_argument(
+        "--pragmas", action="store_true",
+        help="also list every dynacheck suppression pragma in the tree",
+    )
+    ap.add_argument("--no-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(C.ALL_RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    paths = [Path(p) for p in args.paths]
+    for p in paths:
+        if not p.exists():
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    repo_root = Path(__file__).resolve().parents[2]
+    t0 = time.monotonic()
+    report = run(
+        paths, repo_root, engine=args.engine, rules=rules,
+        use_cache=not args.no_cache,
+    )
+    sys.stdout.write(report.render(show_pragmas=args.pragmas))
+    # Wall-clock to stderr only: the stdout report stays byte-identical.
+    print(f"dynacheck ran in {time.monotonic() - t0:.1f}s", file=sys.stderr)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Source-hash keyed cache for the Engine A analysis (the call-graph
+build + interprocedural rules — the expensive half of a dynacheck run).
+
+The key is a sha256 over every scanned file's (path, bytes) in sorted
+order PLUS the analyzer's own sources (tools/dynacheck + tools/dynalint,
+whose config feeds the rule tables) — any edit to either misses. The
+cached artifact is the Engine A result (findings + pragma inventory +
+graph stats) as JSON; Engine B always executes (the models ARE the
+check, and they run in seconds).
+
+Layout: ``.dynacheck_cache/<key>.json`` under the repo root; the CI job
+caches this directory keyed on the same file set. ``--no-cache``
+bypasses both read and write.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from tools.dynacheck.callgraph import Pragma
+from tools.dynacheck.interproc import Finding
+
+CACHE_DIR = ".dynacheck_cache"
+_VERSION = 1
+
+
+def tree_key(files: list[Path], repo_root: Path) -> str:
+    h = hashlib.sha256(b"dynacheck-v%d" % _VERSION)
+    tool_dir = Path(__file__).resolve().parent
+    tool_files = sorted(tool_dir.rglob("*.py"))
+    tool_files += sorted((tool_dir.parent / "dynalint").rglob("*.py"))
+    for f in tool_files + sorted(files):
+        try:
+            rel = f.resolve().relative_to(repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        h.update(rel.encode())
+        h.update(b"\0")
+        h.update(f.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def load(repo_root: Path, key: str):
+    """Returns (findings, pragmas, functions, edges) or None on miss."""
+    p = repo_root / CACHE_DIR / f"{key}.json"
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    try:
+        findings = [Finding(**f) for f in data["findings"]]
+        pragmas = [Pragma(**p) for p in data["pragmas"]]
+        return findings, pragmas, data["functions"], data["edges"]
+    except (KeyError, TypeError):
+        return None
+
+
+def store(
+    repo_root: Path, key: str,
+    findings: list[Finding], pragmas: list[Pragma],
+    functions: int, edges: int,
+) -> None:
+    d = repo_root / CACHE_DIR
+    payload = {
+        "findings": [vars(f) for f in findings],
+        "pragmas": [vars(p) for p in pragmas],
+        "functions": functions,
+        "edges": edges,
+    }
+    try:
+        d.mkdir(exist_ok=True)
+        tmp = d / f".{key}.tmp"
+        tmp.write_text(json.dumps(payload, sort_keys=True), encoding="utf-8")
+        tmp.replace(d / f"{key}.json")
+    except OSError:
+        pass  # cache is best-effort; the analysis can always re-run

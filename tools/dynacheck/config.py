@@ -1,0 +1,206 @@
+"""dynacheck configuration: rule tables pinning the generic analyses to
+the dynamo-tpu codebase.
+
+Everything here is data. Engine A's rules (``interproc.py``) and the call
+graph builder (``callgraph.py``) are generic; this file tells them which
+functions are hot paths, which attributes are protocol state, and which
+entry points are audited. The blocking-call and lock vocabulary is shared
+with dynalint (``tools.dynalint.config``) so the two tiers can never
+disagree about what "blocking" or "guarded" means.
+"""
+
+from __future__ import annotations
+
+from tools.dynalint import config as L
+
+# ---------------------------------------------------------------------------
+# Rule ids (used in pragmas: `# dynacheck: allow-<rule>(<reason>)`)
+# ---------------------------------------------------------------------------
+
+RULE_TRANSITIVE_BLOCKING = "transitive-blocking"
+RULE_LOCK_ORDER = "lock-order"
+RULE_HOLDS_LOCK_UNVERIFIED = "holds-lock-unverified"
+RULE_CORO_LEAK = "coroutine-leak"
+RULE_CURSOR = "cursor-discipline"
+RULE_REGISTRY_DRIFT = "registry-drift"
+
+ALL_RULES = (
+    RULE_TRANSITIVE_BLOCKING,
+    RULE_LOCK_ORDER,
+    RULE_HOLDS_LOCK_UNVERIFIED,
+    RULE_CORO_LEAK,
+    RULE_CURSOR,
+    RULE_REGISTRY_DRIFT,
+)
+
+# ---------------------------------------------------------------------------
+# Shared vocabulary (single source of truth: dynalint's config).
+# ---------------------------------------------------------------------------
+
+# Step-loop hot paths: {file suffix -> set of function names}. dynalint
+# flags DIRECT host-sync calls inside these; dynacheck flags TRANSITIVE
+# reachability (a sync two or more frames down the call graph).
+HOT_STEP_FUNCS = L.HOT_STEP_FUNCS
+
+# Device->host sync call vocabulary (np.asarray / fetch_replicated /
+# .item() / .block_until_ready()).
+HOST_SYNC_FNS = L.HOST_SYNC_FNS
+HOST_SYNC_METHODS = L.HOST_SYNC_METHODS
+HOST_SYNC_ASARRAY_ROOTS = L.HOST_SYNC_ASARRAY_ROOTS
+
+# Event-loop blockers (time.sleep, subprocess.*, requests.*, ...): a hot
+# step function transitively reaching one of these is flagged too — the
+# step loop runs on a worker thread, but a plan-path sleep serializes
+# scheduling exactly like a host sync does.
+BLOCKING_CALLS = set(L.BLOCKING_CALLS)
+BLOCKING_ROOTS = set(L.BLOCKING_ROOTS)
+
+# The GUARDED_BY registry dynacheck cross-references for drift (satellite:
+# the registry is hand-maintained since PR 1; dynacheck fails on entries
+# that no longer exist or attrs mutated nowhere under their declared lock).
+GUARDED_BY = L.GUARDED_BY
+EXTERNAL = L.EXTERNAL
+
+# ---------------------------------------------------------------------------
+# lock-order: lock recognition + identity.
+# ---------------------------------------------------------------------------
+
+# Constructor call names whose assignment target becomes a known lock:
+# `self.X = threading.Lock()` / module-level `_lock = threading.Lock()`.
+LOCK_CONSTRUCTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "Lock", "RLock",
+}
+
+# Attribute-name fallback: a `with <expr>.<attr>:` whose attr ends with
+# one of these suffixes is treated as a lock acquisition even when the
+# constructor was not seen (e.g. the receiver is another instance).
+LOCK_NAME_SUFFIXES = ("lock",)
+
+# ---------------------------------------------------------------------------
+# coroutine-leak: calls that take ownership of a coroutine object. A call
+# to a project-local `async def` must be awaited, handed to one of these,
+# returned, or bound to a name that is used again — anything else is a
+# created-but-never-scheduled coroutine silently dropped on the floor
+# (the body never runs; Python logs "never awaited" at gc time at best).
+# ---------------------------------------------------------------------------
+
+CORO_SINKS = {
+    "create_task", "ensure_future", "gather", "wait", "wait_for",
+    "shield", "run", "run_until_complete", "run_coroutine_threadsafe",
+    "as_completed", "spawn_logged", "timeout", "staggered_race",
+}
+
+# ---------------------------------------------------------------------------
+# cursor-discipline: the audited-writer registry.
+#
+# CURSOR_ATTRS maps protocol-state attribute names to a short description
+# of the protocol they belong to. ANY write to one of these attributes
+# (assign / augassign / del / mutator-method call, on any receiver) in the
+# scanned tree is an error unless the enclosing function is listed in
+# AUDITED_CURSOR_WRITERS for its file — the commit/rollback/release entry
+# points whose bookkeeping the engine-parity tests pin. The three shipped
+# cross-function bugs (block-refcount double-release, preemption prompt
+# truncation, disagg partial-block misalignment) were all writes to this
+# state from paths outside the audited set.
+# ---------------------------------------------------------------------------
+
+CURSOR_ATTRS = {
+    # Sequence progress cursors (engine/core.py): num_computed_tokens is
+    # the `processed` property — the rollback cursor every late-stop /
+    # rejected-draft path relies on.
+    "processed": "num_computed_tokens cursor",
+    "prefilled": "prefill progress cursor",
+    "pinned_hashes": "pinned-hash block pins",
+    "committed_blocks": "committed-block watermark",
+    # Allocator bookkeeping (engine/block_allocator.py and the mocker's
+    # hash-only sibling): refcount conservation is the allocator model's
+    # core invariant, so host code must not touch these out of band.
+    "refcount": "block refcount",
+    "_free": "allocator free list",
+    "_by_hash": "allocator hash index",
+    "_inactive": "allocator inactive LRU",
+    "_partials": "allocator partial-block count",
+}
+
+# {file suffix -> set of audited writer qualnames}. Nested defs are dotted
+# (`EngineCore._plan_megastep.commit` is the megastep commit closure).
+AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
+    "dynamo_tpu/engine/core.py": {
+        # admission (prefix-cache pins + cached-cursor fast-forward)
+        "EngineCore._admit",
+        # block commit path (shared by every scheduler)
+        "EngineCore._commit_completed",
+        # prefill-chunk cursor advance (wave + mixed steps)
+        "EngineCore._advance_prefill_chunk",
+        # ring-prefill synchronous commit
+        "EngineCore._run_ring_prefill",
+        # rollback entry points
+        "EngineCore._preempt",
+        "EngineCore._release_blocks",
+        # per-step commit closures / helpers
+        "EngineCore._plan_prefill_wave.commit",
+        "EngineCore._plan_megastep.commit",
+        "EngineCore._plan_mixed.commit",
+        "EngineCore._apply_verify_row",
+    },
+    # The allocator owns its bookkeeping wholesale: every public method is
+    # an audited entry point; the rule guards against OTHER files reaching
+    # into `allocator._free` / `blk.refcount` directly.
+    "dynamo_tpu/engine/block_allocator.py": {
+        "DeviceBlockAllocator.__init__",
+        "DeviceBlockAllocator._evict_lru",
+        "DeviceBlockAllocator.alloc",
+        "DeviceBlockAllocator.alloc_many",
+        "DeviceBlockAllocator.alloc_for_import",
+        "DeviceBlockAllocator.acquire_cached",
+        "DeviceBlockAllocator.commit",
+        "DeviceBlockAllocator.free_partial",
+        "DeviceBlockAllocator.release",
+        "DeviceBlockAllocator.register_inactive",
+        "DeviceBlockAllocator.clear_cache",
+    },
+    # The mocker mirrors the scheduler on its virtual clock; its step loop
+    # and hash-only KV manager are the same protocol in miniature.
+    "dynamo_tpu/llm/mocker/engine.py": {
+        "MockTpuEngine._admit",
+        "MockTpuEngine._step",
+    },
+    "dynamo_tpu/llm/mocker/kv_manager.py": {
+        "MockKvManager.__init__",
+        "MockKvManager._evict_lru",
+        "MockKvManager._ensure_headroom",
+        "MockKvManager.acquire_cached",
+        "MockKvManager.allocate_partial",
+        "MockKvManager.commit_block",
+        "MockKvManager.release_partial",
+        "MockKvManager.release",
+        "MockKvManager.clear_unpinned",
+        "MockKvManager.clear",
+    },
+}
+
+# ---------------------------------------------------------------------------
+# File selection.
+# ---------------------------------------------------------------------------
+
+# Default scan root for the tree run (`python -m tools.dynacheck`).
+DEFAULT_PATHS = ("dynamo_tpu",)
+
+# Shared with dynalint (live alias, not a copy): the two tiers must
+# scan the same file set, and the dynacheck cache key depends on it.
+EXCLUDE_PARTS = L.EXCLUDE_PARTS
+
+# ---------------------------------------------------------------------------
+# Engine B exploration bounds. Depths are chosen so the full tree run
+# stays well under the CI runtime budget (< 60 s) while every model still
+# visits its complete reachable state space (the explorers report when the
+# frontier is exhausted before the bound — all three are, at these bounds).
+# ---------------------------------------------------------------------------
+
+MODEL_DEPTHS = {
+    "allocator": 18,
+    "cursor": 12,
+    "breaker": 18,
+}

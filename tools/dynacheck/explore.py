@@ -1,0 +1,130 @@
+"""Engine B: exhaustive bounded-depth interleaving exploration.
+
+A model exposes an initial state, a deterministic set of enabled actions
+per state, an invariant check, and a canonical fingerprint. The explorer
+runs breadth-first over DISTINCT states (fingerprint-deduplicated), so
+every reachable state within the depth bound is visited exactly once and
+every invariant is asserted at every one of them — this is exhaustive
+state-space exploration, not sampling. Interleaving coverage follows:
+two action orders that could disagree necessarily pass through different
+states, and both states are visited.
+
+Determinism: actions are explored in the order the model returns them
+(models sort by action name), initial states in listed order, and the
+frontier is a FIFO — the report is byte-identical across runs.
+
+Violations carry the shortest action trace that reproduces them, so a
+model bug report is directly replayable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+
+class Model:
+    """Interface Engine B models implement. States are never mutated in
+    place by the explorer: ``apply`` must return a NEW state (models
+    clone internally — the real allocator/breaker instances they drive
+    are cloned field-by-field)."""
+
+    name: str = "model"
+    max_depth: int = 10
+
+    def initial_states(self) -> Iterable[tuple[str, Any]]:
+        raise NotImplementedError
+
+    def actions(self, state: Any) -> list[tuple[str, Callable[[Any], Any]]]:
+        raise NotImplementedError
+
+    def invariants(self, state: Any) -> list[str]:
+        raise NotImplementedError
+
+    def fingerprint(self, state: Any) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Violation:
+    model: str
+    trace: tuple[str, ...]
+    message: str
+
+    def __str__(self) -> str:
+        path = " ; ".join(self.trace) or "<initial>"
+        return f"[{self.model}] after [{path}]: {self.message}"
+
+
+@dataclass
+class ModelResult:
+    name: str
+    states: int = 0
+    transitions: int = 0
+    depth_reached: int = 0
+    exhausted: bool = False   # frontier emptied before the depth bound
+    truncated: bool = False   # stopped early: violation cap reached
+    violations: list[Violation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        status = "OK" if self.ok else f"{len(self.violations)} VIOLATION(S)"
+        if self.truncated:
+            frontier = "stopped at the violation cap"
+        elif self.exhausted:
+            frontier = "state space exhausted"
+        else:
+            frontier = "depth bound hit"
+        return (
+            f"model {self.name}: {self.states} states, "
+            f"{self.transitions} transitions, depth {self.depth_reached} "
+            f"({frontier}) — {status}"
+        )
+
+
+def explore(model: Model, max_violations: int = 8) -> ModelResult:
+    res = ModelResult(name=model.name)
+    seen: set[Any] = set()
+    frontier: list[tuple[Any, tuple[str, ...]]] = []
+    for label, state in model.initial_states():
+        fp = model.fingerprint(state)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        res.states += 1
+        for msg in model.invariants(state):
+            res.violations.append(Violation(model.name, (label,), msg))
+        frontier.append((state, (label,)))
+    depth = 0
+    while frontier and depth < model.max_depth:
+        depth += 1
+        nxt: list[tuple[Any, tuple[str, ...]]] = []
+        for state, trace in frontier:
+            if len(res.violations) >= max_violations:
+                break
+            for name, apply_fn in model.actions(state):
+                new_state = apply_fn(state)
+                if new_state is None:
+                    continue  # action disabled in this state
+                res.transitions += 1
+                new_trace = trace + (name,)
+                for msg in model.invariants(new_state):
+                    res.violations.append(Violation(model.name, new_trace, msg))
+                    if len(res.violations) >= max_violations:
+                        break
+                fp = model.fingerprint(new_state)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                res.states += 1
+                nxt.append((new_state, new_trace))
+        res.depth_reached = depth
+        frontier = nxt
+        if len(res.violations) >= max_violations:
+            res.truncated = True
+            break
+    res.exhausted = not frontier and not res.truncated
+    return res

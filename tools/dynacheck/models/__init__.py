@@ -1,0 +1,19 @@
+"""Engine B models: executable miniatures of the three hairiest state
+machines, explored exhaustively by :mod:`tools.dynacheck.explore`.
+
+- ``allocator`` drives the REAL :class:`DeviceBlockAllocator` (pure
+  Python) through admit/alloc/commit/release/evict/clear interleavings
+  over a shared-prefix two-sequence world.
+- ``cursor`` models the async-exec + megastep plan/dispatch/commit
+  cursor protocol against a synchronous reference trace.
+- ``breaker`` drives the REAL :class:`CircuitBreaker` under a virtual
+  clock, including the cancelled-probe re-arm.
+"""
+
+from __future__ import annotations
+
+from tools.dynacheck.models.allocator import AllocatorModel
+from tools.dynacheck.models.breaker import BreakerModel
+from tools.dynacheck.models.cursor import CursorModel
+
+ALL_MODELS = (AllocatorModel, CursorModel, BreakerModel)

@@ -1,0 +1,300 @@
+"""Allocator model: the REAL DeviceBlockAllocator under every admit /
+alloc / commit / abort / release / evict / clear interleaving.
+
+World: 3 physical blocks, two sequences whose 2-block hash chains share
+their first block (A: [101, 102], B: [101, 202]) — the shared prefix is
+what makes refcount conservation interesting (dedup on commit, shared
+pins, LRU revival). One initial-state variant arms the ``on_evict``
+demotion hook (the host-KV-tier shape, where eviction does NOT emit
+``removed``), the other leaves eviction emitting.
+
+Invariants checked at EVERY reachable state:
+
+- **block conservation** — free + committed + outstanding partials is
+  exactly the capacity, with no block id in two places at once;
+- **refcount conservation** — each committed block's refcount equals the
+  number of model-side pins on its hash (no double-release can ever make
+  this balance);
+- **LRU consistency** — inactive is exactly the refcount-0 slice of the
+  committed set;
+- **event balance** — ``on_stored``/``on_removed`` callbacks (the
+  router's view of this worker) track the committed set exactly: no
+  double-remove, no remove-before-store, no pinned-hash leak;
+- **drain leak-freedom** — in any quiescent state (nothing pinned, no
+  partials, cache cleared) every block is back on the free list.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Any, Callable
+
+from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError, _Committed
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+CAPACITY = 3
+CHAINS = {"A": (101, 102), "B": (101, 202)}
+
+
+class _State:
+    def __init__(self, demote: bool):
+        self.demote = demote
+        self.events: list[tuple[str, int]] = []     # ("stored"|"removed"|"demoted", hash)
+        self.alloc = DeviceBlockAllocator(
+            CAPACITY, block_size=4, enable_prefix_caching=True,
+            on_stored=self._on_stored, on_removed=self._on_removed,
+        )
+        if demote:
+            self.alloc.on_evict = self._on_evict
+        # Per-sequence protocol mirror: pinned hash list (what
+        # _release_blocks would release), outstanding partial block id,
+        # next chain index to fill.
+        self.pinned: dict[str, list[int]] = {"A": [], "B": []}
+        self.partial: dict[str, int | None] = {"A": None, "B": None}
+        self.next_idx: dict[str, int] = {"A": 0, "B": 0}
+        self.started: dict[str, bool] = {"A": False, "B": False}
+
+    # -- event hooks (the router's view) -----------------------------------
+
+    def _on_stored(self, hashes: list[int], parent: int | None) -> None:
+        for h in hashes:
+            self.events.append(("stored", h))
+
+    def _on_removed(self, hashes: list[int]) -> None:
+        for h in hashes:
+            self.events.append(("removed", h))
+
+    def _on_evict(self, block_id: int, h: int, parent: int | None) -> None:
+        self.events.append(("demoted", h))
+
+    # -- cloning (the explorer never mutates in place) ---------------------
+
+    def clone(self) -> "_State":
+        new = _State.__new__(_State)
+        new.demote = self.demote
+        new.events = list(self.events)
+        a, src = DeviceBlockAllocator.__new__(DeviceBlockAllocator), self.alloc
+        a.capacity = src.capacity
+        a.block_size = src.block_size
+        a.enable_prefix_caching = src.enable_prefix_caching
+        a._free = deque(src._free)
+        a._by_hash = {
+            h: _Committed(b.block_id, b.block_hash, b.parent_hash, b.refcount)
+            for h, b in src._by_hash.items()
+        }
+        # _inactive must reference the SAME _Committed objects as _by_hash.
+        a._inactive = OrderedDict((h, a._by_hash[h]) for h in src._inactive)
+        a._partials = src._partials
+        a.prefix_queries = src.prefix_queries
+        a.prefix_hits = src.prefix_hits
+        a.on_stored = new._on_stored
+        a.on_removed = new._on_removed
+        a.on_evict = new._on_evict if self.demote else None
+        new.alloc = a
+        new.pinned = {k: list(v) for k, v in self.pinned.items()}
+        new.partial = dict(self.partial)
+        new.next_idx = dict(self.next_idx)
+        new.started = dict(self.started)
+        return new
+
+
+class AllocatorModel(Model):
+    name = "allocator"
+    max_depth = C.MODEL_DEPTHS["allocator"]
+
+    def initial_states(self):
+        yield "init", _State(demote=False)
+        yield "init-demote-hook", _State(demote=True)
+
+    # -- actions -----------------------------------------------------------
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        acts: list[tuple[str, Callable[[Any], Any]]] = []
+        for s in ("A", "B"):
+            if not state.started[s]:
+                acts.append((f"admit_{s}", self._mk(self._admit, s)))
+            else:
+                chain = CHAINS[s]
+                if state.partial[s] is None and state.next_idx[s] < len(chain):
+                    acts.append((f"alloc_{s}", self._mk(self._alloc, s)))
+                if state.partial[s] is not None:
+                    acts.append((f"commit_{s}", self._mk(self._commit, s)))
+                    acts.append((f"abort_{s}", self._mk(self._abort, s)))
+                acts.append((f"release_{s}", self._mk(self._release, s)))
+        # Peer KV import (the disagg/kv_transfer path): content arrives
+        # from another worker and registers as cached-but-unpinned.
+        acts.append(("import_peer", self._import_peer))
+        acts.append(("clear_cache", self._clear))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    @staticmethod
+    def _mk(fn, s):
+        return lambda state: fn(state, s)
+
+    @staticmethod
+    def _admit(state: _State, s: str) -> _State:
+        st = state.clone()
+        ids = st.alloc.acquire_cached(list(CHAINS[s]))
+        st.pinned[s] = list(CHAINS[s][: len(ids)])
+        st.next_idx[s] = len(ids)
+        st.started[s] = True
+        return st
+
+    @staticmethod
+    def _alloc(state: _State, s: str) -> _State | None:
+        st = state.clone()
+        try:
+            st.partial[s] = st.alloc.alloc()
+        except OutOfBlocksError:
+            return None  # legitimate refusal: nothing changed
+        return st
+
+    @staticmethod
+    def _commit(state: _State, s: str) -> _State:
+        st = state.clone()
+        chain = CHAINS[s]
+        idx = st.next_idx[s]
+        parent = chain[idx - 1] if idx > 0 else None
+        st.alloc.commit(st.partial[s], chain[idx], parent)
+        st.partial[s] = None
+        st.pinned[s].append(chain[idx])
+        st.next_idx[s] = idx + 1
+        return st
+
+    @staticmethod
+    def _abort(state: _State, s: str) -> _State:
+        st = state.clone()
+        st.alloc.free_partial(st.partial[s])
+        st.partial[s] = None
+        return st
+
+    @staticmethod
+    def _release(state: _State, s: str) -> _State:
+        # Mirrors EngineCore._release_blocks: partials back to the free
+        # list, pins released, then the slate is clean for re-admission.
+        st = state.clone()
+        if st.partial[s] is not None:
+            st.alloc.free_partial(st.partial[s])
+            st.partial[s] = None
+        st.alloc.release(st.pinned[s])
+        st.pinned[s] = []
+        st.next_idx[s] = 0
+        st.started[s] = False
+        return st
+
+    @staticmethod
+    def _import_peer(state: _State) -> _State | None:
+        # Mirrors import_blocks: alloc_for_import + register_inactive,
+        # dedup against already-cached content (the canonical id wins and
+        # the fresh block goes straight back to the free list).
+        st = state.clone()
+        h, parent = CHAINS["B"][1], CHAINS["B"][0]
+        try:
+            bid = st.alloc.alloc_for_import()
+        except OutOfBlocksError:
+            return None
+        st.alloc.register_inactive(bid, h, parent)
+        return st
+
+    @staticmethod
+    def _clear(state: _State) -> _State:
+        st = state.clone()
+        st.alloc.clear_cache()
+        return st
+
+    # -- invariants --------------------------------------------------------
+
+    def invariants(self, state: _State) -> list[str]:
+        out: list[str] = []
+        a = state.alloc
+        free = list(a._free)
+        committed_ids = [b.block_id for b in a._by_hash.values()]
+        partials = [b for b in state.partial.values() if b is not None]
+        everywhere = free + committed_ids + partials
+        if sorted(everywhere) != list(range(CAPACITY)):
+            out.append(
+                "block conservation broken: free=%s committed=%s partials=%s "
+                "(capacity %d)" % (free, committed_ids, partials, CAPACITY)
+            )
+        if a._partials != len(partials):
+            out.append(
+                f"partial count drift: allocator says {a._partials}, "
+                f"model holds {len(partials)}"
+            )
+        # Refcount conservation against model pins.
+        pins: dict[int, int] = {}
+        for s in ("A", "B"):
+            for h in state.pinned[s]:
+                pins[h] = pins.get(h, 0) + 1
+        for h, blk in a._by_hash.items():
+            if blk.refcount < 0:
+                out.append(f"negative refcount on hash {h}: {blk.refcount}")
+            if blk.refcount != pins.get(h, 0):
+                out.append(
+                    f"refcount conservation broken for hash {h}: allocator "
+                    f"says {blk.refcount}, model pins {pins.get(h, 0)} "
+                    "(double-release or leaked pin)"
+                )
+        # Inactive LRU is exactly the refcount-0 slice.
+        for h in a._inactive:
+            if h not in a._by_hash:
+                out.append(f"inactive hash {h} missing from _by_hash")
+            elif a._inactive[h] is not a._by_hash[h]:
+                out.append(f"inactive and _by_hash disagree on hash {h} identity")
+            elif a._by_hash[h].refcount != 0:
+                out.append(f"pinned hash {h} sits in the inactive LRU")
+        for h, blk in a._by_hash.items():
+            if blk.refcount == 0 and h not in a._inactive:
+                out.append(f"refcount-0 hash {h} not reclaimable (LRU leak)")
+        # Event balance: the router's stored-set must equal the committed set.
+        live: set[int] = set()
+        for kind, h in state.events:
+            if kind == "stored":
+                if h in live:
+                    out.append(f"hash {h} stored twice without removal")
+                live.add(h)
+            else:  # removed / demoted both end router-visible residency
+                if h not in live:
+                    out.append(f"hash {h} {kind} but never stored")
+                live.discard(h)
+        if live != set(a._by_hash):
+            out.append(
+                f"router residency drift: events say {sorted(live)}, "
+                f"allocator holds {sorted(a._by_hash)} (pinned-hash leak)"
+            )
+        # Drain leak-freedom: quiescent + empty cache -> everything free.
+        if not a._by_hash and not partials and len(free) != CAPACITY:
+            out.append(f"leak at quiescence: only {len(free)}/{CAPACITY} blocks free")
+        return out
+
+    def fingerprint(self, state: _State) -> Any:
+        a = state.alloc
+        return (
+            state.demote,
+            tuple(a._free),
+            tuple(sorted(
+                (h, b.block_id, b.parent_hash, b.refcount)
+                for h, b in a._by_hash.items()
+            )),
+            tuple(a._inactive),
+            a._partials,
+            tuple(
+                (s, tuple(state.pinned[s]), state.partial[s],
+                 state.next_idx[s], state.started[s])
+                for s in ("A", "B")
+            ),
+            # Router residency (not the raw event list — unbounded).
+            tuple(sorted(_live_hashes(state.events))),
+        )
+
+
+def _live_hashes(events: list[tuple[str, int]]) -> set[int]:
+    live: set[int] = set()
+    for kind, h in events:
+        if kind == "stored":
+            live.add(h)
+        else:
+            live.discard(h)
+    return live

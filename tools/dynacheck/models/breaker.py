@@ -1,0 +1,222 @@
+"""Breaker model: the REAL CircuitBreaker (runtime/dataplane.py) under a
+virtual clock, explored through every interleaving of failures,
+successes, dials, probe outcomes — including the cancelled probe that
+never reports back — and time advances.
+
+Invariants checked at EVERY reachable state:
+
+- **legal states** — the breaker is always exactly one of
+  closed/open/half-open with sane counters;
+- **fail-fast while open** — inside the reset window an open breaker
+  rejects every dial (no traffic leaks to a known-bad address);
+- **single probe** — at most one half-open probe is admitted per reset
+  window (a thundering herd of probes would defeat the breaker);
+- **no wedge (liveness)** — from ANY reachable state, advancing the
+  clock lets a dial through within two reset windows: a cancelled
+  probe (dial admitted, outcome never reported) must re-arm rather
+  than parking the address forever — the exact bug shape the
+  stale-probe re-arm exists for;
+- **recovery** — a probe that succeeds closes the breaker immediately.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from dynamo_tpu.runtime.dataplane import CircuitBreaker
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+THRESHOLD = 2
+RESET_S = 2.0
+HALF = RESET_S / 2
+
+
+class _State:
+    def __init__(self, breaker_cls: type = CircuitBreaker):
+        self.now = 0.0
+        self.breaker = breaker_cls(
+            threshold=THRESHOLD, reset_s=RESET_S, clock=self._clock
+        )
+        # Dials admitted while not closed whose outcome is still pending
+        # (a cancelled probe simply never reports).
+        self.probes_pending = 0
+
+    def _clock(self) -> float:
+        return self.now
+
+    def clone(self) -> "_State":
+        new = _State.__new__(_State)
+        new.now = self.now
+        src = self.breaker
+        b = type(src)(threshold=THRESHOLD, reset_s=RESET_S, clock=new._clock)
+        b.state = src.state
+        b.consecutive_failures = src.consecutive_failures
+        b.opens_total = src.opens_total
+        b._opened_at = src._opened_at
+        b._probe_at = src._probe_at
+        new.breaker = b
+        new.probes_pending = self.probes_pending
+        return new
+
+
+class BreakerModel(Model):
+    name = "breaker"
+    max_depth = C.MODEL_DEPTHS["breaker"]
+    # Injection point for the fixture suite: a deliberately broken
+    # breaker class proves the invariants can fire.
+    breaker_cls: type = CircuitBreaker
+
+    def initial_states(self):
+        yield "init", _State(self.breaker_cls)
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        acts: list[tuple[str, Callable[[Any], Any]]] = [
+            ("advance_full", lambda s: self._advance(s, RESET_S)),
+            ("advance_half", lambda s: self._advance(s, HALF)),
+            ("dial", self._dial),
+            ("fail", self._fail),
+            ("success", self._success),
+        ]
+        if state.probes_pending > 0:
+            acts.append(("probe_cancelled", self._probe_cancelled))
+            acts.append(("probe_fail", self._probe_fail))
+            acts.append(("probe_success", self._probe_success))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    @staticmethod
+    def _advance(state: _State, dt: float) -> _State:
+        st = state.clone()
+        st.now += dt
+        return st
+
+    @staticmethod
+    def _dial(state: _State) -> _State:
+        st = state.clone()
+        was_closed = st.breaker.state == CircuitBreaker.CLOSED
+        admitted = st.breaker.allow()
+        if admitted and not was_closed:
+            st.probes_pending += 1
+        return st
+
+    @staticmethod
+    def _fail(state: _State) -> _State:
+        # A non-probe failure (e.g. an established conn dying).
+        st = state.clone()
+        st.breaker.record_failure()
+        return st
+
+    @staticmethod
+    def _success(state: _State) -> _State:
+        st = state.clone()
+        st.breaker.record_success()
+        return st
+
+    @staticmethod
+    def _probe_cancelled(state: _State) -> _State:
+        # The probe task was cancelled mid-dial: no outcome is EVER
+        # reported. The stale-probe re-arm must absorb this.
+        st = state.clone()
+        st.probes_pending -= 1
+        return st
+
+    @staticmethod
+    def _probe_fail(state: _State) -> _State:
+        st = state.clone()
+        st.probes_pending -= 1
+        st.breaker.record_failure()
+        return st
+
+    @staticmethod
+    def _probe_success(state: _State) -> _State:
+        st = state.clone()
+        st.probes_pending -= 1
+        st.breaker.record_success()
+        return st
+
+    # -- invariants --------------------------------------------------------
+
+    def invariants(self, state: _State) -> list[str]:
+        out: list[str] = []
+        b = state.breaker
+        if b.state not in (
+            CircuitBreaker.CLOSED, CircuitBreaker.OPEN, CircuitBreaker.HALF_OPEN
+        ):
+            out.append(f"illegal breaker state {b.state!r}")
+        if b.consecutive_failures < 0 or b.opens_total < 0:
+            out.append(
+                f"negative counters: failures={b.consecutive_failures}, "
+                f"opens={b.opens_total}"
+            )
+        if (
+            b.state == CircuitBreaker.CLOSED
+            and b.consecutive_failures >= THRESHOLD
+        ):
+            out.append(
+                f"closed with {b.consecutive_failures} consecutive failures "
+                f"(threshold {THRESHOLD}): the breaker failed to open"
+            )
+        # Fail-fast while open: inside the reset window a dial must be
+        # rejected (checked on a clone — allow() mutates).
+        if b.state == CircuitBreaker.OPEN and state.now - b._opened_at < RESET_S:
+            probe = state.clone()
+            if probe.breaker.allow():
+                out.append(
+                    "open breaker admitted a dial inside the reset window "
+                    f"(opened_at={b._opened_at}, now={state.now})"
+                )
+        # Single probe per window: half-open with a fresh probe must hold
+        # further dials.
+        if (
+            b.state == CircuitBreaker.HALF_OPEN
+            and state.now - b._probe_at < RESET_S
+            and state.probes_pending > 0
+        ):
+            probe = state.clone()
+            if probe.breaker.allow():
+                out.append(
+                    "half-open breaker admitted a second concurrent probe "
+                    f"(probe_at={b._probe_at}, now={state.now}, "
+                    f"pending={state.probes_pending})"
+                )
+        # No wedge (liveness): advancing the clock must let a dial
+        # through within two reset windows, from ANY state — a cancelled
+        # probe must never park the address forever.
+        sim = state.clone()
+        admitted = False
+        for _ in range(2):
+            sim.now += RESET_S
+            if sim.breaker.allow():
+                admitted = True
+                break
+        if not admitted:
+            out.append(
+                f"breaker wedged: state={b.state}, no dial admitted within "
+                f"2 reset windows of clock advance (probes_pending="
+                f"{state.probes_pending})"
+            )
+        else:
+            # Recovery: the admitted dial's success must close it.
+            sim.breaker.record_success()
+            if sim.breaker.state != CircuitBreaker.CLOSED:
+                out.append(
+                    "probe success did not close the breaker "
+                    f"(state={sim.breaker.state})"
+                )
+        return out
+
+    def fingerprint(self, state: _State) -> Any:
+        b = state.breaker
+        # Time is canonicalized as bounded deltas (all advances are
+        # multiples of reset_s/2, so these are discrete); beyond two
+        # windows the behavior is time-invariant.
+        cap = RESET_S * 2
+        d_open = min(cap, state.now - b._opened_at)
+        d_probe = min(cap, state.now - b._probe_at)
+        return (
+            b.state,
+            min(b.consecutive_failures, THRESHOLD + 2),
+            d_open, d_probe,
+            min(state.probes_pending, 3),
+        )

@@ -1,0 +1,308 @@
+"""Cursor model: the async-exec + megastep plan/dispatch/commit protocol
+against a synchronous reference trace.
+
+The real machinery (engine/core.py) plans step N+1 against optimistic
+cursor overlays while step N is in flight, fuses k decode iterations into
+one dispatch, and rolls EVERY late outcome — device-watched EOS inside a
+megastep, host-only stops the device cannot see, rejected speculative
+drafts — back through the ``num_computed_tokens`` cursor. This model
+reproduces exactly that algebra with a deterministic token oracle, and
+the explorer drives it through every interleaving of:
+
+- ``step_sync``      plan + commit in place (the async_exec=off loop),
+- ``step_async_k*``  plan k=1/k=2 against the overlay, then commit the
+                     previous in-flight step (the one-step-ahead loop),
+- ``step_verify``    a speculative verify step whose advance is
+                     data-dependent (non-deterministic: the next plan is
+                     barred until it commits, like the engine's barrier),
+- ``drain``          commit the in-flight step with no new plan,
+- ``cancel``         client cancel mid-flight (zombie-lane discard).
+
+Initial-state variants place a device-watched EOS and a host-only stop at
+different stream positions, plus a draft-acceptance pattern for verify.
+
+Invariant: the emitted stream is ALWAYS a prefix of the synchronous
+reference stream, the cursor always equals prompt + written tokens, and
+every quiescent finished state equals the reference exactly — any
+dispatch/commit/late-stop/rollback interleaving must leave
+``num_computed_tokens`` equal to the synchronous trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Callable
+
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+PROMPT_LEN = 2
+MAX_TOKENS = 6
+EOS = 9
+HOST_STOP = 5
+
+
+@dataclass(frozen=True)
+class _World:
+    """Token oracle parameters: where the device-watched EOS and the
+    host-only stop land in the generated stream (1-based generation
+    index), and which drafted positions a verify step gets right."""
+    eos_at: int | None
+    host_at: int | None
+    draft_hits: tuple[bool, ...] = (True, False)
+
+    def token(self, n: int) -> int:
+        # n = generation index of the token being sampled (1-based past
+        # the prefill token). Values are distinct from EOS/HOST_STOP
+        # unless the world places one there.
+        if self.eos_at is not None and n == self.eos_at:
+            return EOS
+        if self.host_at is not None and n == self.host_at:
+            return HOST_STOP
+        return 10 + (n % 4)
+
+
+@dataclass(frozen=True)
+class _Plan:
+    """One in-flight planned step (the model's _PlannedStep)."""
+    kind: str                 # "chain" | "verify"
+    n_steps: int              # device iterations dispatched
+    outputs: tuple[int, ...]  # device-produced tokens (with stop padding)
+    adv_proc: int             # optimistic processed overlay
+    adv_gen: int              # optimistic generated overlay
+    deterministic: bool = True
+    draft: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class _State:
+    world: _World
+    processed: int = PROMPT_LEN    # K/V written (prompt; pending not yet)
+    generated: int = 1             # prefill sampled token counts as 1
+    pending: int | None = None     # set in __post_init__ via factory
+    emitted: tuple[int, ...] = ()
+    finished: str | None = None    # "eos" | "host" | "length" | "cancel"
+    inflight: _Plan | None = None
+    verify_round: int = 0          # which draft_hits entry the next verify uses
+
+    # Effective (overlay) cursors — what plan-time reads see.
+    @property
+    def eff_processed(self) -> int:
+        return self.processed + (self.inflight.adv_proc if self.inflight else 0)
+
+    @property
+    def eff_generated(self) -> int:
+        return self.generated + (self.inflight.adv_gen if self.inflight else 0)
+
+
+def _initial(world: _World) -> _State:
+    # The prefill sampled token(0): generation index 0.
+    return _State(world=world, pending=world.token(0))
+
+
+def _device_outputs(world: _World, gen0: int, n_steps: int) -> tuple[int, ...]:
+    """What the device megastep produces: per inner iteration i it samples
+    token(gen0 + i); once a watched EOS is sampled the lane goes dead and
+    pads the remaining outputs with its last live token."""
+    out: list[int] = []
+    dead_pad: int | None = None
+    for i in range(n_steps):
+        if dead_pad is not None:
+            out.append(dead_pad)
+            continue
+        t = world.token(gen0 + i)
+        out.append(t)
+        if t == EOS:
+            dead_pad = t
+    return tuple(out)
+
+
+def _scan_stop(state: _State, toks: tuple[int, ...]) -> tuple[int, str | None]:
+    """Host stop scan (the authority): accept tokens until EOS, the
+    host-only stop, or the generation budget; k = accepted count."""
+    for j, t in enumerate(toks):
+        gen_after = state.generated + j + 1
+        if t == EOS:
+            return j + 1, "eos"
+        if t == HOST_STOP:
+            return j + 1, "host"
+        if gen_after >= MAX_TOKENS:
+            return j + 1, "length"
+    return len(toks), None
+
+
+def _commit(state: _State) -> _State:
+    """Land the in-flight step: stop scan, cursor advance (k of the
+    optimistic n may land — the rollback IS the cursor), emission."""
+    plan = state.inflight
+    if plan is None:
+        return state
+    if state.finished is not None:
+        # Zombie lane: the optimistic chain is discarded wholesale.
+        return replace(state, inflight=None)
+    k, finish = _scan_stop(state, plan.outputs)
+    accepted = plan.outputs[:k]
+    new = replace(
+        state,
+        inflight=None,
+        processed=state.processed + k,
+        generated=state.generated + k,
+        emitted=state.emitted + accepted,
+        pending=accepted[-1] if finish is None else None,
+        finished=finish,
+    )
+    return new
+
+
+class CursorModel(Model):
+    name = "cursor"
+    max_depth = C.MODEL_DEPTHS["cursor"]
+
+    def initial_states(self):
+        worlds = [
+            ("plain", _World(eos_at=None, host_at=None)),
+            ("eos-mid-megastep", _World(eos_at=2, host_at=None)),
+            ("host-stop-early", _World(eos_at=None, host_at=2)),
+            ("host-before-eos", _World(eos_at=4, host_at=3)),
+            ("eos-at-boundary", _World(eos_at=3, host_at=None,
+                                       draft_hits=(False, True))),
+        ]
+        for label, w in worlds:
+            yield f"init:{label}", _initial(w)
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        acts: list[tuple[str, Callable[[Any], Any]]] = []
+        blocked = state.inflight is not None and not state.inflight.deterministic
+        can_plan = (
+            state.finished is None
+            and not blocked
+            and not self._finishes_inflight(state)
+        )
+        if can_plan:
+            if state.inflight is None:
+                acts.append(("step_sync", self._step_sync))
+            acts.append(("step_async_k1", lambda s: self._step_async(s, 1)))
+            acts.append(("step_async_k2", lambda s: self._step_async(s, 2)))
+            if state.verify_round < len(state.world.draft_hits):
+                acts.append(("step_verify", self._step_verify))
+        if state.inflight is not None:
+            acts.append(("drain", lambda s: _commit(s)))
+            acts.append(("cancel", self._cancel))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    # The engine's _decode_candidates excludes lanes whose in-flight step
+    # is guaranteed to finish them (generation budget / context edge) —
+    # mirrored here so the model only plans what the engine would.
+    @staticmethod
+    def _finishes_inflight(state: _State) -> bool:
+        return state.eff_generated >= MAX_TOKENS
+
+    @staticmethod
+    def _plan(state: _State, k: int) -> _Plan:
+        outputs = _device_outputs(state.world, state.eff_generated, k)
+        return _Plan(
+            kind="chain", n_steps=k, outputs=outputs,
+            adv_proc=k, adv_gen=k,
+        )
+
+    def _step_sync(self, state: _State) -> _State:
+        return _commit(replace(state, inflight=self._plan(state, 1)))
+
+    def _step_async(self, state: _State, k: int) -> _State:
+        """The one-step-ahead order (_step_async): plan N+1 against the
+        overlay FIRST, then commit step N."""
+        new_plan = self._plan(state, k)
+        committed = _commit(state)
+        return replace(committed, inflight=new_plan)
+
+    def _step_verify(self, state: _State) -> _State:
+        """Speculative verify step: pending + 1 drafted token as one row.
+        The draft is right or wrong per the world's acceptance pattern;
+        a wrong draft's K/V write sits past the cursor and is rolled
+        back by it. Data-dependent advance -> non-deterministic plan:
+        the explorer cannot plan over it (like the engine's barrier)."""
+        hit = state.world.draft_hits[state.verify_round]
+        gen0 = state.eff_generated
+        target0 = state.world.token(gen0)      # target's choice at slot 0
+        target1 = state.world.token(gen0 + 1)  # choice after an accepted draft
+        draft = (target0,) if hit else (target0 + 100,)
+        # The device verifies pending+draft and returns the target's own
+        # counter-keyed choices for each position.
+        outputs = (target0, target1) if hit else (target0,)
+        new_plan = _Plan(
+            kind="verify", n_steps=1 + len(draft), outputs=outputs,
+            adv_proc=1, adv_gen=1, deterministic=False, draft=draft,
+        )
+        committed = _commit(state)
+        return replace(
+            committed, inflight=new_plan,
+            verify_round=state.verify_round + 1,
+        )
+
+    @staticmethod
+    def _cancel(state: _State) -> _State:
+        if state.finished is not None:
+            return replace(state, inflight=None)
+        return replace(state, finished="cancel", inflight=None,
+                       pending=None)
+
+    # -- invariants --------------------------------------------------------
+
+    def invariants(self, state: _State) -> list[str]:
+        out: list[str] = []
+        ref_emitted, ref_processed, ref_finish = _reference(state.world)
+        n = len(state.emitted)
+        if state.emitted != ref_emitted[:n]:
+            out.append(
+                f"stream diverged from the synchronous trace: emitted "
+                f"{state.emitted}, reference {ref_emitted[:n]}"
+            )
+        # num_computed_tokens == prompt + accepted writes, always.
+        if state.processed != PROMPT_LEN + n:
+            out.append(
+                f"cursor drift: processed={state.processed}, but prompt "
+                f"{PROMPT_LEN} + emitted {n} = {PROMPT_LEN + n}"
+            )
+        if state.generated != 1 + n:
+            out.append(
+                f"generated drift: {state.generated} != 1 + emitted {n}"
+            )
+        if state.processed > PROMPT_LEN + MAX_TOKENS:
+            out.append(
+                f"cursor past the block table: processed={state.processed}"
+            )
+        if state.finished is not None and state.finished != "cancel":
+            if state.inflight is None and (
+                state.emitted != ref_emitted
+                or state.processed != ref_processed
+                or state.finished != ref_finish
+            ):
+                out.append(
+                    "finished state diverges from the synchronous trace: "
+                    f"emitted={state.emitted} vs {ref_emitted}, "
+                    f"processed={state.processed} vs {ref_processed}, "
+                    f"finish={state.finished} vs {ref_finish}"
+                )
+        return out
+
+    def fingerprint(self, state: _State) -> Any:
+        return (
+            state.world,
+            state.processed, state.generated, state.pending,
+            state.emitted, state.finished, state.inflight,
+            state.verify_round,
+        )
+
+
+def _reference(world: _World) -> tuple[tuple[int, ...], int, str]:
+    """The synchronous k=1, no-speculation trace: the bit-identical
+    baseline every interleaving must reproduce."""
+    state = _initial(world)
+    while state.finished is None:
+        state = _commit(replace(state, inflight=_Plan(
+            kind="chain", n_steps=1,
+            outputs=_device_outputs(world, state.generated, 1),
+            adv_proc=1, adv_gen=1,
+        )))
+    return state.emitted, state.processed, state.finished

@@ -1,0 +1,57 @@
+"""Deterministic report assembly: same tree -> byte-identical report.
+
+No timestamps, no runtimes, no absolute paths in the default report —
+the determinism test in tests/test_dynacheck.py diffs two full runs
+byte for byte, and CI diffs against cache hits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tools.dynacheck.callgraph import Project
+from tools.dynacheck.explore import ModelResult
+from tools.dynacheck.interproc import Finding
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    models: list[ModelResult] = field(default_factory=list)
+    functions: int = 0
+    resolved_edges: int = 0
+    pragmas: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and all(m.ok for m in self.models)
+
+    def render(self, show_pragmas: bool = False) -> str:
+        lines: list[str] = []
+        for f in self.findings:
+            lines.append(str(f))
+        for m in self.models:
+            lines.append(m.summary())
+            for v in m.violations:
+                lines.append(f"  {v}")
+        if show_pragmas:
+            for p in sorted(self.pragmas, key=lambda p: (p.path, p.line)):
+                lines.append(f"pragma: {p.path}:{p.line}: allow-{p.rule}({p.reason})")
+        n = len(self.findings)
+        viol = sum(len(m.violations) for m in self.models)
+        lines.append(
+            f"dynacheck: {self.functions} functions, "
+            f"{self.resolved_edges} resolved call edges; "
+            f"{n} finding{'s' if n != 1 else ''}, "
+            f"{viol} model violation{'s' if viol != 1 else ''}, "
+            f"{len(self.pragmas)} pragma{'s' if len(self.pragmas) != 1 else ''}"
+        )
+        return "\n".join(lines) + "\n"
+
+
+def stats_for(project: Project) -> tuple[int, int]:
+    functions = len(project.functions)
+    edges = sum(
+        1 for f in project.functions.values() for cs in f.calls if cs.targets
+    )
+    return functions, edges

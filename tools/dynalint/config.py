@@ -200,4 +200,5 @@ EXCLUDE_PARTS = {
     ".git",
     # Lint fixtures intentionally contain violations.
     "tests/fixtures/dynalint",
+    "tests/fixtures/dynacheck",
 }

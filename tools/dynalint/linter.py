@@ -39,6 +39,10 @@ class Pragma:
     kind: str          # "allow" | "holds-lock" | "sync-ok" | "unbounded-ok"
     arg: str           # rule name for allow, lock name for holds-lock
     reason: str        # required for allow, empty otherwise
+    # True when the comment has no code before it on its line: a
+    # standalone pragma anchors to the statement BELOW it as well as any
+    # statement spanning its line; a trailing pragma never anchors down.
+    standalone: bool = True
 
     def __str__(self) -> str:
         detail = f"({self.reason})" if self.reason else ""
@@ -112,6 +116,64 @@ def _uses_jax(body: list[ast.stmt]) -> ast.AST | None:
     return None
 
 
+def _stmt_span(node: ast.stmt) -> tuple[int, int]:
+    """The line span a statement contributes to pragma anchoring.
+
+    Simple statements span all their physical lines (the multi-line
+    wrapped-call case). Compound statements (def/if/for/with/try/...)
+    would otherwise span their whole BODY — a pragma deep inside a
+    function must not blanket the function — so they contribute only
+    their header region: ``lineno`` up to the line before the first
+    body statement (a multi-line ``with a,\\n b:`` header, including
+    its closing ``):`` line, is all header)."""
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    body = getattr(node, "body", None)
+    if body and isinstance(body, list) and isinstance(body[0], ast.stmt):
+        end = max(node.lineno, body[0].lineno - 1)
+    return (node.lineno, end)
+
+
+def statement_spans(tree: ast.Module) -> list[tuple[int, int]]:
+    """Pragma-anchoring spans for every statement in ``tree``. Shared
+    with dynacheck (tools/dynacheck/callgraph.py) so the two tiers can
+    never disagree about which lines a pragma covers.
+
+    ``except`` clauses are spanned too (header only, like any compound
+    statement): they are ``ast.excepthandler``, not ``ast.stmt``, but a
+    pragma directly above an ``except Exception:`` line is an
+    established suppression form."""
+    return [
+        _stmt_span(node) for node in ast.walk(tree)
+        if isinstance(node, (ast.stmt, ast.excepthandler))
+    ]
+
+
+def covered_lines(
+    spans: list[tuple[int, int]], pragma_line: int, standalone: bool
+) -> set[int]:
+    """Lines a pragma at ``pragma_line`` suppresses: its own line plus
+    every line of any span containing it, plus — ONLY for a STANDALONE
+    pragma (a comment with no code before it on its line) — the span
+    starting directly under it (the pragma-above-the-statement form).
+
+    A TRAILING pragma (code before the comment) never anchors downward:
+    a pragma on the last line of a multi-line statement, or on the
+    closing ``):`` line of a multi-line header, covers that statement /
+    header only and never bleeds onto the first body statement or the
+    next sibling. Span membership alone cannot make this distinction —
+    a closing-paren line belongs to no AST node — so the caller passes
+    the tokenizer's verdict."""
+    covered = {pragma_line}
+    for lo, hi in spans:
+        if lo <= pragma_line <= hi:
+            covered.update(range(lo, hi + 1))
+    if standalone:
+        for lo, hi in spans:
+            if lo == pragma_line + 1:
+                covered.update(range(lo, hi + 1))
+    return covered
+
+
 # ---------------------------------------------------------------------------
 # Per-file pass
 # ---------------------------------------------------------------------------
@@ -122,6 +184,13 @@ class _FileLinter(ast.NodeVisitor):
         self.path = path
         self.tree = tree
         self.findings: list[Finding] = []
+        # Pragmas anchor to the FULL line span of the enclosing statement:
+        # a `# dynalint: ...` on the opening line of a wrapped call must
+        # suppress the finding even when the flagged node reports a later
+        # lineno (and vice versa — a pragma on the argument line covers
+        # the statement's opening line). Line-based matching alone missed
+        # every multi-line statement.
+        self._stmt_spans: list[tuple[int, int]] = statement_spans(tree)
         # Suppression lookup: (line, rule) from allow pragmas.
         self._allow: dict[int, set[str]] = {}
         # holds-lock pragma lines -> lock names.
@@ -131,12 +200,14 @@ class _FileLinter(ast.NodeVisitor):
         # unbounded-ok pragma lines (unbounded-await suppressions).
         self._unbounded_ok: set[int] = set()
         for p in pragmas:
+            covered = covered_lines(self._stmt_spans, p.line, p.standalone)
             if p.kind == "allow":
-                self._allow.setdefault(p.line, set()).add(p.arg)
+                for ln in covered:
+                    self._allow.setdefault(ln, set()).add(p.arg)
             elif p.kind == "sync-ok":
-                self._sync_ok.add(p.line)
+                self._sync_ok.update(covered)
             elif p.kind == "unbounded-ok":
-                self._unbounded_ok.add(p.line)
+                self._unbounded_ok.update(covered)
             else:
                 self._holds.setdefault(p.line, set()).add(p.arg)
 
@@ -170,9 +241,12 @@ class _FileLinter(ast.NodeVisitor):
 
     def report(self, node: ast.AST, rule: str, message: str) -> None:
         line = getattr(node, "lineno", 0)
-        for probe in (line, line - 1):
-            if rule in self._allow.get(probe, ()):  # suppressed by pragma
-                return
+        # _covered_lines already expanded each pragma over its statement
+        # span AND the pragma-above-the-statement line; probing line-1
+        # here would bleed a pragma'd statement's coverage onto its
+        # NEXT sibling.
+        if rule in self._allow.get(line, ()):  # suppressed by pragma
+            return
         self.findings.append(
             Finding(self.path, line, getattr(node, "col_offset", 0), rule, message)
         )
@@ -354,7 +428,7 @@ class _FileLinter(ast.NodeVisitor):
         if what is None:
             return
         line = node.lineno
-        if line in self._sync_ok or line - 1 in self._sync_ok:
+        if line in self._sync_ok:  # span-expanded; see _covered_lines
             return
         self.report(
             node, C.RULE_HOST_SYNC,
@@ -397,7 +471,7 @@ class _FileLinter(ast.NodeVisitor):
         if self._timeout_depth > 0:
             return
         line = node.lineno
-        if line in self._unbounded_ok or line - 1 in self._unbounded_ok:
+        if line in self._unbounded_ok:  # span-expanded; see _covered_lines
             return
         self.report(
             node, C.RULE_UNBOUNDED_AWAIT,
@@ -729,15 +803,32 @@ class _FileLinter(ast.NodeVisitor):
 # ---------------------------------------------------------------------------
 
 
+def comment_tokens(source: str) -> list[tuple[int, str, bool]]:
+    """(line, text, standalone) for every comment — ``standalone`` means
+    nothing but whitespace precedes the comment on its line. Shared with
+    dynacheck so both tiers classify pragmas identically."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+    lines = source.splitlines()
+    out: list[tuple[int, str, bool]] = []
+    try:
+        for t in tokens:
+            if t.type != tokenize.COMMENT:
+                continue
+            row, col = t.start
+            before = lines[row - 1][:col] if row - 1 < len(lines) else ""
+            out.append((row, t.string, not before.strip()))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
 def extract_pragmas(path: str, source: str) -> tuple[list[Pragma], list[Finding]]:
     pragmas: list[Pragma] = []
     errors: list[Finding] = []
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
-        comments = [(t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT]
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        return [], []
-    for line, text in comments:
+    for line, text, standalone in comment_tokens(source):
         if not _ANY_PRAGMA_RE.search(text):
             continue
         matched = False
@@ -756,16 +847,16 @@ def extract_pragmas(path: str, source: str) -> tuple[list[Pragma], list[Finding]
                     f"allow-{rule} pragma requires a non-empty reason",
                 ))
             else:
-                pragmas.append(Pragma(path, line, "allow", rule, reason))
+                pragmas.append(Pragma(path, line, "allow", rule, reason, standalone))
         for m in _HOLDS_RE.finditer(text):
             matched = True
-            pragmas.append(Pragma(path, line, "holds-lock", m.group(1), ""))
+            pragmas.append(Pragma(path, line, "holds-lock", m.group(1), "", standalone))
         if _SYNC_OK_RE.search(text):
             matched = True
-            pragmas.append(Pragma(path, line, "sync-ok", "", ""))
+            pragmas.append(Pragma(path, line, "sync-ok", "", "", standalone))
         if _UNBOUNDED_OK_RE.search(text):
             matched = True
-            pragmas.append(Pragma(path, line, "unbounded-ok", "", ""))
+            pragmas.append(Pragma(path, line, "unbounded-ok", "", "", standalone))
         if not matched:
             errors.append(Finding(
                 path, line, 0, "malformed-pragma",
