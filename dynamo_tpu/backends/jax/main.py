@@ -539,6 +539,7 @@ async def run_jax_worker(
     # /metrics (queue depth, budget utilization, acceptance rate, hit
     # rate, ...) — evaluated at scrape time against the live core.
     from dynamo_tpu.runtime.status_server import (
+        bind_fair_queue_gauges,
         bind_kv_cache_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
@@ -547,6 +548,7 @@ async def run_jax_worker(
     bind_scheduler_gauges(runtime.status, core.scheduler_stats)
     bind_spec_gauges(runtime.status, core.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, core.kv_cache_stats)
+    bind_fair_queue_gauges(runtime.status, core.fair_queue_stats)
 
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
@@ -1190,6 +1192,23 @@ def main() -> None:
              "(8). Token stream is bit-identical for any k; mixed chunked "
              "steps and spec-decode verify rows always run single-step",
     )
+    ap.add_argument(
+        "--fair-scheduling", default=None, choices=["on", "off"],
+        help="per-tenant deficit-round-robin admission over prompt token "
+             "cost (x-tenant-id keys the queues; off = strict FIFO — "
+             "single-tenant streams are bit-identical either way)",
+    )
+    ap.add_argument(
+        "--fair-quantum", type=int, default=None,
+        help="tokens a tenant earns per DRR rotation visit (0/unset = "
+             "the per-step token budget)",
+    )
+    ap.add_argument(
+        "--max-waiting", type=int, default=None,
+        help="bounded admission queue: at this many waiting requests new "
+             "submits get a typed retryable shed error that migration "
+             "replays on another instance. 0/unset = unbounded",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="int8 weight-only quantization")
@@ -1270,6 +1289,13 @@ def main() -> None:
             "async_exec": (
                 None if args.async_exec is None else args.async_exec == "on"
             ),
+            "fair_scheduling": (
+                None
+                if args.fair_scheduling is None
+                else args.fair_scheduling == "on"
+            ),
+            "fair_quantum": args.fair_quantum,
+            "max_waiting": args.max_waiting,
         }.items()
         if v is not None
     }

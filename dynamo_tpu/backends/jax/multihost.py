@@ -82,6 +82,12 @@ class LeaderCore(EngineCore):
         self._mh_stage: collections.deque = collections.deque()
         self._mh_iter = 0
         self._mh_known: dict[str, Any] = {}  # rid -> seq (cancel tracking)
+        # Wall-clock overload state would desynchronize leader and
+        # followers (deadline expiry fires at different instants; the
+        # bounded-queue length differs between staged and direct intake)
+        # — forced off, like held_block_ttl_s (module docstring).
+        self.enforce_deadlines = False
+        self._max_waiting = 0
 
     def add_request(self, pre: PreprocessedRequest):
         with self._mh_mutex:
@@ -160,6 +166,10 @@ async def run_follower(
     first step."""
     from dynamo_tpu.runtime.barrier import WorkerBarrier
 
+    # Mirror the leader's overload gating (LeaderCore.__init__): the
+    # follower must never expire or refuse what the leader admitted.
+    core.enforce_deadlines = False
+    core._max_waiting = 0
     sub = await runtime.store.subscribe(steps_subject(namespace, component))
     # Lease-bound check-in: a dead follower's key vanishes with its
     # lease, so a fleet restart cannot satisfy the new leader's barrier

@@ -63,6 +63,7 @@ async def run_mocker(
     # Same scheduler + speculation gauges as the real worker (mock fleets
     # exercise the policies CPU-only; dashboards see identical series).
     from dynamo_tpu.runtime.status_server import (
+        bind_fair_queue_gauges,
         bind_kv_cache_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
@@ -71,6 +72,7 @@ async def run_mocker(
     bind_scheduler_gauges(runtime.status, engine.scheduler_stats)
     bind_spec_gauges(runtime.status, engine.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, engine.kv_cache_stats)
+    bind_fair_queue_gauges(runtime.status, engine.fair_queue_stats)
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
@@ -147,6 +149,18 @@ def main() -> None:
                          "bf16 KV block per decode lane-iteration "
                          "(scaled by the kv dtype's byte ratio; 0 = "
                          "legacy timing, KV traffic unpriced)")
+    ap.add_argument("--fair-scheduling", default="off", choices=["on", "off"],
+                    help="per-tenant deficit-round-robin admission over "
+                         "prompt token cost (off = strict FIFO; single-"
+                         "tenant streams are bit-identical either way)")
+    ap.add_argument("--fair-quantum", type=int, default=0,
+                    help="tokens a tenant earns per DRR rotation visit "
+                         "(0 = the per-step token budget)")
+    ap.add_argument("--max-waiting", type=int, default=0,
+                    help="bounded admission queue: at this many waiting "
+                         "requests new submits get a typed retryable "
+                         "shed error (migration retries elsewhere). "
+                         "0 = unbounded")
     ap.add_argument("--chaos-plan", default="",
                     help="fault-injection plan: inline JSON or @file "
                          "(same format as $DYN_CHAOS_PLAN; see "
@@ -179,6 +193,9 @@ def main() -> None:
         megastep_k=args.megastep_k,
         kv_dtype=args.kv_dtype,
         kv_read_us_per_block=args.kv_read_us_per_block,
+        fair_scheduling=args.fair_scheduling == "on",
+        fair_quantum=args.fair_quantum,
+        max_waiting=args.max_waiting,
     )
 
     @dynamo_worker()

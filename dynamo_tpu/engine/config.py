@@ -202,6 +202,25 @@ class EngineConfig:
     # timeout would otherwise pin them forever). 0 = never expire.
     held_block_ttl_s: float = 180.0
 
+    # -- overload robustness (ISSUE 10) ------------------------------------
+    # Per-tenant weighted fair queueing in the admission queue: requests
+    # are admitted by deficit-round-robin over prompt-token cost across
+    # tenants (engine/fair_queue.py) instead of strict FIFO, so one
+    # flooding tenant cannot starve the rest. Off keeps exact FIFO; for
+    # a single tenant DRR degenerates to FIFO, so the token stream is
+    # bit-identical on vs off (pinned by tests/test_overload.py).
+    fair_scheduling: bool = False
+    # Tokens a tenant earns per DRR rotation visit. 0 = auto (the
+    # resolved per-step token budget — one quantum admits roughly one
+    # step's worth of prefill per tenant per round).
+    fair_quantum: int = 0
+    # Bounded admission queue (backpressure): add_request refuses new
+    # work with a typed, RETRYABLE EngineOverloadedError once this many
+    # requests are queued (inbox + waiting) — peers route the request to
+    # another instance via the migration machinery instead of piling
+    # unboundedly here. 0 = unbounded (legacy).
+    max_waiting: int = 0
+
     # -- speculative decoding (dynamo_tpu/spec) -----------------------------
     # "off": every decode row is q_len=1. "ngram": decode rows draft up to
     #   spec_k tokens via prompt-lookup and verify pending+draft as ONE
@@ -239,6 +258,11 @@ class EngineConfig:
     def token_budget(self) -> int:
         """Resolved per-step batched-token budget (chunked scheduling)."""
         return self.max_num_batched_tokens or self.prefill_buckets[-1]
+
+    @property
+    def fair_quantum_resolved(self) -> int:
+        """Resolved DRR quantum (tokens per tenant per rotation visit)."""
+        return self.fair_quantum or self.token_budget
 
     @property
     def chunk_size(self) -> int:

@@ -41,6 +41,8 @@ import numpy as np
 from dynamo_tpu import tracing
 from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksError
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.fair_queue import FairQueue
+from dynamo_tpu.runtime.engine import EngineOverloadedError
 from dynamo_tpu.engine.model import (
     decode_tokens,
     embed_forward,
@@ -116,6 +118,17 @@ class Sequence:
     # Every emitted token, in order (the drafter's lookup history beyond
     # the prompt; cleared on preemption — the rebuilt prompt absorbs it).
     out_tokens: list[int] = field(default_factory=list)
+    # -- overload robustness (ISSUE 10) --
+    # Fairness identity (validated x-tenant-id; "" = default tenant):
+    # keys the admission queue's per-tenant DRR.
+    tenant_id: str = ""
+    # Ordering hint WITHIN the tenant's queue (higher admits first).
+    priority: int = 0
+    # Absolute wall-clock deadline (time.time() domain): a sequence
+    # still QUEUED past it is expired with a typed retryable error
+    # frame; admitted sequences always run to completion (expiring a
+    # partially-streamed request would break the stream).
+    deadline_epoch: float | None = None
 
     @property
     def prompt_len(self) -> int:
@@ -636,6 +649,16 @@ class EngineCore:
                 "which the device feedback gather bypasses); those engines "
                 "keep the synchronous loop"
             )
+        if engine_cfg.max_waiting < 0:
+            raise ValueError(
+                f"max_waiting must be >= 0 (0 = unbounded), got "
+                f"{engine_cfg.max_waiting}"
+            )
+        if engine_cfg.fair_quantum < 0:
+            raise ValueError(
+                f"fair_quantum must be >= 0 (0 = token budget), got "
+                f"{engine_cfg.fair_quantum}"
+            )
         # Verify-row sample width: STATIC per engine so the compiled
         # program set stays O(buckets x widths x variants), not O(draft
         # lengths). Rows with shorter drafts pad the sample gather with
@@ -891,8 +914,28 @@ class EngineCore:
         self._copy_pages_from = jax.jit(_copy_pages_fn, donate_argnums=(1,))
 
         self._inbox: deque[Sequence] = deque()   # thread-safe enqueue
-        self.waiting: deque[Sequence] = deque()
+        # Admission queue: per-tenant deficit-round-robin over prompt
+        # token cost (ISSUE 10). With fair_scheduling off — the default —
+        # every request maps to one tenant and DRR degenerates to the
+        # exact FIFO this deque-shaped field has always been. Touched
+        # only under _step_lock (intake goes through _inbox).
+        self.waiting: FairQueue = FairQueue(
+            quantum=engine_cfg.fair_quantum_resolved,
+            fair=engine_cfg.fair_scheduling,
+            cost_fn=lambda s: s.prompt_len,
+        )
         self.running: list[Sequence] = []
+        # Typed rejections produced by the queue sweeps (deadline expiry)
+        # during planning, delivered with the step's outputs.
+        self._shed_outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+        # Deadline sweeps are wall-clock; multihost engines disable them
+        # (leader and followers would expire divergently — same class of
+        # restriction as embeddings there). Likewise the bounded-queue
+        # ceiling: leader (staged intake) and follower (direct inbox)
+        # queue-length views differ at add time, so the rejection would
+        # not replay identically — multihost forces it off.
+        self.enforce_deadlines = True
+        self._max_waiting = engine_cfg.max_waiting
         self.iterations = 0
         # Step-level spans (engine_prefill_step / engine_decode_step with
         # token counts). record() on a disabled tracer is a no-op, and the
@@ -932,6 +975,10 @@ class EngineCore:
             "last_step_batched_tokens": 0,
             "last_step_budget_utilization": 0.0,
             "chunked_prefills_in_flight": 0,
+            # Overload counters (ISSUE 10): bounded-queue refusals at
+            # add_request and queued requests expired past deadline.
+            "shed_total": 0,
+            "deadline_expired_total": 0,
         }
         # -- async pipelined execution (plan/dispatch/commit) ---------------
         # At most ONE step is in flight; its _PlannedStep carries the
@@ -1022,6 +1069,21 @@ class EngineCore:
     # -- request intake (any thread) --------------------------------------
 
     def add_request(self, pre: PreprocessedRequest) -> Sequence:
+        limit = self._max_waiting
+        if limit and (len(self._inbox) + len(self.waiting)) >= limit:
+            # Bounded admission queue (backpressure): refuse with the
+            # typed RETRYABLE shed error — on the wire this becomes the
+            # same retry-elsewhere shape as the PR 6 drain refusal, so
+            # migration moves the request to a less-loaded instance
+            # instead of letting this queue grow without bound. The
+            # length read is approximate under concurrent intake; the
+            # ceiling is a pressure valve, not an exact capacity.
+            with self._lock:
+                self.sched_stats["shed_total"] += 1
+            raise EngineOverloadedError(
+                f"scheduler queue full ({limit} requests waiting); "
+                f"retry on another instance"
+            )
         with self._lock:
             self._req_counter += 1
             n = self._req_counter
@@ -1095,6 +1157,15 @@ class EngineCore:
                 )
             seq.mm_embeds = embeds
             seq.mm_positions = positions
+        # Overload metadata (ISSUE 10): fairness identity + deadline.
+        # A deadline_ms budget with no frontend-stamped epoch starts the
+        # clock here (direct-engine callers and tests).
+        seq.tenant_id = pre.tenant_id or ""
+        seq.priority = pre.priority or 0
+        if pre.deadline_epoch is not None:
+            seq.deadline_epoch = pre.deadline_epoch
+        elif pre.deadline_ms is not None and pre.deadline_ms > 0:
+            seq.deadline_epoch = time.time() + pre.deadline_ms / 1000.0
         seq.t_queued = time.time()
         self._enqueue(seq)
         return seq
@@ -1214,16 +1285,65 @@ class EngineCore:
                 stat=True,
             )
 
+    def _sweep_queue(self) -> None:
+        """Queue hygiene ahead of admission: drop cancelled requests from
+        ANY queue position (a disconnected client must not wait for its
+        request to reach the head of the line — satellite: disconnect-
+        while-queued cleanup; queued sequences hold no blocks or pins,
+        so removal IS the cleanup), and expire queued requests past
+        their deadline with a typed retryable error frame (pattern:
+        _sweep_expired_holds). Only never-scheduled sequences expire —
+        an admitted (or preempted-mid-stream) sequence runs to
+        completion, because expiring it would break a stream that
+        already emitted tokens."""
+        now = time.time()
+        deadlines = self.enforce_deadlines
+
+        def dead(s: Sequence) -> bool:
+            # ONE combined pass per step (cancel + expiry): the sweep is
+            # hot-loop work inside the step lock, and the common case
+            # finds nothing.
+            return s.cancelled or (
+                deadlines
+                and s.deadline_epoch is not None
+                and now > s.deadline_epoch
+                and not s.emitted_first
+            )
+
+        expired = [
+            s for s in self.waiting.sweep(dead) if not s.cancelled
+        ]
+        for seq in expired:
+            self.sched_stats["deadline_expired_total"] += 1
+            waited_ms = (now - seq.t_queued) * 1e3 if seq.t_queued else 0.0
+            log.info(
+                "expiring %s: deadline passed after %.0f ms in queue",
+                seq.request_id, waited_ms,
+            )
+            out = LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.ERROR.value,
+                prompt_tokens=seq.prompt_len, completion_tokens=0,
+            )
+            out.meta = {
+                "shed": "deadline",
+                "detail": (
+                    f"request {seq.request_id} expired after "
+                    f"{waited_ms:.0f} ms in the scheduler queue"
+                ),
+            }
+            self._shed_outputs.append((seq, out))
+
     def _admit(self) -> None:
         while self._inbox:
             self.waiting.append(self._inbox.popleft())
+        self._sweep_queue()
         bs = self.engine.block_size
         watermark = 0.01 * self.allocator.capacity
         while self.waiting and len(self.running) < self.engine.max_num_seqs:
-            seq = self.waiting[0]
-            if seq.cancelled:
-                self.waiting.popleft()
-                continue
+            # Deficit-round-robin head: FIFO head with fairness off or a
+            # single tenant; pop() charges the admitted prompt's token
+            # cost to its tenant once admission actually succeeds.
+            seq = self.waiting.head()
             P = seq.prompt_len
             seq.prompt_hashes = compute_seq_hashes(seq.prompt, bs)
             # Cap the reusable prefix so at least one token is prefilled
@@ -1248,7 +1368,7 @@ class EngineCore:
             except OutOfBlocksError:
                 self.allocator.release(seq.prompt_hashes[:ncached])
                 return
-            self.waiting.popleft()
+            self.waiting.pop()
             # Admission-time prefix accounting (one query per ADMITTED
             # sequence — watermark retries don't double-count). DEDICATED
             # counters: the allocator's prefix_queries/prefix_hits belong
@@ -2010,6 +2130,12 @@ class EngineCore:
             self.iterations += 1
             plan = self._plan_step()
             outputs = plan.commit() if plan is not None else []
+        if self._shed_outputs:
+            # Typed queue-expiry rejections from this step's sweeps ride
+            # the same output path as real chunks (the engine facade
+            # turns them into the wire-typed DeadlineExceededError).
+            outputs = self._shed_outputs + outputs
+            self._shed_outputs = []
         if self._inflight is None and not (
             self.running or self.waiting or self._inbox
         ):
@@ -3306,6 +3432,8 @@ class EngineCore:
         st["chunked_scheduling"] = 1 if self._sched_chunked else 0
         st["token_budget"] = self.engine.token_budget
         st["async_exec"] = 1 if self.engine.async_exec else 0
+        st["queue_limit"] = self._max_waiting
+        st["fair_enabled"] = 1 if self.engine.fair_scheduling else 0
         st.update(self.exec_stats)
         st["megastep_k"] = self.engine.megastep
         toks = self.exec_stats["committed_tokens"]
@@ -3359,6 +3487,11 @@ class EngineCore:
         st["enabled"] = 1 if self._spec_default is not None else 0
         return st
 
+    def fair_queue_stats(self) -> dict[str, dict[str, float]]:
+        """Per-tenant admission-queue depth + DRR deficit snapshot
+        (status_server.bind_fair_queue_gauges — dynamic tenant labels)."""
+        return self.waiting.stats()
+
     def metrics(self) -> ForwardPassMetrics:
         alloc = self.allocator
         return ForwardPassMetrics(
@@ -3366,6 +3499,14 @@ class EngineCore:
                 request_active_slots=len(self.running),
                 request_total_slots=self.engine.max_num_seqs,
                 num_requests_waiting=len(self.waiting) + len(self._inbox),
+                queue_limit=self._max_waiting,
+                requests_shed_total=(
+                    self.sched_stats["shed_total"]
+                    + self.sched_stats["deadline_expired_total"]
+                ),
+                budget_utilization=self.sched_stats[
+                    "last_step_budget_utilization"
+                ],
             ),
             kv=KvStats(
                 kv_active_blocks=alloc.used_blocks,

@@ -22,7 +22,7 @@ from typing import Any, AsyncIterator
 from dynamo_tpu import tracing
 from dynamo_tpu.engine.core import EngineCore, Sequence
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
-from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -74,6 +74,15 @@ class TpuEngine:
                 item = await queue.get()
                 if item is _FINISHED:
                     return
+                shed = item.get("meta", {}).get("shed") if isinstance(item, dict) else None
+                if shed == "deadline":
+                    # Queue-expiry sweep (core._sweep_queue): surface the
+                    # typed exception so the ingress serializes its wire
+                    # marker — a clean, retryable rejection, never a
+                    # half-stream (the sequence was never admitted).
+                    raise DeadlineExceededError(
+                        item["meta"].get("detail", "deadline exceeded in queue")
+                    )
                 t_last = time.time()
                 if not t_first:
                     t_first = t_last

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from dynamo_tpu.llm.admission import AdmissionConfig
 from dynamo_tpu.llm.http_service import HttpService
 from dynamo_tpu.llm.kv_router.protocols import RouterConfig
 from dynamo_tpu.llm.model_manager import ModelManager
@@ -28,11 +29,16 @@ async def run_frontend(
     service_out: list | None = None,
     tls_cert: str | None = None,
     tls_key: str | None = None,
+    admission: AdmissionConfig | None = None,
 ) -> None:
     manager = ModelManager(runtime, router_mode=router_mode, router_config=router_config)
     await manager.start()
     service = HttpService(
-        manager, host=http_host, port=http_port, tls_cert=tls_cert, tls_key=tls_key
+        manager, host=http_host, port=http_port, tls_cert=tls_cert, tls_key=tls_key,
+        admission=admission,
+        # Drain visibility: the SIGTERM drain flips /health to 503 and
+        # refuses new LLM requests with a retryable shed error.
+        draining_fn=lambda: runtime.draining,
     )
     await service.start()
     if service_out is not None:
@@ -68,6 +74,33 @@ def main() -> None:
         default=None,
         help="override the model card's KV block size (must match workers)",
     )
+    ap.add_argument(
+        "--busy-threshold", type=float, default=None,
+        help="route around workers whose KV usage (or queue saturation) "
+             "is at/above this fraction while alternatives exist",
+    )
+    ap.add_argument(
+        "--queue-threshold", type=int, default=None,
+        help="route around workers with at least this many queued "
+             "requests (saturation-aware routing; workers exporting a "
+             "queue limit are skipped at that limit automatically)",
+    )
+    ap.add_argument(
+        "--tenant-rate-limit", type=float, default=0.0,
+        help="per-tenant sustained requests/second (x-tenant-id header "
+             "keys the bucket); over-limit answers 429 + Retry-After. "
+             "0 = off",
+    )
+    ap.add_argument(
+        "--tenant-burst", type=int, default=0,
+        help="per-tenant burst allowance (token-bucket capacity); "
+             "0 = auto from the rate",
+    )
+    ap.add_argument(
+        "--max-inflight-requests", type=int, default=0,
+        help="concurrently admitted LLM requests across all tenants; at "
+             "the ceiling new requests get a retryable 503. 0 = unbounded",
+    )
     args = ap.parse_args()
 
     config = RouterConfig(
@@ -75,6 +108,13 @@ def main() -> None:
         temperature=args.router_temperature,
         block_size=args.kv_cache_block_size,
         replica_sync=args.kv_replica_sync,
+        busy_threshold=args.busy_threshold,
+        queue_threshold=args.queue_threshold,
+    )
+    admission = AdmissionConfig(
+        tenant_rate=args.tenant_rate_limit,
+        tenant_burst=args.tenant_burst,
+        max_inflight=args.max_inflight_requests,
     )
 
     @dynamo_worker()
@@ -87,6 +127,7 @@ def main() -> None:
             router_config=config,
             tls_cert=args.tls_cert_path,
             tls_key=args.tls_key_path,
+            admission=admission,
         )
 
     entry()

@@ -27,6 +27,11 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from dynamo_tpu import tracing
+from dynamo_tpu.llm.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    resolve_deadline,
+)
 from dynamo_tpu.llm.model_manager import ModelManager, ServedModel
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionRequest,
@@ -39,6 +44,9 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
     new_request_id,
 )
+from dynamo_tpu.runtime import chaos
+from dynamo_tpu.runtime.component import NoInstancesError
+from dynamo_tpu.runtime.engine import DeadlineExceededError
 from dynamo_tpu.runtime.logging_setup import TRACEPARENT_HEADER, child_traceparent
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
@@ -52,6 +60,12 @@ _ITL_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 # charset, bounded length. Anything else gets a freshly minted id.
 _CLIENT_RID_RE = re.compile(r"^[A-Za-z0-9._:\-]{1,128}$")
 
+# Inbound x-tenant-id values key rate-limit buckets, scheduler fair
+# queues, and per-tenant /metrics labels — same conservative validation;
+# anything else maps to the default tenant rather than a 400 (a broken
+# proxy header must not take traffic down).
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._:\-]{1,64}$")
+
 
 class HttpService:
     def __init__(
@@ -62,6 +76,8 @@ class HttpService:
         metrics: MetricsRegistry | None = None,
         tls_cert: str | None = None,
         tls_key: str | None = None,
+        admission: AdmissionConfig | None = None,
+        draining_fn=None,
     ):
         self.manager = manager
         self.host = host
@@ -69,6 +85,14 @@ class HttpService:
         self.metrics = metrics or MetricsRegistry()
         self.tls_cert = tls_cert
         self.tls_key = tls_key
+        # Overload admission (ISSUE 10): per-tenant rate buckets + the
+        # in-flight ceiling. Default config is fully open — admission is
+        # opt-in via CLI/knobs, never a silent new rejection path.
+        self.admission = AdmissionController(admission or AdmissionConfig())
+        # Drain visibility (PR 6 satellite): when the runtime is
+        # draining, /health flips to 503 "draining" so load balancers
+        # stop routing here, and new LLM requests get a retryable 503.
+        self._draining_fn = draining_fn or (lambda: False)
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
@@ -170,10 +194,113 @@ class HttpService:
     def _release_request_id(self, rid: str) -> None:
         self._inflight_rids.discard(rid)
 
+    # -- overload admission (ISSUE 10) -------------------------------------
+
+    @staticmethod
+    def _tenant(request: web.Request) -> str:
+        """The validated x-tenant-id header, or "" (the default tenant).
+        Malformed values degrade to default rather than 400 — tenancy is
+        a fairness key, not an auth boundary."""
+        raw = request.headers.get("x-tenant-id", "").strip()
+        return raw if _TENANT_RE.match(raw) else ""
+
+    def _shed(
+        self,
+        status: int,
+        reason: str,
+        message: str,
+        model: str,
+        endpoint: str,
+        retry_after_s: float = 1.0,
+    ) -> web.Response:
+        """One typed, retryable rejection: OpenAI-style error body, a
+        Retry-After header, and the frontend_requests_shed_total counter
+        bumped under its reason label. Every overload path (rate limit,
+        ceiling, worker shed, deadline, draining, chaos) exits here so
+        clients see ONE error contract."""
+        self.metrics.scoped(
+            service="frontend", model=model, endpoint=endpoint, reason=reason
+        ).counter(
+            "frontend_requests_shed_total",
+            "LLM requests rejected by overload protection, by reason",
+        ).inc()
+        err_type = {
+            429: "rate_limit_error",
+            503: "overloaded_error",
+        }.get(status, "overloaded_error")
+        if reason == "deadline":
+            err_type = "deadline_exceeded"
+        return web.json_response(
+            {
+                "error": {
+                    "message": message,
+                    "type": err_type,
+                    "code": reason,
+                    # Machine-readable mirror of Retry-After — shed
+                    # responses are retryable BY CONTRACT.
+                    "retryable": True,
+                }
+            },
+            status=status,
+            headers={"Retry-After": str(max(1, int(retry_after_s + 0.999)))},
+        )
+
+    async def _admission_gate(
+        self, request: web.Request, model: str, endpoint: str,
+        dyn_deadline_ms: float | None,
+    ):
+        """The ONE admission sequence every LLM endpoint runs: draining
+        check, chaos ``frontend.admit`` point, deadline resolution,
+        rate/ceiling decision. Returns a rejection ``web.Response``, or
+        ``(tenant, deadline_ms, deadline_epoch)`` on admission — in
+        which case the caller OWNS one in-flight slot and must pair with
+        ``self.admission.release()``."""
+        tenant = self._tenant(request)
+        if self._draining_fn():
+            return self._shed(
+                503, "draining",
+                "frontend is draining; retry against another replica",
+                model, endpoint,
+            )
+        if chaos.active():
+            # Overload chaos point: a plan can delay admission or shed
+            # p% of requests (drop/sever both map to a clean 503) —
+            # deterministic overload without touching client code.
+            try:
+                proceed = await chaos.inject(
+                    "frontend.admit", f"{tenant or 'default'}/{model}"
+                )
+            except ConnectionError:
+                proceed = False
+            if not proceed:
+                return self._shed(
+                    503, "chaos", "request shed by the active chaos plan",
+                    model, endpoint,
+                )
+        deadline_ms, deadline_epoch, err = resolve_deadline(
+            dyn_deadline_ms, request.headers.get("x-request-deadline-ms")
+        )
+        if err is not None:
+            return self._error(400, err)
+        decision = self.admission.admit(tenant)
+        if not decision.admitted:
+            return self._shed(
+                decision.status, decision.reason, decision.message,
+                model, endpoint, decision.retry_after_s,
+            )
+        return tenant, deadline_ms, deadline_epoch
+
     # -- handlers ----------------------------------------------------------
 
     async def health(self, request: web.Request) -> web.Response:
         models = [s.entry.name for s in self.manager.list_models()]
+        if self._draining_fn():
+            # Draining (PR 6 SIGTERM path): new requests are being
+            # refused, so the health probe must go dark — a 200 here
+            # keeps load balancers routing into guaranteed rejections.
+            return web.json_response(
+                {"status": "draining", "models": models}, status=503
+            )
         return web.json_response({"status": "healthy" if models else "starting", "models": models})
 
     async def live(self, request: web.Request) -> web.Response:
@@ -203,9 +330,9 @@ class HttpService:
         return lambda osl: m.histogram("frontend_output_sequence_tokens").observe(osl)
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
-        def make_stream(served: ServedModel, body, rid: str, headers, m):
+        def make_stream(served: ServedModel, body, rid: str, headers, m, stamp):
             pre = served.preprocessor.preprocess_chat(body)
-            pre.request_id = rid
+            stamp(pre, rid)
             return served.preprocessor.postprocess_chat_stream(
                 pre,
                 served.generate(pre, headers),
@@ -221,9 +348,9 @@ class HttpService:
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
-        def make_stream(served: ServedModel, body, rid: str, headers, m):
+        def make_stream(served: ServedModel, body, rid: str, headers, m, stamp):
             pre = served.preprocessor.preprocess_completion(body)
-            pre.request_id = rid
+            stamp(pre, rid)
             return served.preprocessor.postprocess_completion(
                 pre, served.generate(pre, headers), request_id=rid, stream=body.stream,
                 on_complete=self._observe_isl(m, len(pre.token_ids)),
@@ -404,6 +531,11 @@ class HttpService:
         for k in ("temperature", "top_p"):
             if body_raw.get(k) is not None:
                 chat_body[k] = body_raw[k]
+        if body_raw.get("dyn") is not None:
+            # Extensions (deadline_ms, priority, ...) ride through to the
+            # rebuilt chat request so this endpoint honors the same
+            # overload contract as /v1/chat/completions.
+            chat_body["dyn"] = body_raw["dyn"]
         try:
             body = ChatCompletionRequest.model_validate(chat_body)
         except ValidationError as e:
@@ -411,29 +543,51 @@ class HttpService:
         served = self._lookup(model)
         if served is None:
             return self._error(404, f"model {model!r} not found", "model_not_found")
+        # Same admission gate as the streaming endpoints: /v1/responses
+        # must not be a side door around the rate limit, the drain, the
+        # deadline contract, or the chaos overload point.
+        gate = await self._admission_gate(
+            request, model, "responses", body.dyn.deadline_ms
+        )
+        if isinstance(gate, web.Response):
+            return gate
+        tenant, deadline_ms, deadline_epoch = gate
 
         rid = self._request_id(request, "resp")
-        pre = served.preprocessor.preprocess_chat(body)
-        pre.request_id = rid
-        chunks = served.preprocessor.postprocess_chat_stream(
-            pre,
-            served.generate(pre, self._headers_for(request, rid)),
-            request_id=rid,
-            include_usage=True,
-        )
         text_parts: list[str] = []
         usage = None
         try:
+            pre = served.preprocessor.preprocess_chat(body)
+            pre.request_id = rid
+            pre.tenant_id = tenant
+            if deadline_ms is not None:
+                pre.deadline_ms = deadline_ms
+                pre.deadline_epoch = deadline_epoch
+            chunks = served.preprocessor.postprocess_chat_stream(
+                pre,
+                served.generate(pre, self._headers_for(request, rid)),
+                request_id=rid,
+                include_usage=True,
+            )
             async for chunk in chunks:
                 for choice in chunk.choices:
                     if choice.delta.content:
                         text_parts.append(choice.delta.content)
                 if chunk.usage:
                     usage = chunk.usage
+        except DeadlineExceededError as e:
+            return self._shed(503, "deadline", str(e), model, "responses")
+        except (ConnectionError, NoInstancesError) as e:
+            return self._shed(
+                503, "worker_shed",
+                f"no instance could take the request: {e}",
+                model, "responses",
+            )
         except Exception as e:  # noqa: BLE001
             log.exception("responses request %s failed", rid)
             return self._error(500, str(e), "internal_error")
         finally:
+            self.admission.release()
             self._release_request_id(rid)
         return web.json_response(
             {
@@ -477,6 +631,24 @@ class HttpService:
         if served is None:
             return self._error(404, f"model {body.model!r} not found", "model_not_found")
 
+        # -- admission gate (ISSUE 10): decide BEFORE any work is done --
+        gate = await self._admission_gate(
+            request, body.model, endpoint, body.dyn.deadline_ms
+        )
+        if isinstance(gate, web.Response):
+            return gate
+        tenant, deadline_ms, deadline_epoch = gate
+
+        def stamp(pre, rid: str) -> None:
+            """Identity + overload metadata onto the preprocessed
+            request: the scheduler's fair queues and deadline sweeps key
+            off these fields downstream."""
+            pre.request_id = rid
+            pre.tenant_id = tenant
+            if deadline_ms is not None:
+                pre.deadline_ms = deadline_ms
+                pre.deadline_epoch = deadline_epoch
+
         rid = self._request_id(request, rid_prefix)
         m = self.metrics.scoped(service="frontend", model=body.model, endpoint=endpoint)
         m.counter("frontend_requests_total").inc()
@@ -496,19 +668,49 @@ class HttpService:
                 # make_stream runs the synchronous preprocess (chat
                 # template + tokenize) before returning the lazy stream.
                 chunks = make_stream(
-                    served, body, rid, self._headers_for(request, rid, root), m
+                    served, body, rid, self._headers_for(request, rid, root), m, stamp
                 )
             if body.stream:
-                return await self._stream_sse(request, chunks, started, m)
+                # Pull the FIRST chunk before sending SSE headers: a
+                # pre-first-token rejection (queue-expired deadline,
+                # fleet-wide shed) must surface as the typed 503 below,
+                # not as an in-band error inside a 200 stream. Once a
+                # token exists the request is admitted, and admitted
+                # streams never shed — so errors after this point are
+                # genuine mid-stream failures.
+                chunks = chunks.__aiter__()
+                try:
+                    first_chunk = await chunks.__anext__()
+                except StopAsyncIteration:
+                    first_chunk = None
+                return await self._stream_sse(
+                    request, chunks, started, m, first_chunk
+                )
             return await aggregate(rid, body, chunks)
         except asyncio.CancelledError:
             root.set("error", "cancelled")
             raise
+        except DeadlineExceededError as e:
+            # Queued past its deadline on a worker: typed, clean, and
+            # retryable (with a fresh budget) — never a broken stream.
+            root.set("error", "deadline_exceeded")
+            return self._shed(503, "deadline", str(e), body.model, endpoint)
+        except (ConnectionError, NoInstancesError) as e:
+            # Every instance shed/drained/died and migration exhausted
+            # its retries: the fleet is saturated, not broken — answer
+            # the retryable overload shape, not a 500.
+            root.set("error", "overloaded")
+            return self._shed(
+                503, "worker_shed",
+                f"no instance could take the request: {e}",
+                body.model, endpoint,
+            )
         except Exception as e:  # noqa: BLE001 — surface engine errors as 500s
             log.exception("%s request %s failed", endpoint, rid)
             root.set("error", type(e).__name__)
             return self._error(500, str(e), "internal_error")
         finally:
+            self.admission.release()
             self._release_request_id(rid)
             inflight.dec()
             m.histogram("frontend_request_duration_seconds").observe(
@@ -519,8 +721,18 @@ class HttpService:
     # -- response shaping --------------------------------------------------
 
     async def _stream_sse(
-        self, request: web.Request, chunks, started: float, m
+        self, request: web.Request, chunks, started: float, m, first_chunk=None
     ) -> web.StreamResponse:
+        """``first_chunk`` was already pulled by the caller (inside its
+        typed-error scope, BEFORE the 200 headers commit); it streams
+        first, then the rest of ``chunks``."""
+
+        async def with_first():
+            if first_chunk is not None:
+                yield first_chunk
+            async for c in chunks:
+                yield c
+
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -534,7 +746,7 @@ class HttpService:
         ttft_h = m.histogram("frontend_time_to_first_token_seconds", buckets=_TTFT_BUCKETS)
         itl_h = m.histogram("frontend_inter_token_latency_seconds", buckets=_ITL_BUCKETS)
         try:
-            async for chunk in chunks:
+            async for chunk in with_first():
                 now = time.monotonic()
                 if first:
                     ttft_h.observe(now - started)

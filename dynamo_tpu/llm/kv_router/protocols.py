@@ -79,6 +79,15 @@ class WorkerStats:
     request_active_slots: int = 0
     request_total_slots: int = 0
     num_requests_waiting: int = 0
+    # Overload robustness (ISSUE 10): the engine's bounded-queue ceiling
+    # (0 = unbounded) and its shed counters, so routing can skip a
+    # saturated worker BEFORE the dial instead of bouncing off its
+    # shed error (NetKV's point: follow measured queue depth).
+    queue_limit: int = 0
+    requests_shed_total: int = 0
+    # Most recent step's batched-tokens / token-budget ratio — the
+    # per-phase load signal the planner/monitor read.
+    budget_utilization: float = 0.0
 
 
 @dataclass
@@ -121,6 +130,10 @@ class RouterConfig:
     # routing while alternatives exist (busy-aware routing; reference
     # worker_monitor.rs + frontend --busy-threshold). None = off.
     busy_threshold: float | None = None
+    # Saturation-aware routing (ISSUE 10): also exclude workers with at
+    # least this many queued requests. None = auto — workers exporting a
+    # bounded-queue limit are skipped when their queue reaches it.
+    queue_threshold: int | None = None
     # None → inherit the model card's kv_block_size at model-add time.
     # Must match the worker's KV block size or seq hashes never overlap.
     block_size: int | None = None

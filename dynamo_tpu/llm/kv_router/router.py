@@ -180,7 +180,11 @@ class KvPushRouter:
                 # Migration retries must not re-dial a worker that just failed —
                 # its cached prefix makes it the router's top pick otherwise.
                 workers = [w for w in workers if w not in exclude] or workers
-            if self.monitor is not None and self.router.config.busy_threshold is not None:
+            if self.monitor is not None:
+                # Busy/saturation-aware routing: the monitor marks
+                # workers busy on KV pressure (busy_threshold) or queue
+                # saturation (queue_threshold / worker-exported queue
+                # limit); an all-busy fleet falls back to the full set.
                 workers = self.monitor.eligible(workers)
             if not workers:
                 raise NoInstancesError(self.client.endpoint.path)
@@ -233,19 +237,59 @@ class KvPushRouter:
                 )
 
         first = True
+        stream = None
+        done = False
         try:
-            stream = await self.client.direct(selection.worker_id, payload, headers)
-            async for item in stream:
+            try:
+                stream = await self.client.direct(
+                    selection.worker_id, payload, headers
+                )
+            except (ConnectionError, NoInstancesError) as e:
+                # Dial-time failure: tag the instance so migration
+                # excludes it on replay (a dead worker's cached prefix
+                # would otherwise make it the router's top pick again).
+                e.worker_id = selection.worker_id  # type: ignore[attr-defined]
+                raise
+            while True:
+                try:
+                    item = await stream.__anext__()
+                except StopAsyncIteration:
+                    done = True
+                    break
+                except (ConnectionError, NoInstancesError) as e:
+                    done = True  # the worker side is already gone
+                    # Tag the failure with the worker so migration can
+                    # exclude it on replay.
+                    e.worker_id = selection.worker_id  # type: ignore[attr-defined]
+                    raise
+                except Exception:
+                    done = True  # stream-delivered error: server closed it
+                    raise
+                # CancelledError/GeneratorExit (consumer vanished while
+                # awaiting a frame) fall through with done=False — the
+                # finally forwards the kill.
                 if first:
                     first = False
                     self.router.mark_prefill_done(request_id)
+                # The one suspension the CONSUMER can abandon us at
+                # (client disconnect -> GeneratorExit/CancelledError
+                # thrown here): `done` stays False and the finally
+                # forwards the kill.
                 yield item
-        except (ConnectionError, NoInstancesError) as e:
-            # Tag the failure with the worker so migration can exclude it.
-            e.worker_id = selection.worker_id  # type: ignore[attr-defined]
-            raise
         finally:
             self.router.free(request_id)
+            if stream is not None and not done:
+                # Consumer vanished mid-stream: forward the kill so the
+                # worker drops the request — queued or running — instead
+                # of serving a ghost. Fire-and-forget: this finally may
+                # be unwinding a cancellation and must not await.
+                from dynamo_tpu.runtime.tasks import spawn_logged
+
+                spawn_logged(
+                    stream.kill_quietly(),
+                    name=f"router-kill-{request_id}",
+                    logger=log,
+                )
 
     @property
     def worker_ids(self) -> list[int]:

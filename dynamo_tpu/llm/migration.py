@@ -75,13 +75,46 @@ class RouterEgress:
                 yield LLMEngineOutput.from_wire(item)
         else:
             worker_id = self.client.pick_instance(self.mode, exclude)
+            stream = None
+            done = False
             try:
-                stream = await self.client.direct(worker_id, payload, headers)
-                async for item in stream:
+                try:
+                    stream = await self.client.direct(worker_id, payload, headers)
+                except (ConnectionError, NoInstancesError) as e:
+                    # Dial-time failure: tag the instance for exclusion.
+                    e.worker_id = worker_id  # type: ignore[attr-defined]
+                    raise
+                while True:
+                    try:
+                        item = await stream.__anext__()
+                    except StopAsyncIteration:
+                        done = True
+                        break
+                    except (ConnectionError, NoInstancesError) as e:
+                        done = True  # the worker side is already gone
+                        e.worker_id = worker_id  # type: ignore[attr-defined]
+                        raise
+                    except Exception:
+                        done = True  # stream-delivered error: server closed it
+                        raise
+                    # Consumer abandonment (client disconnect) surfaces
+                    # as CancelledError/GeneratorExit — at the await
+                    # above or thrown in at this yield — and leaves
+                    # `done` False, so the finally forwards the kill.
                     yield LLMEngineOutput.from_wire(item)
-            except (ConnectionError, NoInstancesError) as e:
-                e.worker_id = worker_id  # type: ignore[attr-defined]
-                raise
+            finally:
+                if stream is not None and not done:
+                    # Consumer vanished mid-stream: forward the kill so
+                    # the worker drops the request (queued or running)
+                    # instead of serving a ghost. Fire-and-forget — this
+                    # finally may be unwinding a cancellation.
+                    from dynamo_tpu.runtime.tasks import spawn_logged
+
+                    spawn_logged(
+                        stream.kill_quietly(),
+                        name=f"egress-kill-{pre.request_id}",
+                        logger=log,
+                    )
 
 
 class MigrationOperator:

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
 from dynamo_tpu import tracing
+from dynamo_tpu.engine.fair_queue import FairQueue
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError, MockKvManager
 from dynamo_tpu.llm.protocols.common import (
@@ -28,7 +29,7 @@ from dynamo_tpu.llm.protocols.common import (
     StopConditions,
 )
 from dynamo_tpu.runtime import chaos
-from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.engine import Context, EngineOverloadedError
 from dynamo_tpu.spec import SpecConfig, SpecStats, resolve_spec_config
 from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
 
@@ -97,6 +98,14 @@ class MockEngineArgs:
     # VALUES never change — only the virtual clock and capacity move.
     kv_dtype: str = "bf16"
     kv_read_us_per_block: float = 0.0
+    # Overload robustness (mirrors EngineConfig, ISSUE 10): per-tenant
+    # DRR fair admission (off = exact FIFO; single tenant is FIFO either
+    # way, so streams stay bit-identical), the DRR quantum (0 = token
+    # budget), and the bounded admission queue (0 = unbounded; at the
+    # ceiling submits raise the typed retryable EngineOverloadedError).
+    fair_scheduling: bool = False
+    fair_quantum: int = 0
+    max_waiting: int = 0
 
 
 @dataclass
@@ -123,6 +132,12 @@ class _Seq:
     # stopped, the way a real model conditioning on the grown prompt
     # would.
     replay_base: int = 0
+    # Overload metadata (ISSUE 10), mirroring engine/core.Sequence:
+    # fairness identity, within-tenant ordering, absolute deadline (in
+    # the engine's clock domain — injectable for virtual-clock tests).
+    tenant_id: str = ""
+    priority: int = 0
+    deadline_epoch: float | None = None
     # Phase timestamps for the tracer (0.0 = not reached yet). The spans
     # are emitted retroactively when the stream closes so the sim loop's
     # hot path only ever stamps a float.
@@ -190,8 +205,19 @@ class MockTpuEngine:
             block_size=self.args.block_size,
             enable_prefix_caching=self.args.enable_prefix_caching,
         )
-        self._waiting: list[_Seq] = []
+        # Admission queue: per-tenant DRR over prompt-token cost,
+        # mirroring EngineCore.waiting (fair off = exact FIFO, keeping
+        # every historical stream bit-identical).
+        self._waiting: FairQueue = FairQueue(
+            quantum=self.args.fair_quantum or self.args.max_num_batched_tokens,
+            fair=self.args.fair_scheduling,
+            cost_fn=lambda s: len(s.prompt),
+        )
         self._running: list[_Seq] = []
+        # Deadline clock — injectable so virtual-clock drivers (bench
+        # run_overload_ab, fairness tests) expire queued requests on the
+        # simulated timeline instead of the wall.
+        self.clock = time.time
         self._wakeup = asyncio.Event()
         self._loop_task: asyncio.Task | None = None
         self._iterations = 0
@@ -232,6 +258,9 @@ class MockTpuEngine:
             "megastep_dispatches": 0,
             "single_step_dispatches": 0,
             "committed_tokens": 0,
+            # Overload counters (ISSUE 10), mirroring EngineCore.
+            "shed_total": 0,
+            "deadline_expired_total": 0,
         }
 
     # -- public engine surface --------------------------------------------
@@ -260,6 +289,17 @@ class MockTpuEngine:
             }
             return
         pre = PreprocessedRequest.from_wire(request)
+        limit = self.args.max_waiting
+        if limit and len(self._waiting) >= limit:
+            # Bounded admission queue (backpressure): the typed shed
+            # error serializes as a retry-elsewhere err frame, exactly
+            # like EngineCore's — migration moves the request to a
+            # less-loaded worker.
+            self.sched_stats["shed_total"] += 1
+            raise EngineOverloadedError(
+                f"scheduler queue full ({limit} requests waiting); "
+                f"retry on another instance"
+            )
         max_tokens = pre.stop.max_tokens or 16
         seq = _Seq(
             request_id=pre.request_id or context.id,
@@ -270,7 +310,13 @@ class MockTpuEngine:
             prompt_hashes=compute_seq_hashes(pre.token_ids, self.args.block_size),
             stop=pre.stop,
             replay_base=pre.replayed_tokens,
+            tenant_id=pre.tenant_id or "",
+            priority=pre.priority or 0,
         )
+        if pre.deadline_epoch is not None:
+            seq.deadline_epoch = pre.deadline_epoch
+        elif pre.deadline_ms is not None and pre.deadline_ms > 0:
+            seq.deadline_epoch = self.clock() + pre.deadline_ms / 1000.0
         spec = resolve_spec_config(
             self._spec_default, pre.spec_decode, self.args.spec_k
         )
@@ -287,6 +333,15 @@ class MockTpuEngine:
                 item = await seq.out.get()
                 if item is self._FINISHED:
                     return
+                shed = item.get("meta", {}).get("shed") if isinstance(item, dict) else None
+                if shed == "deadline":
+                    # Expired while queued: typed, clean, never a
+                    # half-stream (mirrors TpuEngine.generate).
+                    from dynamo_tpu.runtime.engine import DeadlineExceededError
+
+                    raise DeadlineExceededError(
+                        item["meta"].get("detail", "deadline exceeded in queue")
+                    )
                 yield item
                 if context.is_stopped:
                     seq.cancelled = True
@@ -334,6 +389,8 @@ class MockTpuEngine:
         st["chunked_scheduling"] = 1 if self.args.scheduling == "chunked" else 0
         st["token_budget"] = self.args.max_num_batched_tokens
         st["async_exec"] = 1 if self.args.async_exec else 0
+        st["queue_limit"] = self.args.max_waiting
+        st["fair_enabled"] = 1 if self.args.fair_scheduling else 0
         st["megastep_k"] = self.args.megastep_k
         toks = self.sched_stats["committed_tokens"]
         st["dispatches_per_token"] = (
@@ -381,12 +438,25 @@ class MockTpuEngine:
             ),
         }
 
+    def fair_queue_stats(self) -> dict[str, dict[str, float]]:
+        """Per-tenant queue depth + DRR deficit snapshot, same shape as
+        EngineCore.fair_queue_stats (status-server tenant gauges)."""
+        return self._waiting.stats()
+
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
             worker=WorkerStats(
                 request_active_slots=len(self._running),
                 request_total_slots=self.args.max_num_seqs,
                 num_requests_waiting=len(self._waiting),
+                queue_limit=self.args.max_waiting,
+                requests_shed_total=(
+                    self.sched_stats["shed_total"]
+                    + self.sched_stats["deadline_expired_total"]
+                ),
+                budget_utilization=self.sched_stats[
+                    "last_step_budget_utilization"
+                ],
             ),
             kv=KvStats(
                 kv_active_blocks=self.kv.used_blocks,
@@ -477,14 +547,53 @@ class MockTpuEngine:
                 )
             )
 
+    def _sweep_queue(self) -> None:
+        """Queue hygiene ahead of admission, mirroring EngineCore:
+        cancelled requests leave from ANY queue position; queued
+        requests past their deadline get the typed shed frame (the
+        generate loop raises it as DeadlineExceededError). Queued
+        sequences hold no pins or partials, so removal is the whole
+        cleanup."""
+        now = self.clock()
+
+        def dead(s: _Seq) -> bool:
+            # ONE combined pass per iteration (cancel + expiry),
+            # mirroring EngineCore._sweep_queue.
+            return s.cancelled or (
+                s.deadline_epoch is not None
+                and now > s.deadline_epoch
+                and s.generated == 0
+            )
+
+        swept = self._waiting.sweep(dead)
+        for seq in swept:
+            if seq.cancelled:
+                self._finish(seq, emit=False)
+        expired = [s for s in swept if not s.cancelled]
+        for seq in expired:
+            self.sched_stats["deadline_expired_total"] += 1
+            waited_ms = (now - seq.t_submit) * 1e3 if seq.t_submit else 0.0
+            out = LLMEngineOutput(
+                token_ids=[], finish_reason="error",
+                prompt_tokens=len(seq.prompt), completion_tokens=0,
+            )
+            out.meta = {
+                "shed": "deadline",
+                "detail": (
+                    f"request {seq.request_id} expired after "
+                    f"{waited_ms:.0f} ms in the scheduler queue"
+                ),
+            }
+            seq.out.put_nowait(out.to_wire())
+            self._finish(seq, emit=False)
+
     def _admit(self) -> None:
+        self._sweep_queue()
         watermark_blocks = self.args.watermark * self.kv.capacity
         while self._waiting and len(self._running) < self.args.max_num_seqs:
-            seq = self._waiting[0]
-            if seq.cancelled:
-                self._waiting.pop(0)
-                self._finish(seq, emit=False)
-                continue
+            # DRR head (FIFO with fairness off / one tenant); pop() on
+            # successful admission charges the tenant's deficit.
+            seq = self._waiting.head()
             cached = self.kv.acquire_cached(seq.prompt_hashes)
             to_commit = len(seq.prompt_hashes) - cached
             trailing = 1 if len(seq.prompt) % self.args.block_size else 0
@@ -498,7 +607,7 @@ class MockTpuEngine:
             except InsufficientBlocksError:
                 self.kv.release(seq.prompt_hashes[:cached])
                 return
-            self._waiting.pop(0)
+            self._waiting.pop()
             # Admission-time prefix accounting (one query per ADMITTED
             # sequence), mirroring EngineCore._admit — DEDICATED counters,
             # never the kv manager's match_prefix probe counters.

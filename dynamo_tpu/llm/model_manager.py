@@ -113,6 +113,7 @@ class ModelManager:
                 entry.namespace,
                 entry.component,
                 busy_threshold=config.busy_threshold or 0.95,
+                queue_threshold=config.queue_threshold,
             )
             await monitor.start()
             aggregator = monitor.aggregator

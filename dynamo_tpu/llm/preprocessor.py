@@ -90,6 +90,8 @@ class OpenAIPreprocessor:
                 if request.dyn.spec_decode is not None
                 else None
             ),
+            priority=request.dyn.priority,
+            deadline_ms=request.dyn.deadline_ms,
         )
 
     def make_decoder(self, pre: PreprocessedRequest) -> Decoder:

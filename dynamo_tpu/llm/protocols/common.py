@@ -124,6 +124,23 @@ class PreprocessedRequest:
     # the count to keep its synthetic token function bit-identical across
     # a replay. 0 on every fresh request.
     replayed_tokens: int = 0
+    # -- overload robustness (ISSUE 10) ---------------------------------
+    # Fairness identity: the validated x-tenant-id header (frontend) or
+    # "" for the default tenant. The scheduler's per-tenant DRR queues
+    # key on this; it also labels the per-tenant /metrics gauges.
+    tenant_id: str = ""
+    # Ordering hint WITHIN a tenant's queue (higher admits first, FIFO
+    # among equals). Never a cross-tenant bandwidth grant.
+    priority: int = 0
+    # Client-requested completion budget in milliseconds (dyn.deadline_ms
+    # or x-request-deadline-ms) — observability + the source for
+    # deadline_epoch when the frontend did not stamp one.
+    deadline_ms: float | None = None
+    # Absolute wall-clock deadline (time.time() domain), stamped by the
+    # frontend at admission so downstream queue time counts against the
+    # budget. A request still queued past this expires with a typed
+    # DeadlineExceededError — never a broken stream.
+    deadline_epoch: float | None = None
 
     def to_wire(self) -> dict:
         return asdict(self)
@@ -143,6 +160,10 @@ class PreprocessedRequest:
             mm=d.get("mm"),
             spec_decode=d.get("spec_decode"),
             replayed_tokens=d.get("replayed_tokens", 0),
+            tenant_id=d.get("tenant_id", ""),
+            priority=d.get("priority", 0),
+            deadline_ms=d.get("deadline_ms"),
+            deadline_epoch=d.get("deadline_epoch"),
         )
 
 

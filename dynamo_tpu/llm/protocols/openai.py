@@ -39,6 +39,14 @@ class DynExt(BaseModel):
     # ...} — rides PreprocessedRequest.spec_decode to the worker engine
     # (greedy output is bit-identical with or without it).
     spec_decode: dict[str, Any] | None = None
+    # Overload robustness (ISSUE 10): completion deadline budget in ms
+    # (the x-request-deadline-ms header overrides it) — a request still
+    # queued past its deadline gets a typed retryable error instead of
+    # late tokens. priority orders requests WITHIN the caller's tenant
+    # queue (higher first); tenancy itself comes from the validated
+    # x-tenant-id header, never the request body.
+    deadline_ms: float | None = None
+    priority: int = 0
 
 
 class FunctionCall(BaseModel):

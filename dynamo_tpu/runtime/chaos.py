@@ -39,6 +39,13 @@ call sites; tests assert against these names):
 ``store.frame``       control-plane inbound frame (target: store address)
 ``engine.step``       one engine/sim-loop iteration (target: worker tag)
 ``kv_transfer.pull``  disagg/peer KV block pull (target: source worker)
+``frontend.admit``    one HTTP LLM request at admission (target:
+                      ``tenant/model``) — the overload/burst point:
+                      ``delay`` slows admission, ``drop``/``sever`` shed
+                      the request with a clean retryable 503, and a
+                      ``delay`` rule on ``engine.step`` alongside it
+                      turns nominal traffic into a saturating burst
+                      (see :func:`burst_plan`)
 ====================  ====================================================
 
 Rule actions:
@@ -80,6 +87,7 @@ POINTS = (
     "store.frame",
     "engine.step",
     "kv_transfer.pull",
+    "frontend.admit",
 )
 
 ACTIONS = ("delay", "drop", "sever", "stall", "kill")
@@ -148,6 +156,37 @@ class ChaosPlan:
     def from_dict(cls, d: dict[str, Any]) -> "ChaosPlan":
         rules = [ChaosRule(**r) for r in d.get("rules", [])]
         return cls(rules=rules, seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def burst(
+        cls,
+        slow_s: float = 0.05,
+        shed_p: float = 0.0,
+        match: str = "",
+        seed: int = 0,
+        count: int | None = None,
+    ) -> "ChaosPlan":
+        """The canonical overload/burst rule set (ISSUE 10): slow every
+        matching engine iteration by ``slow_s`` — normal arrival rate
+        against a 1/slow_s-times-slower fleet IS a burst, queues build
+        exactly as under a traffic spike — and optionally shed
+        ``shed_p`` of frontend admissions (deterministic on the seed).
+        Used by the overload tests to create saturation without
+        touching client code."""
+        rules = [
+            ChaosRule(
+                point="engine.step", action="delay", match=match,
+                delay_s=slow_s, count=count,
+            )
+        ]
+        if shed_p > 0.0:
+            rules.append(
+                ChaosRule(
+                    point="frontend.admit", action="drop", p=shed_p,
+                    match=match, count=count,
+                )
+            )
+        return cls(rules=rules, seed=seed)
 
     @classmethod
     def from_env(cls, env: str = CHAOS_PLAN_ENV) -> "ChaosPlan | None":

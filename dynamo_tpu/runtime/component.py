@@ -164,6 +164,12 @@ class DistributedRuntime:
         self._shutdown.set()
         return completed
 
+    @property
+    def draining(self) -> bool:
+        """True once a graceful drain began: health surfaces go dark and
+        frontends answer new requests with a retryable 503."""
+        return self._draining
+
     def signal_shutdown(self) -> None:
         self._shutdown.set()
 

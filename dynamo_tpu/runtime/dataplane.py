@@ -74,7 +74,8 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.runtime import chaos, framing
-from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime import engine as _engine_errors
+from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
 from dynamo_tpu.runtime.tasks import spawn_logged
 
 log = logging.getLogger("dynamo_tpu.dataplane")
@@ -84,6 +85,14 @@ Handler = Callable[[Any, Context], AsyncIterator[Any]]
 # Distinguished err payload a draining server answers new requests with;
 # clients map it to ConnectionError so migration replays elsewhere.
 DRAINING_ERR = "worker draining"
+
+# Typed overload markers (runtime/engine.py): an engine-side
+# EngineOverloadedError/DeadlineExceededError serializes as an err frame
+# whose payload starts with its ``wire`` marker; the client maps the
+# marker back (shed -> retryable ConnectionError like DRAINING_ERR,
+# deadline -> client-side DeadlineExceededError, never migrated).
+SHED_WIRE = _engine_errors.SHED_WIRE
+DEADLINE_WIRE = _engine_errors.DEADLINE_WIRE
 
 
 def _env_float(name: str, default: float) -> float:
@@ -353,9 +362,19 @@ class IngressServer:
         except ConnectionError:
             pass
         except Exception as e:  # noqa: BLE001 — stream errors go to the peer
-            log.exception("handler %s failed", msg.get("m"))
+            # Typed overload rejections (EngineOverloadedError /
+            # DeadlineExceededError) serialize their canonical wire
+            # marker so the client maps them back; they are expected
+            # load-shedding behavior, logged at info, not exception.
+            wire = getattr(e, "wire", None)
+            if wire:
+                log.info("handler %s shed request: %s", msg.get("m"), e)
+                payload = f"{wire}: {e}"
+            else:
+                log.exception("handler %s failed", msg.get("m"))
+                payload = f"{type(e).__name__}: {e}"
             try:
-                await send({"t": "err", "i": req_id, "err": f"{type(e).__name__}: {e}"})
+                await send({"t": "err", "i": req_id, "err": payload})
             except ConnectionError:
                 pass
         finally:
@@ -425,8 +444,21 @@ class ResponseStream:
         await self._conn.send({"t": "stop", "i": self._req_id})
 
     async def kill(self) -> None:
+        # Deregister first: a killed server task sends no end frame, so
+        # leaving the entry would leak one registry slot per kill (and a
+        # late frame racing the kill must be discarded, not delivered).
+        self._conn._streams.pop(self._req_id, None)
         await self._conn.send({"t": "kill", "i": self._req_id})
         self._push(self._END)
+
+    async def kill_quietly(self) -> None:
+        """Best-effort kill for fire-and-forget callers (consumer-
+        abandonment cleanup): a connection that died first means the
+        server already reaped the request — nothing to report."""
+        try:
+            await self.kill()
+        except (ConnectionError, OSError):
+            pass
 
 
 class _EgressConn:
@@ -532,6 +564,19 @@ class _EgressConn:
                             f"worker at {self.address} is draining"
                         )
                         err.worker_id = stream.worker_id  # type: ignore[attr-defined]
+                    elif msg["err"].startswith(SHED_WIRE):
+                        # Overload shed: same retryable shape as the
+                        # drain refusal — migration retries the request
+                        # on a less-loaded instance.
+                        err = ConnectionError(
+                            f"worker at {self.address} shed the request: "
+                            f"{msg['err']}"
+                        )
+                        err.worker_id = stream.worker_id  # type: ignore[attr-defined]
+                    elif msg["err"].startswith(DEADLINE_WIRE):
+                        # Deadline expiry is typed but NOT retryable via
+                        # migration — the budget is already spent.
+                        err = DeadlineExceededError(msg["err"])
                     else:
                         err = EngineStreamError(msg["err"])
                     stream._push(err)

@@ -80,6 +80,50 @@ class AsyncEngine(Protocol):
         ...
 
 
+# -- typed overload errors (the shed/deadline contract) ----------------------
+#
+# Graceful degradation under overload (ISSUE 10) needs REJECTIONS to be
+# typed end to end: an engine that cannot take a request raises one of
+# these, the ingress server serializes the exception's ``wire`` marker as
+# the err-frame payload (dataplane.IngressServer._serve_one), and the
+# egress client maps the marker back to the right client-side behavior
+# (dataplane._EgressConn._recv_loop):
+#
+#   EngineOverloadedError -> ConnectionError carrying worker_id
+#       ("retry elsewhere"): migration replays the request on another
+#       instance, exactly like the PR 6 "draining" refusal — a shed
+#       worker is a worker you route around, not a failed request.
+#   DeadlineExceededError -> DeadlineExceededError on the client
+#       (NOT retried by migration: the deadline has already passed, so
+#       replaying elsewhere burns capacity to miss it again). The HTTP
+#       frontend maps it to a clean, retryable 503 with Retry-After.
+#
+# The markers live here (not in dataplane.py) because engines raise these
+# without importing the dataplane; dataplane imports this module already.
+
+SHED_WIRE = "worker overloaded (shed)"
+DEADLINE_WIRE = "deadline exceeded"
+
+
+class EngineOverloadedError(ValueError):
+    """Admission refused: the engine's bounded queue is full (or the
+    frontend's in-flight ceiling is hit). Retryable — on the data plane
+    this surfaces to peers as a ConnectionError so the migration layer
+    retries on another instance. Subclasses ValueError so a multihost
+    follower replaying a leader-rejected add swallows the symmetric
+    rejection the same way it swallows validation errors."""
+
+    wire = SHED_WIRE
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed while it was still queued (never
+    admitted, nothing streamed). Typed and clean — the client can retry
+    with a fresh deadline — but never replayed by migration."""
+
+    wire = DEADLINE_WIRE
+
+
 @dataclass
 class Annotated:
     """SSE-shaped event envelope flowing through LLM pipelines.

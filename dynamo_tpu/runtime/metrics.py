@@ -53,6 +53,19 @@ class ScopedMetrics:
         metric = self._root._get_or_create(Gauge, name, doc, tuple(self._labels))
         return metric.labels(**self._labels)
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop this label-set's child of a gauge (no-op if absent).
+        For exporters with CLIENT-CONTROLLED label values (per-tenant
+        queue gauges): without removal, every value ever seen leaves a
+        permanent series in /metrics — unbounded output from a header."""
+        key = f"Gauge:{PREFIX}_{name}:{tuple(self._labels)}"
+        metric = self._root._metrics.get(key)
+        if metric is not None:
+            try:
+                metric.remove(*self._labels.values())
+            except KeyError:
+                pass
+
     def histogram(self, name: str, doc: str = "", buckets: tuple | None = None) -> Histogram:
         kwargs = {"buckets": buckets} if buckets else {}
         metric = self._root._get_or_create(Histogram, name, doc, tuple(self._labels), **kwargs)

@@ -87,6 +87,27 @@ SCHEDULER_GAUGES: dict[str, tuple[str, str]] = {
         "start — < 1.0 means multi-token dispatches are amortizing the "
         "fixed per-dispatch overhead",
     ),
+    # Overload robustness (ISSUE 10): bounded-queue + deadline shedding
+    # and the fair-scheduler switch, on BOTH backends.
+    "queue_limit": (
+        "scheduler_queue_limit",
+        "Bounded admission-queue ceiling (0 = unbounded); at the limit "
+        "new requests get the typed retryable shed error",
+    ),
+    "shed_total": (
+        "scheduler_requests_shed_total",
+        "Requests refused at add_request because the bounded queue was "
+        "full (each became a retry-elsewhere error, never a broken stream)",
+    ),
+    "deadline_expired_total": (
+        "scheduler_deadline_expired_total",
+        "Queued requests expired past their deadline (typed retryable "
+        "error frame; admitted requests always run to completion)",
+    ),
+    "fair_enabled": (
+        "scheduler_fair_enabled",
+        "1 when per-tenant deficit-round-robin admission is active",
+    ),
 }
 
 
@@ -230,6 +251,71 @@ def bind_kv_cache_gauges(
             "kv_cache_dtype",
             "KV cache storage dtype as an info gauge (value label)",
         ).set(1.0)
+
+
+# Per-tenant fair-queue gauges: queue depth and DRR deficit per tenant.
+# Tenant labels are dynamic (tenants appear as their first request
+# arrives), so these sync via a before_render hook like the egress
+# gauges rather than pre-bound set_function children.
+FAIR_QUEUE_GAUGES: dict[str, tuple[str, str]] = {
+    "depth": (
+        "scheduler_tenant_queue_depth",
+        "Requests waiting in this tenant's admission queue",
+    ),
+    "deficit": (
+        "scheduler_tenant_deficit_tokens",
+        "The tenant's current deficit-round-robin token balance",
+    ),
+}
+
+
+# Tenant labels come from the CLIENT-controlled x-tenant-id header, so
+# the export is bounded: at most this many distinct tenant series, the
+# overflow aggregated under tenant="__other__", and drained tenants'
+# series REMOVED (not zeroed) so /metrics output cannot grow without
+# bound from a rotating-tenant spray.
+MAX_TENANT_GAUGES = 64
+
+
+def bind_fair_queue_gauges(
+    status: "SystemStatusServer | None", fair_queue_stats: Callable[[], dict]
+) -> None:
+    """Export a worker's per-tenant admission-queue gauges on /metrics
+    (labels: service=engine, tenant=<id>). ``fair_queue_stats`` returns
+    {tenant: {"depth": n, "deficit": d}} (EngineCore/MockTpuEngine
+    fair_queue_stats). No-op when the status server is disabled."""
+    if status is None:
+        return
+
+    seen: set[str] = set()
+
+    def sync() -> None:
+        stats = fair_queue_stats()
+        if len(stats) > MAX_TENANT_GAUGES:
+            ranked = sorted(
+                stats.items(), key=lambda kv: -kv[1].get("depth", 0.0)
+            )
+            stats = dict(ranked[:MAX_TENANT_GAUGES])
+            other = {"depth": 0.0, "deficit": 0.0}
+            for _t, st in ranked[MAX_TENANT_GAUGES:]:
+                for k in other:
+                    other[k] += st.get(k, 0.0)
+            stats["__other__"] = other
+        # Tenants that left the snapshot take their series with them —
+        # a stale zeroed series per tenant-ever-seen is still unbounded
+        # /metrics growth.
+        for tenant in seen - set(stats):
+            scoped = status.metrics.scoped(service="engine", tenant=tenant)
+            for _key, (name, _doc) in FAIR_QUEUE_GAUGES.items():
+                scoped.remove_gauge(name)
+        seen.intersection_update(stats)
+        for tenant, st in stats.items():
+            seen.add(tenant)
+            scoped = status.metrics.scoped(service="engine", tenant=tenant)
+            for key, (name, doc) in FAIR_QUEUE_GAUGES.items():
+                scoped.gauge(name, doc).set(float(st.get(key, 0.0)))
+
+    status.before_render.append(sync)
 
 
 # Dataplane egress containment gauges: per-address circuit-breaker state

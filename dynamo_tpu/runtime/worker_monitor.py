@@ -23,17 +23,28 @@ log = logging.getLogger("dynamo_tpu.worker_monitor")
 
 
 class WorkerMonitor:
+    # Class-level default: tests (and older callers) build partial
+    # monitors via __new__ without running __init__.
+    queue_threshold: int | None = None
+
     def __init__(
         self,
         store,
         namespace: str,
         component: str,
         busy_threshold: float = 0.95,
+        queue_threshold: int | None = None,
         on_busy_change: Callable[[int, bool], None] | None = None,
         aggregator: MetricsAggregator | None = None,
     ):
         self.aggregator = aggregator or MetricsAggregator(store, namespace, component)
         self.busy_threshold = busy_threshold
+        # Saturation-aware routing (ISSUE 10): a worker is also busy when
+        # its scheduler queue is saturated — at `queue_threshold` queued
+        # requests, or (None = auto) at the bounded-queue limit the
+        # worker itself exports in WorkerStats.queue_limit. Routing to a
+        # worker that is about to shed just burns a dial + a migration.
+        self.queue_threshold = queue_threshold
         self.on_busy_change = on_busy_change or (lambda w, b: None)
         self.busy: set[int] = set()
         self.aggregator.on_update.append(self._on_metrics)
@@ -48,14 +59,25 @@ class WorkerMonitor:
     async def stop(self) -> None:
         await self.aggregator.stop()
 
+    def _saturated(self, fpm: ForwardPassMetrics) -> bool:
+        w = fpm.worker
+        limit = self.queue_threshold
+        if limit is None:
+            limit = w.queue_limit or 0
+        return bool(limit) and w.num_requests_waiting >= limit
+
     def _on_metrics(self, fpm: ForwardPassMetrics) -> None:
         worker_id = fpm.worker_id
         usage = fpm.kv.gpu_cache_usage_perc
         was_busy = worker_id in self.busy
-        now_busy = usage >= self.busy_threshold
+        now_busy = usage >= self.busy_threshold or self._saturated(fpm)
         if now_busy != was_busy:
             (self.busy.add if now_busy else self.busy.discard)(worker_id)
-            log.info("worker %d busy=%s (kv %.0f%%)", worker_id, now_busy, usage * 100)
+            log.info(
+                "worker %d busy=%s (kv %.0f%%, queued %d)",
+                worker_id, now_busy, usage * 100,
+                fpm.worker.num_requests_waiting,
+            )
             self.on_busy_change(worker_id, now_busy)
 
     def eligible(self, workers: list[int]) -> list[int]:

@@ -122,6 +122,11 @@ CURSOR_ATTRS = {
     "_by_hash": "allocator hash index",
     "_inactive": "allocator inactive LRU",
     "_partials": "allocator partial-block count",
+    # Fair-queue DRR state (engine/fair_queue.py, ISSUE 10): deficit
+    # balances and the tenant rotation decide admission order; a write
+    # from outside the queue's own methods would silently skew fairness.
+    "_deficits": "DRR per-tenant deficit balances",
+    "_order": "DRR tenant rotation",
 }
 
 # {file suffix -> set of audited writer qualnames}. Nested defs are dotted
@@ -160,6 +165,20 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
         "DeviceBlockAllocator.release",
         "DeviceBlockAllocator.register_inactive",
         "DeviceBlockAllocator.clear_cache",
+    },
+    # The fair queue owns its DRR bookkeeping wholesale (every mutator
+    # is an entry point); the rule guards against OTHER files reaching
+    # into `waiting._deficits` / `waiting._order` directly.
+    "dynamo_tpu/engine/fair_queue.py": {
+        "FairQueue.__init__",
+        "FairQueue._queue_for",
+        "FairQueue.append",
+        "FairQueue.appendleft",
+        "FairQueue.head",
+        "FairQueue.pop",
+        "FairQueue._drop_tenant",
+        "FairQueue.remove",
+        "FairQueue.sweep",
     },
     # The mocker mirrors the scheduler on its virtual clock; its step loop
     # and hash-only KV manager are the same protocol in miniature.

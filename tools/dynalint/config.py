@@ -92,6 +92,15 @@ GUARDED_BY = {
         ("DeviceBlockAllocator", "_inactive"): EXTERNAL,
         ("DeviceBlockAllocator", "_partials"): EXTERNAL,
     },
+    "dynamo_tpu/engine/fair_queue.py": {
+        # The per-tenant DRR admission queue (ISSUE 10) is externally
+        # synchronized like the allocator: EngineCore reaches it only
+        # under _step_lock (intake goes through the thread-safe _inbox
+        # deque), the mocker only from its single sim loop.
+        ("FairQueue", "_queues"): EXTERNAL,
+        ("FairQueue", "_deficits"): EXTERNAL,
+        ("FairQueue", "_order"): EXTERNAL,
+    },
     "dynamo_tpu/llm/kv_router/native_radix.py": {
         # One-shot lazy .so build+load, raced by every router thread.
         (None, "_lib"): "_lock",
