@@ -39,6 +39,7 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
 from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.kv_pool import PeerKvClient
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
@@ -94,74 +95,6 @@ async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None
 
     ep = runtime.namespace(namespace).component(component).endpoint("kv_fetch")
     await ep.serve(kv_fetch_handler)
-
-
-async def _pull_peer_prefix(
-    core, fetch_client, hint: dict, token_ids: list[int]
-) -> int:
-    """Pull a better-overlapping peer's cached prefix into the local
-    cache before prefilling (the router attached ``peer_prefix`` because
-    routing could not land on that peer — busy, excluded, sampled away).
-    Best-effort: any failure falls back to local recompute."""
-    import numpy as np
-
-    from dynamo_tpu.tokens import compute_seq_hashes
-
-    bs = core.engine.block_size
-    hashes = compute_seq_hashes(token_ids, bs)
-    cached = await asyncio.to_thread(core.cached_prefix_tokens, token_ids)
-    start = cached // bs
-    want = hashes[start:]
-    if not want:
-        return 0
-    # Defaults overridden by the server's geometry frame (a peer on a
-    # different float precision reports its own dtype; import_blocks
-    # casts — but an int8-vs-float mismatch fails the import fast, and
-    # the pull degrades to local recompute).
-    shape = [
-        core.cfg.num_layers, bs, 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
-    ]
-    dtype = core.kv_wire_dtype
-    imported = 0
-    try:
-        # Hard deadline: a stalled peer must degrade to local recompute,
-        # never hang the user's request.
-        async with asyncio.timeout(30.0):
-            if chaos.active():
-                await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
-            stream = await fetch_client.direct(
-                hint["worker_id"], {"hashes": want}
-            )
-            async for frame in stream:
-                if "shape" in frame:
-                    shape = list(frame["shape"])
-                    dtype = frame["dtype"]
-                if "kv" not in frame:
-                    continue
-                s = frame["start"]
-                blocks = []
-                for j, kv in enumerate(frame["kv"]):
-                    gi = start + s + j
-                    blocks.append({
-                        "hash": hashes[gi],
-                        "parent": hashes[gi - 1] if gi > 0 else None,
-                        "shape": shape,
-                        "dtype": dtype,
-                        "kv": kv,
-                    })
-                res = await asyncio.to_thread(core.import_blocks, blocks)
-                imported += res.imported
-    except Exception:  # noqa: BLE001 — recompute is always correct
-        log.warning(
-            "peer prefix pull from worker %s failed; recomputing locally",
-            hint.get("worker_id"), exc_info=True,
-        )
-    if imported:
-        log.debug(
-            "pulled %d prefix blocks from peer worker %s",
-            imported, hint.get("worker_id"),
-        )
-    return imported
 
 
 async def _resolve_mm(core, encode_client, embed_fetch_client, request: dict) -> None:
@@ -271,6 +204,8 @@ def build_engine(
     eos_token_ids: tuple[int, ...] = (),
     on_stored=None,
     on_removed=None,
+    on_tier_stored=None,
+    on_tier_removed=None,
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
@@ -424,6 +359,8 @@ def build_engine(
         eos_token_ids=eos_token_ids,
         on_stored=on_stored,
         on_removed=on_removed,
+        on_tier_stored=on_tier_stored,
+        on_tier_removed=on_tier_removed,
         mesh=mesh,
         sp_mesh=sp_mesh,
         pp_mesh=pp_mesh,
@@ -489,17 +426,25 @@ async def run_jax_worker(
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
     loop = asyncio.get_running_loop()
 
-    # KV events fire from the engine thread (core.step under to_thread);
-    # hop them onto the loop for publishing.
+    # KV events fire from the engine thread (core.step under to_thread)
+    # and the offload worker thread (tier demotions); hop them onto the
+    # loop where the publisher's bounded buffer lives. Device-tier events
+    # come from the allocator callbacks, host/disk-tier events from the
+    # offload engine — the router's global index composes them back to
+    # worker-level residency.
     def on_stored(hashes: list[int], parent: int | None) -> None:
-        loop.call_soon_threadsafe(
-            lambda: loop.create_task(kv_pub.stored(hashes, parent))
-        )
+        loop.call_soon_threadsafe(kv_pub.stored_nowait, list(hashes), parent)
 
     def on_removed(hashes: list[int]) -> None:
+        loop.call_soon_threadsafe(kv_pub.removed_nowait, list(hashes))
+
+    def on_tier_stored(hashes: list[int], parent: int | None, tier: str) -> None:
         loop.call_soon_threadsafe(
-            lambda: loop.create_task(kv_pub.removed(hashes))
+            kv_pub.stored_nowait, list(hashes), parent, tier
         )
+
+    def on_tier_removed(hashes: list[int], tier: str) -> None:
+        loop.call_soon_threadsafe(kv_pub.removed_nowait, list(hashes), tier)
 
     # Off the event loop like the build below: resolving eos for an HF
     # tokenizer reads tokenizer.json, and blocking the loop starves the
@@ -518,6 +463,8 @@ async def run_jax_worker(
         eos_token_ids=eos,
         on_stored=on_stored,
         on_removed=on_removed,
+        on_tier_stored=on_tier_stored,
+        on_tier_removed=on_tier_removed,
         tp=tp,
         dp=dp,
         sp=sp,
@@ -530,6 +477,20 @@ async def run_jax_worker(
     if core_out is not None:
         core_out.append(core)
 
+    # Cluster KV pool plumbing (ISSUE 11): the publisher can answer
+    # indexer resync requests with the engine's full tier inventory, and
+    # a graceful drain retracts the whole published inventory (cleared +
+    # flush) so routers stop serving stale hints the moment we leave —
+    # not at lease expiry.
+    kv_pub.inventory_source = core.kv_inventory
+    await kv_pub.start()
+
+    async def _retract_kv_inventory() -> None:
+        kv_pub.cleared_nowait()
+        await kv_pub.flush(timeout=5.0)
+
+    runtime.on_drain.append(_retract_kv_inventory)
+
     metrics_pub = WorkerMetricsPublisher(
         runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
     )
@@ -541,6 +502,7 @@ async def run_jax_worker(
     from dynamo_tpu.runtime.status_server import (
         bind_fair_queue_gauges,
         bind_kv_cache_gauges,
+        bind_kv_pool_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
     )
@@ -549,6 +511,19 @@ async def run_jax_worker(
     bind_spec_gauges(runtime.status, core.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, core.kv_cache_stats)
     bind_fair_queue_gauges(runtime.status, core.fair_queue_stats)
+
+    # kv_pool_* gauges: publisher inventory/drop counters always; the
+    # peer-pull counters once the role wiring below creates the client
+    # (prefill workers serve blocks but never pull).
+    _peer_clients: list = []
+
+    def _kv_pool_stats() -> dict:
+        st = kv_pub.stats()
+        if _peer_clients:
+            st.update(_peer_clients[0].pool_stats())
+        return st
+
+    bind_kv_pool_gauges(runtime.status, _kv_pool_stats)
 
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
@@ -714,6 +689,8 @@ async def run_jax_worker(
         fetch_client = await (
             runtime.namespace(namespace).component(component).endpoint("kv_fetch").client()
         )
+        peer_kv = PeerKvClient(core, fetch_client)
+        _peer_clients.append(peer_kv)
 
         qname = _prefill_queue(namespace)
 
@@ -730,7 +707,7 @@ async def run_jax_worker(
             pre.request_id = pre.request_id or context.id
             hint = (pre.kv_transfer_params or {}).get("peer_prefix")
             if hint and hint.get("worker_id") != worker_id:
-                await _pull_peer_prefix(core, fetch_client, hint, list(pre.token_ids))
+                await peer_kv.pull_prefix(hint, list(pre.token_ids))
             cached = await asyncio.to_thread(core.cached_prefix_tokens, pre.token_ids)
             uncached = len(pre.token_ids) - cached
             fallback_replayed = 0  # tokens replayed by an in-worker disagg fallback
@@ -796,6 +773,8 @@ async def run_jax_worker(
         fetch_client = await (
             runtime.namespace(namespace).component(component).endpoint("kv_fetch").client()
         )
+        peer_kv = PeerKvClient(core, fetch_client)
+        _peer_clients.append(peer_kv)
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
             await _resolve_mm(core, encode_client, embed_fetch_client, request)
@@ -805,9 +784,7 @@ async def run_jax_worker(
                 and hint.get("worker_id") != worker_id
                 and request.get("token_ids")
             ):
-                await _pull_peer_prefix(
-                    core, fetch_client, hint, list(request["token_ids"])
-                )
+                await peer_kv.pull_prefix(hint, list(request["token_ids"]))
             async for out in engine.generate(request, context):
                 yield out
 

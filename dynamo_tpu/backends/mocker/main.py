@@ -15,17 +15,95 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
-from dynamo_tpu.runtime import Context, DistributedRuntime
-from dynamo_tpu.runtime.tasks import spawn_logged
+from dynamo_tpu.runtime import Context, DistributedRuntime, chaos
 from dynamo_tpu.runtime.worker import dynamo_worker
+from dynamo_tpu.tokens import compute_seq_hashes
 
 log = logging.getLogger("dynamo_tpu.backends.mocker")
+
+
+async def _pull_peer_prefix_mock(
+    engine: MockTpuEngine, fetch_client, hint: dict, token_ids: list[int]
+) -> int:
+    """Mocker twin of PeerKvClient.pull_prefix: ask the hinted peer which
+    prefix blocks it holds over the REAL dataplane (breakers, stall
+    deadlines, and chaos all apply), register them as locally cached, and
+    price the transfer on the clock. Every failure degrades to local
+    recompute — the stream is bit-identical either way."""
+    from dynamo_tpu.llm.kv_pool.peer_client import _env_float
+
+    from dynamo_tpu.runtime.dataplane import BreakerOpenError
+
+    st = engine.peer_stats
+    bs = engine.args.block_size
+    hashes = compute_seq_hashes(token_ids, bs)
+    have = engine.kv.held_prefix(hashes)
+    want = hashes[len(have):]
+    if not want:
+        return 0
+    st.pulls_attempted += 1
+    t0 = time.monotonic()
+    frame_timeout = _env_float("DYN_KV_POOL_FRAME_TIMEOUT_S", 10.0)
+    imported = 0
+    cost_s = 0.0
+    ok = False
+    try:
+        if chaos.active():
+            await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
+        stream = await fetch_client.direct(hint["worker_id"], {"hashes": want})
+        held: list[int] = []
+        while True:
+            try:
+                frame = await asyncio.wait_for(stream.__anext__(), frame_timeout)
+            except StopAsyncIteration:
+                break
+            dtype = frame.get("dtype")
+            if dtype is not None and (
+                (dtype == "int8") != (engine.args.kv_dtype == "int8")
+            ):
+                # The PR 8 fail-fast contract, mirrored: mixed int8/float
+                # fleets never re-quantize — recompute locally.
+                st.dtype_mismatches += 1
+                raise ValueError(
+                    f"KV dtype mismatch: peer pages are {dtype!r}, local "
+                    f"cache is {engine.args.kv_dtype!r}"
+                )
+            held.extend(frame.get("held") or [])
+        offset = len(have)
+        parents = [
+            hashes[offset + i - 1] if offset + i > 0 else None
+            for i in range(len(held))
+        ]
+        imported, cost_s = engine.import_peer_blocks(held, parents)
+        ok = True
+    except BreakerOpenError:
+        st.breaker_fast_fails += 1
+        log.info(
+            "mock peer pull from worker %s skipped: circuit breaker open",
+            hint.get("worker_id"),
+        )
+    except Exception:  # noqa: BLE001 — recompute is always correct
+        log.warning(
+            "mock peer pull from worker %s failed; recomputing locally",
+            hint.get("worker_id"), exc_info=True,
+        )
+    if cost_s > 0:
+        await asyncio.sleep(cost_s)  # the priced dataplane copy
+    elapsed_ms = (time.monotonic() - t0) * 1e3
+    st.pull_ms_total += elapsed_ms
+    st.last_pull_ms = elapsed_ms
+    if ok:
+        st.pulls_succeeded += 1
+    else:
+        st.pulls_fallback += 1
+    return imported
 
 
 async def run_mocker(
@@ -36,24 +114,38 @@ async def run_mocker(
     engine_args: MockEngineArgs | None = None,
     context_length: int = 16384,
     served_event: asyncio.Event | None = None,
+    engine_out: list | None = None,
 ) -> None:
     args = engine_args or MockEngineArgs()
     engine = MockTpuEngine(args)
+    if engine_out is not None:
+        engine_out.append(engine)
     worker_id = runtime.primary_lease_id
     # Chaos targeting: `engine.step` rules match this worker by id (and
     # by model name, so a plan can wedge "one worker of model X").
     engine.chaos_tag = f"worker-{worker_id}/{model_name}"
 
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
+    # Anti-entropy + drain retraction, mirroring the jax worker: the
+    # publisher can re-publish the full inventory after a gap, and a
+    # graceful drain retracts it so routers drop this worker's hints now.
+    kv_pub.inventory_source = lambda: [
+        ("device", h, parent) for h, parent in engine.kv.snapshot()
+    ]
+    # The mock kv manager is loop-affine: snapshot inline, never from a
+    # thread (the sim loop mutates the same dicts).
+    kv_pub.inventory_blocking = False
+    await kv_pub.start()
 
-    def on_stored(hashes: list[int], parent: int | None) -> None:
-        spawn_logged(kv_pub.stored(hashes, parent), name="kv-stored", logger=log)
+    async def _retract_kv_inventory() -> None:
+        kv_pub.cleared_nowait()
+        await kv_pub.flush(timeout=5.0)
 
-    def on_removed(hashes: list[int]) -> None:
-        spawn_logged(kv_pub.removed(hashes), name="kv-removed", logger=log)
+    runtime.on_drain.append(_retract_kv_inventory)
 
-    engine.kv.on_stored = on_stored
-    engine.kv.on_removed = on_removed
+    # The mock kv manager mutates only on the event loop: enqueue direct.
+    engine.kv.on_stored = kv_pub.stored_nowait
+    engine.kv.on_removed = kv_pub.removed_nowait
 
     metrics_pub = WorkerMetricsPublisher(
         runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
@@ -65,6 +157,7 @@ async def run_mocker(
     from dynamo_tpu.runtime.status_server import (
         bind_fair_queue_gauges,
         bind_kv_cache_gauges,
+        bind_kv_pool_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
     )
@@ -73,10 +166,37 @@ async def run_mocker(
     bind_spec_gauges(runtime.status, engine.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, engine.kv_cache_stats)
     bind_fair_queue_gauges(runtime.status, engine.fair_queue_stats)
+    bind_kv_pool_gauges(
+        runtime.status,
+        lambda: {**kv_pub.stats(), **engine.kv_pool_stats()},
+    )
+
+    # Peer block server (mock twin of the jax _serve_kv_fetch): answers
+    # which prefix of the requested hash chain this worker holds, behind
+    # a geometry-ish frame carrying the kv dtype for the fail-fast check.
+    async def kv_fetch_handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        hashes = list(request.get("hashes") or [])
+        yield {"version": 2, "dtype": args.kv_dtype, "mock": True}
+        yield {"version": 2, "held": engine.kv.held_prefix(hashes)}
+
+    fetch_ep = runtime.namespace(namespace).component(component).endpoint("kv_fetch")
+    await fetch_ep.serve(kv_fetch_handler)
+    fetch_client = await (
+        runtime.namespace(namespace).component(component).endpoint("kv_fetch").client()
+    )
 
     endpoint = runtime.namespace(namespace).component(component).endpoint("generate")
 
     async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
+        hint = (request.get("kv_transfer_params") or {}).get("peer_prefix")
+        if (
+            hint
+            and hint.get("worker_id") != worker_id
+            and request.get("token_ids")
+        ):
+            await _pull_peer_prefix_mock(
+                engine, fetch_client, hint, list(request["token_ids"])
+            )
         async for out in engine.generate(request, context):
             yield out
 
@@ -149,6 +269,11 @@ def main() -> None:
                          "bf16 KV block per decode lane-iteration "
                          "(scaled by the kv dtype's byte ratio; 0 = "
                          "legacy timing, KV traffic unpriced)")
+    ap.add_argument("--kv-pull-us-per-block", type=float, default=0.0,
+                    help="clock cost of pulling one bf16-equivalent KV "
+                         "block from a peer worker (cluster KV pool; "
+                         "scaled by the kv dtype's byte ratio — int8 "
+                         "moves ~0.52x the bytes). 0 = pulls unpriced")
     ap.add_argument("--fair-scheduling", default="off", choices=["on", "off"],
                     help="per-tenant deficit-round-robin admission over "
                          "prompt token cost (off = strict FIFO; single-"
@@ -193,6 +318,7 @@ def main() -> None:
         megastep_k=args.megastep_k,
         kv_dtype=args.kv_dtype,
         kv_read_us_per_block=args.kv_read_us_per_block,
+        kv_pull_us_per_block=args.kv_pull_us_per_block,
         fair_scheduling=args.fair_scheduling == "on",
         fair_quantum=args.fair_quantum,
         max_waiting=args.max_waiting,

@@ -184,6 +184,12 @@ class DeviceBlockAllocator:
     def is_cached(self, block_hash: int) -> bool:
         return block_hash in self._by_hash
 
+    def snapshot(self) -> list[tuple[int, int | None]]:
+        """(hash, parent) for every committed block, in commit (≈chain)
+        order — the anti-entropy resync's device-tier slice. Caller
+        synchronizes (EngineCore holds _step_lock)."""
+        return [(h, blk.parent_hash) for h, blk in self._by_hash.items()]
+
     def alloc_for_import(self) -> int:
         """A block for transferred-in KV content (not partial-tracked)."""
         if not self._free:

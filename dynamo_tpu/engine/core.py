@@ -538,6 +538,8 @@ class EngineCore:
         mesh: Any = None,
         sp_mesh: Any = None,
         pp_mesh: Any = None,
+        on_tier_stored: Callable[[list[int], int | None, str], None] | None = None,
+        on_tier_removed: Callable[[list[int], str], None] | None = None,
     ):
         """``mesh`` (a jax.sharding.Mesh with axes ("dp", "tp")) turns on
         in-engine model parallelism: params/cache shard per
@@ -827,23 +829,43 @@ class EngineCore:
         self.host_pool = None
         self.disk_pool = None
         self.offload = None
+        # Cluster-pool tier events (ISSUE 11): when both tier callbacks
+        # are wired, offload-tier transitions publish tier-tagged events
+        # (the composing global index folds them back to worker-level
+        # residency); without them, behavior is the legacy worker-level
+        # contract byte for byte.
+        self._tier_aware = on_tier_stored is not None and on_tier_removed is not None
+        self._on_tier_stored = on_tier_stored
+        self._on_tier_removed = on_tier_removed
         if engine_cfg.disk_kv_dir and engine_cfg.host_kv_blocks <= 0:
             raise ValueError("disk_kv_dir (G3) requires host_kv_blocks > 0 (G2)")
         if engine_cfg.host_kv_blocks > 0:
             from dynamo_tpu.engine.host_cache import HostKvPool
             from dynamo_tpu.engine.offload import DiskKvPool, OffloadEngine
 
+            def _pool_removed(tier: str) -> Callable[[list[int]], None]:
+                # Tier-aware: the pool's eviction retracts THAT tier (the
+                # index drops the worker only when its last tier empties).
+                # Legacy: the worker-level removed, exactly as before.
+                if self._tier_aware:
+                    return lambda hashes: self._on_tier_removed(hashes, tier)
+                return lambda hashes: self.allocator.on_removed(hashes)
+
             self.host_pool = HostKvPool(
-                engine_cfg.host_kv_blocks,
-                on_removed=lambda hashes: self.allocator.on_removed(hashes),
+                engine_cfg.host_kv_blocks, on_removed=_pool_removed("host")
             )
             if engine_cfg.disk_kv_dir:
                 self.disk_pool = DiskKvPool(
                     engine_cfg.disk_kv_dir,
                     engine_cfg.disk_kv_blocks,
-                    on_removed=lambda hashes: self.allocator.on_removed(hashes),
+                    on_removed=_pool_removed("disk"),
                 )
-            self.offload = OffloadEngine(self.host_pool, self.disk_pool)
+            self.offload = OffloadEngine(
+                self.host_pool,
+                self.disk_pool,
+                on_tier_stored=on_tier_stored if self._tier_aware else None,
+                on_tier_removed=on_tier_removed if self._tier_aware else None,
+            )
             self.allocator.on_evict = self._offload_block
 
         # Page movement programs (offload demotion + disagg transfer).
@@ -1462,10 +1484,10 @@ class EngineCore:
         unpacked, never re-quantized)."""
         while ncached < cap and self.offload.contains(hashes[ncached]):
             h = hashes[ncached]
-            got = self.offload.fetch(h)
+            got = self.offload.fetch_tiered(h)
             if got is None:
                 break  # evicted between contains() and fetch()
-            parent_hash, kv = got
+            parent_hash, kv, src_tier = got
             try:
                 bid = self.allocator.alloc_for_import()
             except OutOfBlocksError:
@@ -1475,7 +1497,16 @@ class EngineCore:
                 self.cache, jnp.asarray([bid], jnp.int32),
                 self._stack_staged([self._stage_page(kv)]),
             )
-            self.allocator.register_inactive(bid, h, parent_hash, emit=False)
+            # Tier-aware: the promotion publishes stored(device) via the
+            # allocator callback, then retracts the source tier — stored
+            # first, so the composed index never sees the worker empty.
+            # Legacy (emit=False): the block never left the worker, so
+            # the router already counts it as stored.
+            self.allocator.register_inactive(
+                bid, h, parent_hash, emit=self._tier_aware
+            )
+            if self._tier_aware:
+                self._on_tier_removed([h], src_tier)
             cached_ids.extend(self.allocator.acquire_cached([h]))
             ncached += 1
         return cached_ids, ncached
@@ -3167,6 +3198,18 @@ class EngineCore:
         hashes = compute_seq_hashes(token_ids, self.engine.block_size)
         with self._step_lock:
             return self.allocator.match_prefix(hashes) * self.engine.block_size
+
+    def kv_inventory(self) -> list[tuple[str, int, int | None]]:
+        """Full (tier, hash, parent) snapshot across device + offload
+        tiers — the anti-entropy resync payload the KV event publisher
+        re-publishes after a gap (KvEventPublisher.inventory_source)."""
+        with self._step_lock:
+            out: list[tuple[str, int, int | None]] = [
+                ("device", h, parent) for h, parent in self.allocator.snapshot()
+            ]
+        if self.offload is not None:
+            out.extend(self.offload.snapshot())
+        return out
 
     # dynalint: holds-lock(_step_lock) — transfer endpoints lock first
     def _touch_hold(self, request_id: str) -> None:

@@ -85,3 +85,9 @@ class HostKvPool:
         if blk is not None:
             self.stats.onboards += 1
         return blk
+
+    def snapshot(self) -> list[tuple[int, int | None]]:
+        """(hash, parent) inventory in insertion (≈chain) order — the
+        anti-entropy resync's host-tier slice. Caller synchronizes (the
+        offload engine's condition guards every mutation)."""
+        return [(h, blk.parent_hash) for h, blk in self._blocks.items()]

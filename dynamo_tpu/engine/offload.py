@@ -134,6 +134,11 @@ class DiskKvPool:
                 log.warning("disk tier: failed to load block %x", block_hash)
                 return None
 
+    def snapshot(self) -> list[tuple[int, int | None]]:
+        """(hash, parent) inventory — the anti-entropy resync's disk slice."""
+        with self._lock:
+            return list(self._index.items())
+
 
 class OffloadEngine:
     """Background transfer worker between the KV tiers.
@@ -141,25 +146,65 @@ class OffloadEngine:
     ``submit`` is the only engine-thread entry point on the eviction path
     and does no device synchronization; the worker thread owns every
     blocking copy (device->host landing, disk IO).
+
+    Cluster-pool tier events (ISSUE 11): when ``on_tier_stored`` /
+    ``on_tier_removed`` are wired (callables taking ``(hashes, parent,
+    tier)`` / ``(hashes, tier)``; must be thread-safe — they fire from
+    the engine thread at submit and from the offload worker thread on
+    demotion), every tier transition publishes: device→host demotion
+    emits ``stored(host)`` then ``removed(device)`` AT SUBMIT (the
+    in-flight block is servable — ``fetch`` waits out the landing — and
+    the ordering keeps the worker's global-index entry gapless),
+    host→disk demotion emits ``stored(disk)`` + ``removed(host)``, and a
+    failed landing retracts the host advertisement. Without the hooks the
+    legacy behavior is byte-identical: tiers move silently and only the
+    final eviction emits the worker-level ``removed``.
     """
 
-    def __init__(self, host: HostKvPool, disk: DiskKvPool | None = None):
+    def __init__(
+        self,
+        host: HostKvPool,
+        disk: DiskKvPool | None = None,
+        on_tier_stored: Callable[[list[int], int | None, str], None] | None = None,
+        on_tier_removed: Callable[[list[int], str], None] | None = None,
+    ):
         self.host = host
         self.disk = disk
+        self._on_tier_stored = on_tier_stored
+        self._on_tier_removed = on_tier_removed
         if disk is not None:
             # Host evictions demote to disk instead of emitting removal.
-            host.on_evict_block = disk.put
+            host.on_evict_block = self._demote_to_disk
         self._cond = threading.Condition()
         self._pending: dict[int, int | None] = {}  # hash -> parent (in flight)
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._thread = threading.Thread(target=self._run, name="kv-offload", daemon=True)
         self._thread.start()
 
+    def _demote_to_disk(self, block_hash: int, parent: int | None, kv: np.ndarray) -> None:
+        """Host LRU eviction with a disk tier behind it: the block moves
+        down, and (tier-aware) the inventory follows it."""
+        assert self.disk is not None
+        self.disk.put(block_hash, parent, kv)
+        if self._on_tier_stored is not None:
+            self._on_tier_stored([block_hash], parent, "disk")
+        if self._on_tier_removed is not None:
+            self._on_tier_removed([block_hash], "host")
+
     # -- eviction side (engine thread, non-blocking) -----------------------
 
     def submit(self, block_hash: int, parent_hash: int | None, device_page: Any) -> None:
         with self._cond:
             self._pending[block_hash] = parent_hash
+        # Advertise host-bound residency BEFORE the queue put (stored
+        # before removed: the composed index never transits through
+        # "worker holds nothing"): once the item is queued the worker
+        # thread may fail the landing and emit its removed(host)
+        # retraction, which must not be orderable ahead of this stored.
+        if self._on_tier_stored is not None:
+            self._on_tier_stored([block_hash], parent_hash, "host")
+        if self._on_tier_removed is not None:
+            self._on_tier_removed([block_hash], "device")
         self._q.put((block_hash, parent_hash, device_page))
 
     # -- worker ------------------------------------------------------------
@@ -186,10 +231,12 @@ class OffloadEngine:
             except Exception:  # noqa: BLE001 — engine may have shut down
                 log.exception("offload transfer failed for block %x", block_hash)
                 arr = None
+            landed = False
             with self._cond:
                 try:
                     if arr is not None and block_hash in self._pending:
                         self.host.put(block_hash, parent, arr)
+                        landed = True
                 except Exception:  # noqa: BLE001 — e.g. disk tier ENOSPC
                     # The block is lost to the offload tiers, but the
                     # worker must survive: fetch() waiters depend on
@@ -198,6 +245,10 @@ class OffloadEngine:
                 finally:
                     self._pending.pop(block_hash, None)
                     self._cond.notify_all()
+            if not landed and self._on_tier_removed is not None:
+                # Retract the host advertisement submit() made: the
+                # landing failed, the block is gone from this worker.
+                self._on_tier_removed([block_hash], "host")
 
     # -- onboarding side ---------------------------------------------------
 
@@ -217,14 +268,25 @@ class OffloadEngine:
     def fetch(self, block_hash: int) -> tuple[int | None, np.ndarray] | None:
         """Pop a block for onboarding, whichever tier holds it; waits out
         an in-flight transfer of the same hash."""
+        got = self.fetch_tiered(block_hash)
+        return None if got is None else got[:2]
+
+    def fetch_tiered(
+        self, block_hash: int
+    ) -> tuple[int | None, np.ndarray, str] | None:
+        """Like :meth:`fetch` but reports WHICH tier served the pop, so
+        the onboarding path can emit the matching tier-removed event
+        (device-stored is emitted by the allocator registration)."""
         with self._cond:
             while block_hash in self._pending:
                 self._cond.wait(timeout=30)
             blk = self.host.pop(block_hash)
             if blk is not None:
-                return blk.parent_hash, blk.kv
+                return blk.parent_hash, blk.kv, "host"
         if self.disk is not None:
-            return self.disk.pop(block_hash)
+            got = self.disk.pop(block_hash)
+            if got is not None:
+                return got[0], got[1], "disk"
         return None
 
     def peek(self, block_hash: int) -> np.ndarray | None:
@@ -239,6 +301,18 @@ class OffloadEngine:
         if self.disk is not None:
             return self.disk.peek(block_hash)
         return None
+
+    def snapshot(self) -> list[tuple[str, int, int | None]]:
+        """(tier, hash, parent) inventory across the offload tiers —
+        in-flight submissions count as host (they were advertised as such
+        and ``fetch`` can serve them)."""
+        out: list[tuple[str, int, int | None]] = []
+        with self._cond:
+            out += [("host", h, p) for h, p in self._pending.items()]
+            out += [("host", h, p) for h, p in self.host.snapshot()]
+        if self.disk is not None:
+            out += [("disk", h, p) for h, p in self.disk.snapshot()]
+        return out
 
     def flush(self) -> None:
         """Wait until every submitted transfer has landed (tests/shutdown)."""

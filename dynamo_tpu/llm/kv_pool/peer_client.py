@@ -1,0 +1,209 @@
+"""Worker→worker KV block pull: the cluster pool's transfer path.
+
+``PeerKvClient.pull_prefix`` streams the reusable prefix blocks of a
+request from the peer the router hinted at (``kv_transfer_params.
+peer_prefix``) into the local cache, through ``EngineCore.import_blocks``
+— the same packed-buffer path disagg transfers and host-tier onboarding
+use, so pulled bytes are bit-identical to local recompute by
+construction (quantize-once, PR 8).
+
+Degradation contract (the part chaos tests pin):
+
+- The dial rides the dataplane ``EgressClient`` — per-address circuit
+  breakers and connect deadlines apply before a single byte moves; an
+  OPEN breaker fails the pull in microseconds (``breaker_fast_fails``).
+- Every frame wait is bounded by ``frame_timeout_s`` and the whole pull
+  by ``total_timeout_s`` (env: ``DYN_KV_POOL_FRAME_TIMEOUT_S`` /
+  ``DYN_KV_POOL_PULL_TIMEOUT_S``) — a peer that stalls mid-stream costs
+  at most one frame budget, not a wedged request.
+- ANY failure — sever, stall, dtype mismatch, dead peer — falls back to
+  local recompute, which is always correct (the pull is a latency
+  optimization, never a correctness dependency). Already-imported blocks
+  from a partial pull still prefix-hit.
+
+Counters surface as ``kv_pool_*`` gauges (status_server.
+bind_kv_pool_gauges) on both backends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+from dynamo_tpu.runtime import chaos
+from dynamo_tpu.runtime.dataplane import BreakerOpenError
+from dynamo_tpu.tokens import compute_seq_hashes
+
+log = logging.getLogger("dynamo_tpu.kv_pool.peer")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw is not None else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class PeerPullStats:
+    """Shared counter shape for the jax client and the mocker mirror
+    (identical /metrics series on both backends)."""
+
+    pulls_attempted: int = 0
+    pulls_succeeded: int = 0
+    pulls_fallback: int = 0
+    blocks_pulled: int = 0
+    bytes_pulled: int = 0
+    pull_ms_total: float = 0.0
+    last_pull_ms: float = 0.0
+    breaker_fast_fails: int = 0
+    dtype_mismatches: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "pulls_attempted": self.pulls_attempted,
+            "pulls_succeeded": self.pulls_succeeded,
+            "pulls_fallback": self.pulls_fallback,
+            "blocks_pulled": self.blocks_pulled,
+            "bytes_pulled": self.bytes_pulled,
+            "pull_ms_total": round(self.pull_ms_total, 3),
+            "last_pull_ms": round(self.last_pull_ms, 3),
+            "breaker_fast_fails": self.breaker_fast_fails,
+            "dtype_mismatches": self.dtype_mismatches,
+        }
+
+
+class PeerKvClient:
+    def __init__(
+        self,
+        core,
+        fetch_client,
+        frame_timeout_s: float | None = None,
+        total_timeout_s: float | None = None,
+        chunk_blocks: int = 32,
+    ):
+        self.core = core
+        self.fetch_client = fetch_client
+        self.frame_timeout_s = (
+            frame_timeout_s
+            if frame_timeout_s is not None
+            else _env_float("DYN_KV_POOL_FRAME_TIMEOUT_S", 10.0)
+        )
+        self.total_timeout_s = (
+            total_timeout_s
+            if total_timeout_s is not None
+            else _env_float("DYN_KV_POOL_PULL_TIMEOUT_S", 30.0)
+        )
+        self.chunk_blocks = chunk_blocks
+        self.stats = PeerPullStats()
+
+    async def pull_prefix(self, hint: dict, token_ids: list[int]) -> int:
+        """Pull the peer's cached prefix of ``token_ids`` that this worker
+        is missing; returns blocks imported. Best-effort by contract —
+        every failure path logs, counts, and returns what landed so the
+        caller proceeds to (partial) local recompute."""
+        core = self.core
+        bs = core.engine.block_size
+        hashes = compute_seq_hashes(token_ids, bs)
+        cached = await asyncio.to_thread(core.cached_prefix_tokens, token_ids)
+        start = cached // bs
+        want = hashes[start:]
+        if not want:
+            return 0
+        st = self.stats
+        st.pulls_attempted += 1
+        t0 = time.monotonic()
+        deadline = t0 + self.total_timeout_s
+        # Defaults overridden by the server's geometry frame (a peer on a
+        # different float precision reports its own dtype; import_blocks
+        # casts floats — an int8-vs-float mismatch fails the import FAST
+        # per the PR 8 contract and the pull degrades to recompute).
+        shape = [
+            core.cfg.num_layers, bs, 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
+        ]
+        dtype = core.kv_wire_dtype
+        imported = 0
+        ok = False
+        try:
+            if chaos.active():
+                await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
+            stream = await self.fetch_client.direct(
+                hint["worker_id"], {"hashes": want, "chunk_blocks": self.chunk_blocks}
+            )
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(
+                        f"peer pull exceeded {self.total_timeout_s:.1f}s"
+                    )
+                try:
+                    frame = await asyncio.wait_for(
+                        stream.__anext__(),
+                        min(self.frame_timeout_s, remaining),
+                    )
+                except StopAsyncIteration:
+                    break
+                if "shape" in frame:
+                    shape = list(frame["shape"])
+                    dtype = frame["dtype"]
+                if "kv" not in frame:
+                    continue
+                s = frame["start"]
+                blocks = []
+                for j, kv in enumerate(frame["kv"]):
+                    gi = start + s + j
+                    blocks.append({
+                        "hash": hashes[gi],
+                        "parent": hashes[gi - 1] if gi > 0 else None,
+                        "shape": shape,
+                        "dtype": dtype,
+                        "kv": kv,
+                    })
+                    st.bytes_pulled += len(kv)
+                res = await asyncio.to_thread(core.import_blocks, blocks)
+                imported += res.imported
+            ok = True
+        except BreakerOpenError:
+            # The breaker already knows this peer is bad: fail in
+            # microseconds, recompute locally, let the half-open probe
+            # decide when pulls resume.
+            st.breaker_fast_fails += 1
+            log.info(
+                "peer pull from worker %s skipped: circuit breaker open",
+                hint.get("worker_id"),
+            )
+        except ValueError as e:
+            # import_blocks' fail-fast contract (dtype/geometry mismatch):
+            # re-quantizing or resegmenting would break bit-stability, so
+            # a mixed-dtype fleet pull degrades to recompute immediately.
+            st.dtype_mismatches += 1
+            log.warning(
+                "peer pull from worker %s refused by import contract: %s",
+                hint.get("worker_id"), e,
+            )
+        except Exception:  # noqa: BLE001 — recompute is always correct
+            log.warning(
+                "peer prefix pull from worker %s failed; recomputing locally",
+                hint.get("worker_id"), exc_info=True,
+            )
+        elapsed_ms = (time.monotonic() - t0) * 1e3
+        st.pull_ms_total += elapsed_ms
+        st.last_pull_ms = elapsed_ms
+        st.blocks_pulled += imported
+        if ok:
+            st.pulls_succeeded += 1
+            log.debug(
+                "pulled %d prefix blocks from peer worker %s in %.1f ms",
+                imported, hint.get("worker_id"), elapsed_ms,
+            )
+        else:
+            st.pulls_fallback += 1
+        return imported
+
+    def pool_stats(self) -> dict:
+        """kv_pool_* gauge payload for this worker's pull side."""
+        return self.stats.as_dict()

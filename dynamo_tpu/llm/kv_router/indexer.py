@@ -148,34 +148,65 @@ class RadixTree:
 
 class KvIndexer:
     """Event-driven indexer: subscribes to the kv_events subject and applies
-    events to its radix tree on a single task.
+    events to its global index on a single task.
 
-    Uses the C++ tree (native/radix_tree.cpp via ctypes) when the toolchain
-    can provide it — find_matches is the router's per-request hot loop —
+    The index is a :class:`~dynamo_tpu.llm.kv_pool.global_index.
+    GlobalKvIndex` — the tier-composing cluster-pool view — wrapping a
+    radix tree for the per-request overlap hot loop. Uses the C++ tree
+    (native/radix_tree.cpp via ctypes) when the toolchain can provide it,
     falling back to the Python tree (`DYNAMO_TPU_NO_NATIVE=1` forces the
-    fallback)."""
+    fallback).
 
-    def __init__(self, store, subject: str):
+    Anti-entropy: when the index detects a per-worker event-id GAP (the
+    worker's bounded publisher dropped events), the indexer publishes a
+    resync request on ``resync_subject``; the worker answers with a
+    ``cleared`` + full-inventory re-publish."""
+
+    def __init__(self, store, subject: str, resync_subject: str | None = None):
         import os
+
+        from dynamo_tpu.llm.kv_pool.global_index import GlobalKvIndex
 
         self._store = store
         self._subject = subject
-        self.tree: RadixTree
+        self._resync_subject = resync_subject
+        inner: RadixTree
         if os.environ.get("DYNAMO_TPU_NO_NATIVE"):
-            self.tree = RadixTree()
+            inner = RadixTree()
         else:
             try:
                 from dynamo_tpu.llm.kv_router.native_radix import NativeRadixTree
 
-                self.tree = NativeRadixTree()  # type: ignore[assignment]
+                inner = NativeRadixTree()  # type: ignore[assignment]
             except (RuntimeError, OSError):
-                self.tree = RadixTree()
+                inner = RadixTree()
+        self.tree = GlobalKvIndex(inner, on_gap=self._request_resync)
         self._task: asyncio.Task | None = None
         self._sub = None
         # Worker ids seen in events — tree-implementation-agnostic (the
         # native tree has no workers() enumeration); used by replica-sync
         # bootstrap dumps.
         self.known_workers: set[int] = set()
+
+    def _request_resync(self, worker_id: int) -> None:
+        """Ask a gapped worker for its full inventory (fire-and-forget —
+        the request is an optimization; the stale entries also age out
+        with the worker's lease)."""
+        if self._resync_subject is None:
+            return
+        import msgpack
+
+        from dynamo_tpu.runtime.tasks import spawn_logged
+
+        async def _send() -> None:
+            try:
+                await self._store.publish(
+                    self._resync_subject, msgpack.packb({"w": worker_id})
+                )
+            except ConnectionError:
+                log.warning("kv resync request publish failed (store down?)")
+
+        spawn_logged(_send(), name="kv-resync-request", logger=log)
 
     async def start(self) -> None:
         self._sub = await self._store.subscribe(self._subject)

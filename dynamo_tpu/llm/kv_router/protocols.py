@@ -22,8 +22,21 @@ import msgpack
 def kv_events_subject(namespace: str, component: str) -> str:
     return f"kv_events.{namespace}.{component}"
 
+def kv_resync_subject(namespace: str, component: str) -> str:
+    """Anti-entropy channel: an indexer that detected an event-id GAP for
+    a worker publishes ``{"w": worker_id}`` here; that worker's publisher
+    answers with a full-inventory re-publish (cleared + stored events)."""
+    return f"kv_events_resync.{namespace}.{component}"
+
 def load_metrics_subject(namespace: str, component: str) -> str:
     return f"load_metrics.{namespace}.{component}"
+
+
+# KV residency tiers a block-hash event can describe. "device" doubles as
+# the worker-level tag: pre-tier publishers never set a tier, and untagged
+# wire events decode to "device", so old workers and new indexers (and
+# vice versa) stay compatible.
+KV_TIERS = ("device", "host", "disk")
 
 
 @dataclass(frozen=True)
@@ -32,11 +45,16 @@ class KvCacheEvent:
 
     ``stored``: ``block_hashes`` are chained seq hashes appended under
     ``parent_hash`` (None = sequence roots). ``removed``: hashes evicted.
+    ``tier`` says WHICH residency tier the transition happened on
+    (device HBM / host RAM / disk); the cluster-wide pool index composes
+    per-worker tier sets, and a worker "holds" a block while ANY tier
+    does. Untagged (legacy) events are device-tier.
     """
 
     op: str  # "stored" | "removed" | "cleared"
     block_hashes: tuple[int, ...] = ()
     parent_hash: int | None = None
+    tier: str = "device"
 
 
 @dataclass(frozen=True)
@@ -46,15 +64,18 @@ class RouterEvent:
     event: KvCacheEvent
 
     def to_wire(self) -> bytes:
-        return msgpack.packb(
-            {
-                "w": self.worker_id,
-                "i": self.event_id,
-                "op": self.event.op,
-                "h": list(self.event.block_hashes),
-                "p": self.event.parent_hash,
-            }
-        )
+        d = {
+            "w": self.worker_id,
+            "i": self.event_id,
+            "op": self.event.op,
+            "h": list(self.event.block_hashes),
+            "p": self.event.parent_hash,
+        }
+        if self.event.tier != "device":
+            # Device-tier events travel untagged — byte-compatible with
+            # every pre-tier consumer (and most events are device-tier).
+            d["t"] = self.event.tier
+        return msgpack.packb(d)
 
     @classmethod
     def from_wire(cls, raw: bytes) -> "RouterEvent":
@@ -62,7 +83,12 @@ class RouterEvent:
         return cls(
             worker_id=d["w"],
             event_id=d["i"],
-            event=KvCacheEvent(op=d["op"], block_hashes=tuple(d["h"]), parent_hash=d["p"]),
+            event=KvCacheEvent(
+                op=d["op"],
+                block_hashes=tuple(d["h"]),
+                parent_hash=d["p"],
+                tier=d.get("t", "device"),
+            ),
         )
 
 

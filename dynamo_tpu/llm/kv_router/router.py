@@ -19,7 +19,11 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu import tracing
 from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer, KvIndexer
-from dynamo_tpu.llm.kv_router.protocols import RouterConfig, kv_events_subject
+from dynamo_tpu.llm.kv_router.protocols import (
+    RouterConfig,
+    kv_events_subject,
+    kv_resync_subject,
+)
 from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector, SelectionResult
 from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
 from dynamo_tpu.runtime.component import EndpointClient, NoInstancesError
@@ -54,7 +58,9 @@ class KvRouter:
         self.selector = DefaultWorkerSelector()
         if self.config.use_kv_events:
             self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(
-                store, kv_events_subject(namespace, component)
+                store,
+                kv_events_subject(namespace, component),
+                resync_subject=kv_resync_subject(namespace, component),
             )
         else:
             self.indexer = ApproxKvIndexer()

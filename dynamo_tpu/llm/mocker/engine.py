@@ -98,6 +98,13 @@ class MockEngineArgs:
     # VALUES never change — only the virtual clock and capacity move.
     kv_dtype: str = "bf16"
     kv_read_us_per_block: float = 0.0
+    # Cluster KV pool (ISSUE 11): virtual-clock price of pulling ONE
+    # bf16-equivalent KV block from a peer over the dataplane, scaled by
+    # the kv_dtype's byte ratio (int8 pulls move ~0.52x the bytes — the
+    # packed wire buffer IS the transfer format). 0 = pulls are free on
+    # the clock (legacy timing untouched); bench run_peer_pool_ab sets it
+    # for the shared-prefix fleet A/B.
+    kv_pull_us_per_block: float = 0.0
     # Overload robustness (mirrors EngineConfig, ISSUE 10): per-tenant
     # DRR fair admission (off = exact FIFO; single tenant is FIFO either
     # way, so streams stay bit-identical), the DRR quantum (0 = token
@@ -188,6 +195,11 @@ class MockTpuEngine:
         # + f32 scales ~0.516x at the nominal head_dim 128).
         self._kv_byte_ratio = kv_byte_ratio(self.args.kv_dtype)
         self._last_kv_blocks_read = 0
+        # Cluster-pool peer-pull accounting (kv_pool_* gauges; same
+        # counter shape as the jax worker's PeerKvClient).
+        from dynamo_tpu.llm.kv_pool import PeerPullStats
+
+        self.peer_stats = PeerPullStats()
         self._spec_default = (
             SpecConfig(k=self.args.spec_k)
             if self.args.spec_decode != "off"
@@ -442,6 +454,43 @@ class MockTpuEngine:
         """Per-tenant queue depth + DRR deficit snapshot, same shape as
         EngineCore.fair_queue_stats (status-server tenant gauges)."""
         return self._waiting.stats()
+
+    # -- cluster KV pool mirror (ISSUE 11) ---------------------------------
+
+    def import_peer_blocks(
+        self, hashes: list[int], parents: list[int | None]
+    ) -> tuple[int, float]:
+        """Register peer-pulled block hashes as locally cached and price
+        the transfer: returns (blocks imported, virtual-clock seconds the
+        pull costs). The cost models the dataplane copy of the canonical
+        packed buffer — per-block microseconds x the kv_dtype byte ratio
+        (int8 ≈ 0.52x) — so shared-prefix TTFT A/Bs carry the transfer
+        price, not just the win. Token values never change: an imported
+        prefix only turns recompute into a prefix-cache hit."""
+        from dynamo_tpu.engine.kv_quant import kv_page_bytes
+
+        imported = 0
+        for h, parent in zip(hashes, parents):
+            if self.kv.import_block(h, parent):
+                imported += 1
+        cost_s = (
+            imported
+            * self.args.kv_pull_us_per_block
+            * self._kv_byte_ratio
+            / 1e6
+            / self.args.speedup_ratio
+        )
+        self.peer_stats.blocks_pulled += imported
+        self.peer_stats.bytes_pulled += imported * kv_page_bytes(
+            32, self.args.block_size, 8, 128, self.args.kv_dtype
+        )
+        return imported, cost_s
+
+    def kv_pool_stats(self) -> dict:
+        """kv_pool_* gauge payload, same keys as the jax worker's
+        PeerKvClient.pool_stats() + KvEventPublisher.stats() merge (the
+        publisher half is merged in by run_mocker, which owns it)."""
+        return self.peer_stats.as_dict()
 
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(

@@ -164,6 +164,43 @@ class MockKvManager:
                     self.stats.removed_events += 1
                     self.on_removed([h])
 
+    def held_prefix(self, seq_hashes: list[int]) -> list[int]:
+        """Contiguous leading hashes this worker can serve (active or
+        inactive), WITHOUT touching the prefix-probe counters — the peer
+        kv_fetch server's read, mirroring EngineCore.read_cached_pages'
+        'longest locally-held prefix' contract."""
+        held: list[int] = []
+        for h in seq_hashes:
+            if h in self._active or h in self._inactive:
+                held.append(h)
+            else:
+                break
+        return held
+
+    def import_block(self, block_hash: int, parent_hash: int | None) -> bool:
+        """Register peer-pulled content as cached-but-unpinned (inactive
+        LRU) — the mocker twin of DeviceBlockAllocator.register_inactive.
+        Returns True when the block was actually imported (False: already
+        cached, or the pool cannot make headroom)."""
+        if block_hash in self._active or block_hash in self._inactive:
+            return False
+        try:
+            self._ensure_headroom(1)
+        except InsufficientBlocksError:
+            return False
+        self._inactive[block_hash] = _Block(block_hash, parent_hash)
+        self._inactive.move_to_end(block_hash)
+        self.stats.stored_events += 1
+        self.on_stored([block_hash], parent_hash)
+        return True
+
+    def snapshot(self) -> list[tuple[int, int | None]]:
+        """(hash, parent) for every cached block — the mocker's
+        anti-entropy resync inventory (single device tier)."""
+        out = [(h, b.parent_hash) for h, b in self._active.items()]
+        out += [(h, b.parent_hash) for h, b in self._inactive.items()]
+        return out
+
     def clear_unpinned(self) -> list[int]:
         """Drop only the inactive (unpinned) cache — in-flight sequences
         keep their blocks; emits `removed` for the router. The admin

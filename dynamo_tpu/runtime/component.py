@@ -97,6 +97,12 @@ class DistributedRuntime:
         # path deregisters them from discovery before anything else.
         self._served: list[tuple["Endpoint", int]] = []
         self._draining = False
+        # Drain-time retraction hooks (async callables), run right after
+        # discovery deregistration: workers append their published-state
+        # retractions here — e.g. the KV inventory `cleared` event — so
+        # routers stop serving stale hints NOW instead of at lease expiry
+        # (ISSUE 11 satellite: drain used to leave the KV index stale).
+        self.on_drain: list[Callable[[], Any]] = []
 
     @classmethod
     async def create(
@@ -154,6 +160,14 @@ class DistributedRuntime:
                 await ep.deregister(instance_id)
             except (ConnectionError, StoreError):
                 log.warning("drain: deregister %s failed", ep.path, exc_info=True)
+        # Published-state retraction (KV inventory `cleared`, ...): after
+        # deregistration so no new routes target us, before the lease
+        # revoke so the events still reach the store.
+        for cb in list(self.on_drain):
+            try:
+                await cb()
+            except Exception:  # noqa: BLE001 — retraction is best-effort; lease expiry is the backstop
+                log.warning("drain: retraction hook failed", exc_info=True)
         completed = True
         if self._ingress_started:
             completed = await self.ingress.drain(timeout)

@@ -253,6 +253,88 @@ def bind_kv_cache_gauges(
         ).set(1.0)
 
 
+# Cluster KV pool gauges (ISSUE 11): the worker's peer-pull outcomes and
+# its published global-index contribution. Keys match
+# PeerKvClient.pool_stats() + KvEventPublisher.stats() on the jax backend
+# and MockTpuEngine.kv_pool_stats() on the mocker — identical series on
+# both, like every other gauge family here.
+KV_POOL_GAUGES: dict[str, tuple[str, str]] = {
+    "pulls_attempted": (
+        "kv_pool_peer_pulls_attempted_total",
+        "Peer prefix pulls started (router hinted a better-overlapping peer)",
+    ),
+    "pulls_succeeded": (
+        "kv_pool_peer_pulls_succeeded_total",
+        "Peer pulls that streamed to completion (imported blocks prefix-hit)",
+    ),
+    "pulls_fallback": (
+        "kv_pool_peer_pulls_fallback_total",
+        "Peer pulls that degraded to local recompute (sever/stall/dead "
+        "peer/dtype mismatch — never a stalled request)",
+    ),
+    "blocks_pulled": (
+        "kv_pool_blocks_pulled_total",
+        "KV blocks imported from peers since start",
+    ),
+    "bytes_pulled": (
+        "kv_pool_bytes_pulled_total",
+        "KV page bytes received from peers (canonical packed wire buffer)",
+    ),
+    "last_pull_ms": (
+        "kv_pool_last_pull_latency_ms",
+        "Wall-clock latency of the most recent peer pull",
+    ),
+    "pull_ms_total": (
+        "kv_pool_pull_latency_ms_total",
+        "Cumulative peer-pull wall-clock milliseconds",
+    ),
+    "breaker_fast_fails": (
+        "kv_pool_breaker_fast_fails_total",
+        "Peer pulls refused in microseconds by an open dataplane circuit "
+        "breaker (recompute instead of burning a connect timeout)",
+    ),
+    "dtype_mismatches": (
+        "kv_pool_dtype_mismatch_total",
+        "Peer pulls refused by the kv_dtype fail-fast contract (mixed "
+        "int8/float fleet; re-quantizing would break bit-stability)",
+    ),
+    "published_blocks": (
+        "kv_pool_published_blocks",
+        "Net blocks this worker currently advertises to the global index "
+        "(its stored-minus-removed contribution, all tiers)",
+    ),
+    "events_dropped": (
+        "kv_events_dropped_total",
+        "KV events dropped by the bounded publisher buffer (each schedules "
+        "an anti-entropy full-inventory resync)",
+    ),
+    "events_published": (
+        "kv_events_published_total",
+        "KV events published to the control plane since start",
+    ),
+    "resyncs": (
+        "kv_events_resyncs_total",
+        "Full-inventory re-publishes (after buffer overflow or an "
+        "indexer-requested resync)",
+    ),
+}
+
+
+def bind_kv_pool_gauges(
+    status: "SystemStatusServer | None", kv_pool_stats: Callable[[], dict]
+) -> None:
+    """Export a worker's cluster-KV-pool gauges on /metrics (same
+    scrape-time evaluation as the scheduler gauges). No-op when the
+    status server is disabled."""
+    if status is None:
+        return
+    scoped = status.metrics.scoped(service="kv_pool")
+    for key, (name, doc) in KV_POOL_GAUGES.items():
+        scoped.gauge(name, doc).set_function(
+            lambda k=key: float(kv_pool_stats().get(k, 0) or 0)
+        )
+
+
 # Per-tenant fair-queue gauges: queue depth and DRR deficit per tenant.
 # Tenant labels are dynamic (tenants appear as their first request
 # arrives), so these sync via a before_render hook like the egress

@@ -1,0 +1,591 @@
+"""Cluster-wide prefix KV pool (ISSUE 11).
+
+Unit surface: tier-tagged event wire compat, the tier-composing
+GlobalKvIndex (a worker stays routable while ANY tier holds a block),
+the bounded KV event publisher (visible drops + anti-entropy resync),
+and the indexer→publisher resync request round trip over a real store.
+
+Engine surface: a tiny jax EngineCore with host+disk tiers wired
+tier-aware — the composed index never loses the worker's prefix while
+the worker can still serve it, across demotion and onboarding.
+
+Fleet surface: two real mocker workers behind the real frontend router —
+the peer pull serves a rerouted request's prefill, chaos (sever / stall
+/ dead peer) degrades every pull to local recompute with BIT-IDENTICAL
+streams and populated fallback/breaker counters, and a graceful drain
+retracts the worker's published inventory immediately (not at lease
+expiry).
+"""
+
+import asyncio
+import os
+from contextlib import suppress
+
+import pytest
+
+from dynamo_tpu.llm.kv_pool import GlobalKvIndex, PeerPullStats
+from dynamo_tpu.llm.kv_router.protocols import (
+    KvCacheEvent,
+    RouterEvent,
+    kv_events_subject,
+    kv_resync_subject,
+)
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+pytestmark = [pytest.mark.integration, pytest.mark.pre_merge]
+
+
+def ev(worker, eid, op, hashes=(), parent=None, tier="device"):
+    return RouterEvent(
+        worker, eid, KvCacheEvent(op=op, block_hashes=tuple(hashes),
+                                  parent_hash=parent, tier=tier)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wire compat
+# ---------------------------------------------------------------------------
+
+
+def test_tier_rides_the_wire_and_legacy_events_decode_device():
+    e = ev(7, 1, "stored", [11, 12], parent=None, tier="disk")
+    back = RouterEvent.from_wire(e.to_wire())
+    assert back.event.tier == "disk"
+    assert back.event.block_hashes == (11, 12)
+    # Device-tier events travel untagged: byte-identical to the pre-tier
+    # wire format, so old consumers parse new workers and vice versa.
+    legacy = ev(7, 2, "stored", [13])
+    assert b"disk" not in legacy.to_wire() and b"t" not in legacy.to_wire()[:1]
+    assert RouterEvent.from_wire(legacy.to_wire()).event.tier == "device"
+
+
+# ---------------------------------------------------------------------------
+# GlobalKvIndex composition
+# ---------------------------------------------------------------------------
+
+
+def test_index_composes_tiers_worker_survives_demotion():
+    idx = GlobalKvIndex()
+    idx.apply_event(ev(1, 1, "stored", [10], None))
+    idx.apply_event(ev(1, 2, "stored", [20], 10))
+    assert idx.find_matches([10, 20]) == {1: 2}
+    # Demotion: stored(host) then removed(device) — the worker never
+    # stops matching (it can still serve the block from host).
+    idx.apply_event(ev(1, 3, "stored", [10], None, tier="host"))
+    idx.apply_event(ev(1, 4, "removed", [10], tier="device"))
+    assert idx.find_matches([10, 20]) == {1: 2}
+    assert idx.holders(10) == {1: {"host"}}
+    # Host→disk demotion keeps it matched too.
+    idx.apply_event(ev(1, 5, "stored", [10], None, tier="disk"))
+    idx.apply_event(ev(1, 6, "removed", [10], tier="host"))
+    assert idx.find_matches([10, 20]) == {1: 2}
+    # The LAST tier letting go retracts the worker: the prefix chain is
+    # broken at depth 1, so nothing matches (block 20 is still held —
+    # truthfully in the ledger — but unreachable as a prefix).
+    idx.apply_event(ev(1, 7, "removed", [10], tier="disk"))
+    assert idx.find_matches([10, 20]) == {}
+    assert idx.holders(10) == {}
+    idx.apply_event(ev(1, 8, "removed", [20], tier="device"))
+    assert idx.num_blocks(1) == 0
+
+
+def test_index_host_only_inventory_still_matches():
+    # A resync snapshot can legitimately publish a block that lives ONLY
+    # in an offload tier — it is still servable (peer pull onboards it).
+    idx = GlobalKvIndex()
+    idx.apply_event(ev(3, 1, "stored", [10], None, tier="host"))
+    assert idx.find_matches([10]) == {3: 1}
+
+
+def test_index_cleared_and_remove_worker_retire_everything():
+    idx = GlobalKvIndex()
+    for w in (1, 2):
+        idx.apply_event(ev(w, 1, "stored", [10], None))
+        idx.apply_event(ev(w, 2, "stored", [10], None, tier="host"))
+    idx.apply_event(ev(1, 3, "cleared"))
+    assert idx.find_matches([10]) == {2: 1}
+    assert idx.num_blocks(1) == 0
+    idx.remove_worker(2)
+    assert idx.find_matches([10]) == {}
+    assert idx.stats()["index_blocks"] == 0
+
+
+def test_index_gap_detection_requests_resync():
+    gaps: list[int] = []
+    idx = GlobalKvIndex(on_gap=gaps.append)
+    idx.apply_event(ev(5, 1, "stored", [10], None))
+    idx.apply_event(ev(5, 2, "stored", [20], 10))
+    idx.apply_event(ev(5, 2, "stored", [20], 10))  # duplicate: ignored
+    assert gaps == [] and idx.gaps_detected == 0
+    idx.apply_event(ev(5, 9, "stored", [30], 20))  # ids 3..8 lost
+    assert gaps == [5] and idx.gaps_detected == 1
+    # The gapped event itself still applies (best effort until resync).
+    assert idx.find_matches([10, 20, 30]) == {5: 3}
+
+
+def test_index_dump_round_trips_tiers():
+    idx = GlobalKvIndex()
+    idx.apply_event(ev(4, 1, "stored", [10, 20], None))
+    idx.apply_event(ev(4, 2, "stored", [10], None, tier="host"))
+    idx.apply_event(ev(4, 3, "removed", [10], tier="device"))
+    fresh = GlobalKvIndex()
+    for e in idx.dump_as_events(4):
+        assert e.event_id == 0, "bootstrap events must be unsequenced"
+        fresh.apply_event(e)
+    assert fresh.find_matches([10, 20]) == idx.find_matches([10, 20]) == {4: 2}
+    assert fresh.holders(10) == idx.holders(10) == {4: {"host"}}
+    # The dump must NOT poison the replica's live-id dedup: the worker's
+    # next real events (low ids — lower than the dump's entry count in
+    # the old numbering) still apply, including removals.
+    fresh.apply_event(ev(4, 4, "removed", [10], tier="host"))
+    assert fresh.find_matches([10, 20]) == {}
+    fresh.apply_event(ev(4, 5, "stored", [30], None))
+    assert fresh.find_matches([30]) == {4: 1}
+
+
+# ---------------------------------------------------------------------------
+# Bounded publisher + anti-entropy
+# ---------------------------------------------------------------------------
+
+
+class FakeStore:
+    def __init__(self):
+        self.published: list[tuple[str, bytes]] = []
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        self.published.append((subject, payload))
+
+    def events(self):
+        return [RouterEvent.from_wire(p) for _s, p in self.published]
+
+
+async def test_publisher_orders_tags_and_accounts():
+    store = FakeStore()
+    pub = KvEventPublisher(store, "ns", "c", worker_id=9)
+    pub.stored_nowait([1], None)
+    pub.stored_nowait([1], None, "host")
+    pub.removed_nowait([1], "device")
+    assert await pub.flush()
+    evs = store.events()
+    assert [(e.event.op, e.event.tier) for e in evs] == [
+        ("stored", "device"), ("stored", "host"), ("removed", "device"),
+    ]
+    assert [e.event_id for e in evs] == [1, 2, 3]
+    st = pub.stats()
+    assert st["events_published"] == 3 and st["events_dropped"] == 0
+    assert st["published_blocks"] == 1  # net: host copy is the survivor
+    assert st["published_host_blocks"] == 1
+
+
+async def test_publisher_overflow_drops_visibly_and_resyncs():
+    store = FakeStore()
+    pub = KvEventPublisher(store, "ns", "c", worker_id=9, buffer=2)
+    inventory = [("device", 100, None), ("host", 200, 100)]
+    pub.inventory_source = lambda: inventory
+    # Enqueue a burst with the drain task never scheduled yet (no await
+    # between calls): the buffer holds 2, the rest drop visibly.
+    for i in range(6):
+        pub.stored_nowait([i + 1], None)
+    assert pub.events_dropped_total > 0
+    assert await pub.flush()
+    assert pub.resyncs_total == 1
+    evs = store.events()
+    # The resync supersedes the buffered backlog: cleared, then the full
+    # inventory with tier tags, and nothing stale after it.
+    assert evs[0].event.op == "cleared"
+    assert [(e.event.op, e.event.tier, e.event.block_hashes)
+            for e in evs[1:]] == [
+        ("stored", "device", (100,)), ("stored", "host", (200,)),
+    ]
+    # The composed result is exactly the inventory.
+    idx = GlobalKvIndex()
+    for e in evs:
+        idx.apply_event(e)
+    assert idx.find_matches([100, 200]) == {9: 2}
+    assert pub.stats()["published_blocks"] == 2
+
+
+async def test_publisher_resync_batches_chain_runs():
+    """A contiguous same-tier chain resyncs as ONE multi-hash event, not
+    one store round trip per block."""
+    store = FakeStore()
+    pub = KvEventPublisher(store, "ns", "c", worker_id=9, buffer=1)
+    pub.inventory_source = lambda: [
+        ("device", 1, None), ("device", 2, 1), ("device", 3, 2),
+        ("host", 4, 3), ("host", 9, None),
+    ]
+    pub.stored_nowait([50], None)
+    pub.stored_nowait([51], None)  # overflow -> resync
+    assert await pub.flush()
+    evs = store.events()
+    assert evs[0].event.op == "cleared"
+    assert [(e.event.tier, e.event.block_hashes, e.event.parent_hash)
+            for e in evs[1:]] == [
+        ("device", (1, 2, 3), None), ("host", (4,), 3), ("host", (9,), None),
+    ]
+
+
+async def test_resync_request_round_trip_over_store():
+    """An indexer that sees an event-id gap publishes a resync request;
+    the worker's publisher answers with cleared + full inventory and the
+    index converges — the anti-entropy loop end to end."""
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.runtime.store.client import StoreClient
+
+    store = StoreServer()
+    await store.start()
+    pub_client = await StoreClient.open(store.address)
+    idx_client = await StoreClient.open(store.address)
+    try:
+        subject = kv_events_subject("ns", "c")
+        pub = KvEventPublisher(pub_client, "ns", "c", worker_id=3)
+        pub.inventory_source = lambda: [("device", 100, None), ("disk", 200, 100)]
+        await pub.start()
+        indexer = KvIndexer(idx_client, subject,
+                            resync_subject=kv_resync_subject("ns", "c"))
+        await indexer.start()
+
+        pub.stored_nowait([100], None)
+        await pub.flush()
+        # Manufacture a gap: events 2..4 vanish (as if dropped upstream).
+        pub._event_id += 3
+        pub.stored_nowait([999], 100)
+        await pub.flush()
+
+        async def until(cond, timeout=10.0):
+            for _ in range(int(timeout / 0.05)):
+                if cond():
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        # Gap detected -> resync requested -> inventory re-published ->
+        # the index converges on the snapshot (999 was superseded).
+        assert await until(lambda: pub.resyncs_total >= 1), "no resync ran"
+        assert await until(
+            lambda: indexer.find_matches([100, 200]) == {3: 2}
+            and indexer.find_matches([999]) == {}
+        ), f"index never converged: {indexer.find_matches([100, 200])}"
+        assert indexer.tree.gaps_detected >= 1
+        await indexer.stop()
+        await pub.stop()
+    finally:
+        for c in (pub_client, idx_client):
+            with suppress(ConnectionError, OSError):
+                await c.close()
+        await store.stop()
+
+
+# ---------------------------------------------------------------------------
+# Engine tier events (tiny jax core, host+disk tiers)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_tier_events_keep_composed_index_consistent(tmp_path):
+    """Wire a tiny EngineCore tier-aware and replay its event stream into
+    a GlobalKvIndex: across device eviction → host → disk demotion and
+    onboarding, the composed index scores the worker for the prompt
+    exactly while the worker can serve it — never a transient loss."""
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineCore, tiny_engine, tiny_model
+    from dynamo_tpu.tokens import compute_seq_hashes
+    from tests.test_engine_core import _req, run_to_completion
+
+    events: list[RouterEvent] = []
+    eid = [0]
+
+    def emit(op, hashes, parent, tier):
+        eid[0] += 1
+        events.append(ev(1, eid[0], op, hashes, parent, tier))
+
+    core = EngineCore(
+        tiny_model(),
+        tiny_engine(
+            num_kv_blocks=24, host_kv_blocks=8,
+            disk_kv_dir=str(tmp_path / "disk"), disk_kv_blocks=64,
+            max_model_len=128,
+        ),
+        seed=0,
+        on_stored=lambda hs, p: emit("stored", hs, p, "device"),
+        on_removed=lambda hs: emit("removed", hs, None, "device"),
+        on_tier_stored=lambda hs, p, tier: emit("stored", hs, p, tier),
+        on_tier_removed=lambda hs, tier: emit("removed", hs, None, tier),
+    )
+    prompt = list(range(7, 7 + 40))
+    hashes = compute_seq_hashes(prompt, core.engine.block_size)
+    s1 = core.add_request(_req(prompt, "a", max_tokens=4))
+    ref, _ = run_to_completion(core, [s1])
+
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        seqs = [core.add_request(
+            _req(list(rng.randint(1, 300, size=40)), f"n{i}", max_tokens=4))]
+        run_to_completion(core, seqs)
+    core.offload.flush()
+    assert core.host_pool.stats.offloads > 0
+
+    idx = GlobalKvIndex()
+    for e in events:
+        idx.apply_event(e)
+    n_prompt = len([h for h in hashes if h in dict.fromkeys(hashes)])
+    got = idx.find_matches(hashes)
+    # The worker still serves the whole prompt prefix (device or tiers) —
+    # and the composed index agrees.
+    assert got.get(1, 0) == len(hashes), (got, len(hashes), n_prompt)
+    host_or_disk = [
+        h for h in hashes if "host" in idx.holders(h).get(1, set())
+        or "disk" in idx.holders(h).get(1, set())
+    ]
+    assert host_or_disk, "nothing demoted to the offload tiers"
+
+    # Onboard: rerunning the prompt promotes tiers back to device; the
+    # index must still match and the output must be unchanged.
+    s2 = core.add_request(_req(prompt, "b", max_tokens=4))
+    d2, _ = run_to_completion(core, [s2])
+    assert d2["b"] == ref["a"]
+    idx2 = GlobalKvIndex()
+    for e in events:
+        idx2.apply_event(e)
+    assert idx2.find_matches(hashes).get(1, 0) == len(hashes)
+
+    # The resync snapshot composes to the same worker-level answer.
+    snap = core.kv_inventory()
+    idx3 = GlobalKvIndex()
+    fid = 0
+    for tier, h, parent in snap:
+        fid += 1
+        idx3.apply_event(ev(1, fid, "stored", [h], parent, tier))
+    assert idx3.find_matches(hashes).get(1, 0) == len(hashes)
+
+
+# ---------------------------------------------------------------------------
+# Mocker fleet: peer pull + chaos degradation + drain retraction
+# ---------------------------------------------------------------------------
+
+
+class MockPoolFleet:
+    """Two run_mocker workers (full worker wiring: kv_fetch endpoint,
+    peer pull, publisher) + the real KV frontend router."""
+
+    def __init__(self, n: int = 2, **args_kw):
+        from dynamo_tpu.llm.mocker import MockEngineArgs
+
+        self.n = n
+        self.args = MockEngineArgs(
+            num_kv_blocks=512, block_size=8, speedup_ratio=50.0,
+            kv_pull_us_per_block=5.0, **args_kw,
+        )
+
+    async def __aenter__(self) -> "MockPoolFleet":
+        from dynamo_tpu.backends.mocker import run_mocker
+        from dynamo_tpu.frontend.main import run_frontend
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.store import StoreServer
+
+        self.store = StoreServer()
+        await self.store.start()
+        self.runtimes: list[DistributedRuntime] = []
+        self.worker_ids: list[int] = []
+        self.engines: list = []
+        self.tasks: list[asyncio.Task] = []
+        for _ in range(self.n):
+            rt = await DistributedRuntime.create(self.store.address)
+            served = asyncio.Event()
+            self.tasks.append(asyncio.create_task(run_mocker(
+                rt, model_name="mock", engine_args=self.args,
+                served_event=served, engine_out=self.engines,
+            )))
+            await asyncio.wait_for(served.wait(), 15)
+            self.runtimes.append(rt)
+            self.worker_ids.append(rt.primary_lease_id)
+        front_rt = await DistributedRuntime.create(self.store.address)
+        self.front_rt = front_rt
+        ready = asyncio.Event()
+        services: list = []
+        self.tasks.append(asyncio.create_task(run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )))
+        await asyncio.wait_for(ready.wait(), 15)
+        self.service = services[0]
+        for _ in range(200):
+            served_model = self.service.manager.get("mock")
+            if served_model is not None and served_model.push_router is not None:
+                self.push = served_model.push_router
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared")
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        from dynamo_tpu.runtime import chaos
+
+        chaos.uninstall()
+        for rt in [self.front_rt] + self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.05)
+        for t in self.tasks:
+            t.cancel()
+        for rt in [self.front_rt] + self.runtimes:
+            with suppress(Exception):  # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
+                await rt.shutdown()
+        await self.store.stop()
+
+    async def route(self, prompt, rid, *, pinned=None, exclude=None,
+                    max_tokens=6):
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions,
+        )
+
+        pre = PreprocessedRequest(
+            model="mock", token_ids=list(prompt), request_id=rid,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=max_tokens),
+        )
+        kw = {}
+        if pinned is not None:
+            kw["router_overrides"] = {"backend_instance_id": pinned}
+        if exclude is not None:
+            kw["exclude"] = exclude
+        toks = []
+        async for out in self.push.generate(
+            pre.to_wire(), rid, list(prompt), **kw
+        ):
+            toks.extend(out.get("token_ids") or [])
+        self.push.router.free(rid)
+        return toks
+
+
+def _pool_gauges(metrics_text: str) -> dict:
+    out = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("dynamo_kv_pool_") or line.startswith("dynamo_kv_events_"):
+            name = line.split("{")[0].split(" ")[0]
+            out[name] = float(line.rsplit(None, 1)[-1])
+    return out
+
+
+PROMPT = list(range(1, 90))  # 11 complete 8-token blocks
+
+
+async def test_mocker_peer_pull_serves_rerouted_prefill():
+    async with MockPoolFleet() as f:
+        a = f.worker_ids[0]
+        want = await f.route(PROMPT, "seed", pinned=a)
+        assert len(want) == 6
+        got = await f.route(PROMPT, "reroute", exclude={a})
+        assert got == want, "peer-pulled stream diverged"
+        st = f.engines[1].peer_stats
+        assert st.pulls_attempted == 1 and st.pulls_succeeded == 1
+        assert st.pulls_fallback == 0
+        assert st.blocks_pulled == 11  # the prompt's complete blocks
+        assert st.bytes_pulled > 0 and st.last_pull_ms >= 0.0
+        # The pull registered the prefix on the second worker: the next
+        # pinned run there is a pure prefix-cache hit (no new pull).
+        b = f.worker_ids[1]
+        got2 = await f.route(PROMPT, "warm", pinned=b)
+        assert got2 == want
+        assert st.pulls_attempted == 1
+
+
+async def test_mocker_peer_pull_sever_falls_back_bit_identical():
+    from dynamo_tpu.runtime import chaos
+    from dynamo_tpu.runtime.chaos import ChaosPlan, ChaosRule
+
+    async with MockPoolFleet() as f:
+        a = f.worker_ids[0]
+        want = await f.route(PROMPT, "seed", pinned=a)
+        chaos.install(ChaosPlan(rules=[
+            ChaosRule(point="kv_transfer.pull", action="sever", match=str(a)),
+        ]))
+        got = await f.route(PROMPT, "reroute", exclude={a})
+        assert got == want, "sever mid-pull broke the stream"
+        st = f.engines[1].peer_stats
+        assert st.pulls_fallback == 1 and st.pulls_succeeded == 0
+        assert st.blocks_pulled == 0
+
+
+async def test_mocker_peer_pull_stall_bounded_by_frame_deadline():
+    """Frames from the peer stop arriving mid-pull (dropped at the
+    dataplane): the per-frame deadline converts the stall into a local
+    recompute — the request completes bit-identically, well inside the
+    stall budget a wedged pull would have burned."""
+    import time as _time
+
+    from dynamo_tpu.runtime import chaos
+    from dynamo_tpu.runtime.chaos import ChaosPlan, ChaosRule
+
+    os.environ["DYN_KV_POOL_FRAME_TIMEOUT_S"] = "0.4"
+    try:
+        async with MockPoolFleet() as f:
+            a = f.worker_ids[0]
+            a_addr = f.runtimes[0].ingress.address
+            want = await f.route(PROMPT, "seed", pinned=a)
+            # Drop every response frame from A's ingress: the kv_fetch
+            # stream opens and then goes silent — the stall shape.
+            chaos.install(ChaosPlan(rules=[
+                ChaosRule(point="dataplane.recv", action="drop", match=a_addr),
+            ]))
+            t0 = _time.monotonic()
+            got = await f.route(PROMPT, "reroute", exclude={a})
+            elapsed = _time.monotonic() - t0
+            assert got == want, "stalled pull broke the stream"
+            assert elapsed < 5.0, (
+                f"fallback took {elapsed:.1f}s — the frame deadline did "
+                "not bound the stall"
+            )
+            assert f.engines[1].peer_stats.pulls_fallback == 1
+    finally:
+        os.environ.pop("DYN_KV_POOL_FRAME_TIMEOUT_S", None)
+
+
+async def test_mocker_peer_pull_dead_peer_falls_back():
+    """The hinted peer is gone (ingress down, lease still live so the
+    hint still points at it): the dial fails, the pull falls back, the
+    stream is served by local recompute bit-identically."""
+    async with MockPoolFleet() as f:
+        a = f.worker_ids[0]
+        want = await f.route(PROMPT, "seed", pinned=a)
+        await f.runtimes[0].ingress.stop()
+        got = await f.route(PROMPT, "reroute", exclude={a})
+        assert got == want, "dead-peer pull broke the stream"
+        assert f.engines[1].peer_stats.pulls_fallback == 1
+
+
+async def test_mocker_drain_retracts_published_inventory():
+    """Graceful drain publishes the worker-clear: an event-layer consumer
+    (KvIndexer with no instance watch) drops the worker's blocks the
+    moment the drain lands — NOT at lease expiry."""
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.runtime.store.client import StoreClient
+    from dynamo_tpu.tokens import compute_seq_hashes
+
+    async with MockPoolFleet(n=1) as f:
+        a = f.worker_ids[0]
+        idx_client = await StoreClient.open(f.store.address)
+        indexer = KvIndexer(idx_client, kv_events_subject("dynamo", "backend"))
+        await indexer.start()
+        try:
+            await f.route(PROMPT, "seed", pinned=a)
+            hashes = compute_seq_hashes(PROMPT, 8)
+            for _ in range(100):
+                if indexer.find_matches(hashes).get(a):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("worker inventory never indexed")
+            assert await f.runtimes[0].drain(timeout=2.0)
+            for _ in range(100):
+                if indexer.tree.num_blocks(a) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert indexer.tree.num_blocks(a) == 0, (
+                "drain left the worker's inventory in the index"
+            )
+        finally:
+            await indexer.stop()
+            with suppress(ConnectionError, OSError):
+                await idx_client.close()

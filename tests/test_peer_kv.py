@@ -27,12 +27,16 @@ pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
 
 
 class PeerCluster:
-    """Two aggregated jax workers with tiny device pools + host/disk
-    offload tiers, plus a frontend (KV routing)."""
+    """N aggregated jax workers with tiny device pools + host/disk
+    offload tiers, plus a frontend (KV routing). ``kv_dtype`` may be a
+    single dtype or a per-worker list (mixed-fleet tests)."""
 
-    def __init__(self, tmp_path, kv_dtype: str = "bf16"):
+    def __init__(self, tmp_path, kv_dtype: "str | list[str]" = "bf16", n: int = 2):
         self.tmp_path = tmp_path
-        self.kv_dtype = kv_dtype
+        self.n = n
+        self.kv_dtypes = (
+            list(kv_dtype) if isinstance(kv_dtype, list) else [kv_dtype] * n
+        )
         self.store = StoreServer()
         self.runtimes: list[DistributedRuntime] = []
         self.worker_ids: list[int] = []
@@ -43,7 +47,7 @@ class PeerCluster:
 
     async def __aenter__(self) -> "PeerCluster":
         await self.store.start()
-        for i in range(2):
+        for i in range(self.n):
             rt = await DistributedRuntime.create(self.store.address)
             self.runtimes.append(rt)
             served = asyncio.Event()
@@ -57,7 +61,7 @@ class PeerCluster:
                             "host_kv_blocks": 8,
                             "disk_kv_dir": str(self.tmp_path / f"disk{i}"),
                             "disk_kv_blocks": 64,
-                            "kv_dtype": self.kv_dtype,
+                            "kv_dtype": self.kv_dtypes[i],
                         },
                     )
                 )
@@ -247,3 +251,110 @@ async def test_kv_fetch_serves_int8_packed_pages(tmp_path):
             router_overrides={"backend_instance_id": c.worker_ids[1]},
         )
         assert got == want, "int8 peer-served decode diverged"
+
+
+async def test_three_worker_pool_shared_prefix_e2e(tmp_path):
+    """ISSUE 11 three-worker pool: a shared prefix cached on worker A; a
+    request EXCLUDED from A lands on one of B/C, which pulls the blocks
+    from A over the dataplane and streams BIT-IDENTICALLY to A's cold
+    prefill — while the third worker never touches the prefix."""
+    prompt = list(range(1, 90))  # 11 complete 8-token blocks
+    async with PeerCluster(tmp_path, n=3) as c:
+        served = c.service.manager.get("peer")
+        push = served.push_router
+        a_id = c.worker_ids[0]
+        a_core = c.cores[0]
+
+        want = await _route(
+            push, _pre(prompt, "seed"),
+            router_overrides={"backend_instance_id": a_id},
+        )
+        assert len(want) == 4
+
+        got = []
+        async for out in push.generate(
+            _pre(prompt, "reroute").to_wire(), "reroute", list(prompt),
+            exclude={a_id},
+        ):
+            got.extend(out.get("token_ids") or [])
+        push.router.free("reroute")
+        assert got == want, "cross-worker pooled decode diverged"
+
+        pulled = [
+            core for core in c.cores[1:]
+            if core.transfer_stats["imported_blocks"] > 0
+        ]
+        assert len(pulled) == 1, (
+            "exactly one of B/C must have pulled the prefix: "
+            f"{[core.transfer_stats for core in c.cores]}"
+        )
+        assert pulled[0].transfer_stats["imported_blocks"] >= 11
+        # A still serves its copy (the pull is non-destructive).
+        assert a_core.cached_prefix_tokens(prompt) > 0
+
+
+async def test_mixed_dtype_fleet_pull_fails_fast_and_recomputes(tmp_path):
+    """PR 8 dtype contract at the pool layer: a bf16 worker's pages must
+    NOT import into an int8 worker (re-quantizing breaks bit-stability).
+    The pull fails fast, the request completes via local recompute, and
+    the recomputed prefix serves consistently afterwards."""
+    prompt = list(range(1, 90))
+    async with PeerCluster(tmp_path, kv_dtype=["bf16", "int8"]) as c:
+        served = c.service.manager.get("peer")
+        push = served.push_router
+        a_id = c.worker_ids[0]
+        b_core = c.cores[1]
+        assert not c.cores[0].engine.kv_quantized
+        assert b_core.engine.kv_quantized
+
+        await _route(
+            push, _pre(prompt, "seed"),
+            router_overrides={"backend_instance_id": a_id},
+        )
+        got = await _route(push, _pre(prompt, "reroute"), exclude={a_id})
+        assert len(got) == 4, "mixed-dtype fallback lost the stream"
+        # The fail-fast contract: NOTHING imported across the dtype edge.
+        assert b_core.transfer_stats["imported_blocks"] == 0
+        # The fallback recompute cached the prefix locally: a pinned
+        # repeat on B streams identically (its own quantized decode).
+        got2 = await _route(
+            push, _pre(prompt, "again"),
+            router_overrides={"backend_instance_id": c.worker_ids[1]},
+        )
+        assert got2 == got, "post-fallback repeat diverged"
+
+
+async def test_chaos_sever_mid_pull_degrades_to_recompute(tmp_path):
+    """Acceptance chaos e2e (jax engines): the peer connection is severed
+    MID-PULL (after the first frame); the request completes via local
+    recompute with a stream bit-identical to the no-fault run — no
+    wedged request, no stall."""
+    from dynamo_tpu.runtime import chaos
+    from dynamo_tpu.runtime.chaos import ChaosPlan, ChaosRule
+
+    prompt = list(range(1, 90))
+    try:
+        async with PeerCluster(tmp_path) as c:
+            served = c.service.manager.get("peer")
+            push = served.push_router
+            a_id = c.worker_ids[0]
+            b_core = c.cores[1]
+
+            want = await _route(
+                push, _pre(prompt, "seed"),
+                router_overrides={"backend_instance_id": a_id},
+            )
+            a_addr = c.runtimes[0].ingress.address
+            chaos.install(ChaosPlan(rules=[
+                ChaosRule(
+                    point="dataplane.recv", action="sever",
+                    match=a_addr, after=1,
+                ),
+            ]))
+            got = await _route(push, _pre(prompt, "reroute"), exclude={a_id})
+            chaos.uninstall()
+            assert got == want, "sever mid-pull broke the stream"
+            # At most the pre-sever chunk imported; the rest recomputed.
+            assert b_core.transfer_stats["imported_blocks"] < 11
+    finally:
+        chaos.uninstall()

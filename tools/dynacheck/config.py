@@ -127,6 +127,10 @@ CURSOR_ATTRS = {
     # from outside the queue's own methods would silently skew fairness.
     "_deficits": "DRR per-tenant deficit balances",
     "_order": "DRR tenant rotation",
+    # Cluster-pool global index (llm/kv_pool/global_index.py, ISSUE 11):
+    # the per-worker tier ledger IS the routing truth — an out-of-band
+    # write would desynchronize it from the radix tree it feeds.
+    "_tiers": "global-index per-worker tier ledger",
 }
 
 # {file suffix -> set of audited writer qualnames}. Nested defs are dotted
@@ -197,6 +201,17 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
         "MockKvManager.release",
         "MockKvManager.clear_unpinned",
         "MockKvManager.clear",
+        # Cluster-pool import (ISSUE 11): register_inactive's mocker twin.
+        "MockKvManager.import_block",
+    },
+    # The global index owns its tier ledger wholesale (single event-task
+    # writer); the rule guards OTHER files reaching into `idx._tiers`.
+    "dynamo_tpu/llm/kv_pool/global_index.py": {
+        "GlobalKvIndex.__init__",
+        "GlobalKvIndex._apply_stored",
+        "GlobalKvIndex._apply_removed",
+        "GlobalKvIndex._retire",
+        "GlobalKvIndex.remove_worker",
     },
 }
 

@@ -106,6 +106,19 @@ GUARDED_BY = {
         (None, "_lib"): "_lock",
         (None, "_load_failed"): "_lock",
     },
+    "dynamo_tpu/llm/kv_pool/global_index.py": {
+        # Single-writer discipline like the radix tree it wraps: only the
+        # indexer's event task mutates the tier ledger; readers share its
+        # event loop (kv_router/indexer.py docstring).
+        ("GlobalKvIndex", "_tiers"): EXTERNAL,
+        ("GlobalKvIndex", "_last_event_id"): EXTERNAL,
+        ("GlobalKvIndex", "_fwd_id"): EXTERNAL,
+    },
+    "dynamo_tpu/llm/kv_router/publisher.py": {
+        # Bounded event buffer: every mutation is loop-affine (engine
+        # threads hop in via call_soon_threadsafe; one drain task pops).
+        ("KvEventPublisher", "_buf"): EXTERNAL,
+    },
 }
 
 # Mutating method names: `x.<name>(...)` counts as a mutation of `x`.

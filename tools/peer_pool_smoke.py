@@ -1,0 +1,200 @@
+"""Cluster-KV-pool smoke: two mocker workers behind the real OpenAI
+frontend (KV routing); a shared prompt is seeded on whichever worker the
+router picks, then the same prompt is re-sent with router temperature
+sampling until routing lands on the OTHER worker — which pulls the
+cached prefix from its peer over the dataplane instead of recomputing.
+
+Asserts the user-visible contract of ISSUE 11:
+
+- at least one peer pull SUCCEEDED (the ``kv_pool_peer_pulls_succeeded_
+  total`` gauge on a worker's /metrics moved), with zero fallbacks;
+- every streamed completion is byte-identical to a single-worker
+  deployment's stream of the same request (the pool changes WHERE the
+  prefix comes from, never which tokens stream).
+
+CI usage (`.github/workflows/ci.yml` peer-pool-smoke step) and local:
+
+    python tools/peer_pool_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.megastep_smoke import stream_text  # noqa: E402
+
+PROMPT = "cluster kv pool smoke " * 40  # long enough to span many blocks
+BODY = {
+    "model": "mock",
+    "messages": [{"role": "user", "content": PROMPT}],
+    "max_tokens": 24,
+    "temperature": 0,
+    "stream": True,
+}
+
+
+def _engine_args():
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+
+    return MockEngineArgs(
+        num_kv_blocks=4096,
+        block_size=8,
+        speedup_ratio=50.0,
+        kv_pull_us_per_block=20.0,
+    )
+
+
+async def _boot(n_workers: int):
+    """Store + n mocker workers (each with a live status server) + a KV
+    frontend; returns (handles-to-teardown, base_url, status_ports)."""
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.status_server import SystemStatusServer
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    runtimes, tasks, statuses = [], [], []
+    for _ in range(n_workers):
+        rt = await DistributedRuntime.create(store.address)
+        status = SystemStatusServer(host="127.0.0.1", port=0)
+        await status.start()
+        rt.status = status
+        statuses.append(status)
+        served = asyncio.Event()
+        tasks.append(
+            asyncio.create_task(
+                run_mocker(
+                    rt, model_name="mock", engine_args=_engine_args(),
+                    served_event=served,
+                )
+            )
+        )
+        await asyncio.wait_for(served.wait(), 30)
+        runtimes.append(rt)
+    front_rt = await DistributedRuntime.create(store.address)
+    runtimes.append(front_rt)
+    ready = asyncio.Event()
+    services: list = []
+    tasks.append(
+        asyncio.create_task(
+            run_frontend(
+                front_rt, http_host="127.0.0.1", http_port=0,
+                router_mode="kv", ready_event=ready, service_out=services,
+            )
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    return (store, runtimes, tasks, statuses), f"http://127.0.0.1:{services[0].port}"
+
+
+async def _teardown(handles) -> None:
+    store, runtimes, tasks, statuses = handles
+    for t in tasks:
+        t.cancel()
+    for rt in runtimes:
+        await rt.shutdown()
+    for st in statuses:
+        await st.stop()
+    await store.stop()
+
+
+async def _wait_model(s, base: str) -> None:
+    for _ in range(200):
+        async with s.get(f"{base}/v1/models") as r:
+            if (await r.json())["data"]:
+                return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("model never appeared on frontend")
+
+
+def _gauge(metrics: str, name: str) -> float:
+    for line in metrics.splitlines():
+        if line.startswith(name):
+            return float(line.rsplit(None, 1)[-1])
+    raise AssertionError(f"gauge {name!r} not on /metrics")
+
+
+async def run() -> None:
+    import aiohttp
+
+    # Reference: a single-worker deployment's stream of the same request.
+    handles, base = await _boot(1)
+    try:
+        async with aiohttp.ClientSession() as s:
+            await _wait_model(s, base)
+            want = await stream_text(s, f"{base}/v1/chat/completions", dict(BODY))
+    finally:
+        await _teardown(handles)
+    assert want, "single-worker reference streamed nothing"
+
+    # The pool fleet: 2 workers. Request 1 seeds one of them; repeats with
+    # router temperature sampling eventually land on the other, which must
+    # serve its prefill via a peer pull.
+    handles, base = await _boot(2)
+    try:
+        statuses = handles[3]
+        async with aiohttp.ClientSession() as s:
+            await _wait_model(s, base)
+            texts = [await stream_text(s, f"{base}/v1/chat/completions", dict(BODY))]
+            pulls = 0.0
+            for _ in range(24):
+                body = dict(BODY, dyn={"router": {"router_temperature": 2.0}})
+                texts.append(
+                    await stream_text(s, f"{base}/v1/chat/completions", body)
+                )
+                metrics = []
+                for st in statuses:
+                    async with s.get(
+                        f"http://127.0.0.1:{st.port}/metrics"
+                    ) as r:
+                        assert r.status == 200
+                        metrics.append(await r.text())
+                pulls = sum(
+                    _gauge(m, "dynamo_kv_pool_peer_pulls_succeeded_total")
+                    for m in metrics
+                )
+                if pulls >= 1:
+                    break
+            assert pulls >= 1, (
+                "no peer pull succeeded across 24 temperature-sampled "
+                "requests (two-worker fleet)"
+            )
+            fallbacks = sum(
+                _gauge(m, "dynamo_kv_pool_peer_pulls_fallback_total")
+                for m in metrics
+            )
+            blocks = sum(
+                _gauge(m, "dynamo_kv_pool_blocks_pulled_total") for m in metrics
+            )
+            assert fallbacks == 0, f"{fallbacks} pulls fell back in a healthy fleet"
+            assert blocks >= 1, "a successful pull imported no blocks"
+    finally:
+        await _teardown(handles)
+
+    bad = [i for i, t in enumerate(texts) if t != want]
+    assert not bad, (
+        f"streams diverged from the single-worker reference at request(s) "
+        f"{bad}:\n  want: {want!r}\n  got:  {texts[bad[0]]!r}"
+    )
+    print(
+        f"peer-pool-smoke OK: {len(texts)} streams byte-identical to the "
+        f"single-worker run; {int(pulls)} peer pull(s), {int(blocks)} "
+        f"block(s) imported, 0 fallbacks",
+        flush=True,
+    )
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
