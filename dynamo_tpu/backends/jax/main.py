@@ -1162,12 +1162,15 @@ def main() -> None:
     )
     ap.add_argument(
         "--megastep-k", type=int, default=None,
-        help="decode megastep: fuse this many decode iterations into ONE "
-             "device dispatch (on-device sampling + per-lane stop flags; "
-             "host drains outputs every k steps). 1 = off (one dispatch "
-             "per token); unset = inherit the legacy decode-chain default "
-             "(8). Token stream is bit-identical for any k; mixed chunked "
-             "steps and spec-decode verify rows always run single-step",
+        help="universal megastep: fuse this many decode iterations into "
+             "ONE device dispatch (on-device sampling + per-lane stop "
+             "flags; host drains outputs every k steps). Prefill chunks "
+             "ride the fused dispatch and continue as decode rows; spec "
+             "verify rows resolve accept/reject on device. 1 = off (one "
+             "dispatch per token); unset = inherit the legacy "
+             "decode-chain default (8). Token stream is bit-identical "
+             "for any k; only a stop watch wider than 8 ids forces a "
+             "batch back to single-step",
     )
     ap.add_argument(
         "--fair-scheduling", default=None, choices=["on", "off"],

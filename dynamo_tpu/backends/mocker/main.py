@@ -253,11 +253,12 @@ def main() -> None:
                          "overhead hides under device compute (virtual "
                          "clock; stream stays bit-identical to 'off')")
     ap.add_argument("--megastep-k", type=int, default=1,
-                    help="decode megastep: decode-only iterations fuse k "
-                         "device steps under ONE per-dispatch host "
+                    help="universal megastep: iterations with decode work "
+                         "fuse k device steps under ONE per-dispatch host "
                          "overhead (virtual clock; stream stays bit-"
-                         "identical to k=1). Mixed prefill+decode steps "
-                         "and spec verify rows stay single-step")
+                         "identical to k=1). Prefill chunks ride the same "
+                         "priced dispatch and spec verify lanes resolve "
+                         "accept/reject inside the fused iteration")
     ap.add_argument("--kv-dtype", default="bf16", choices=["bf16", "int8"],
                     help="simulated KV cache dtype (mirrors the jax "
                          "worker's --kv-dtype): int8 halves the priced "

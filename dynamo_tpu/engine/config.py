@@ -156,8 +156,15 @@ class EngineConfig:
     # for any k (greedy and seeded sampling; host stop-scan stays the
     # authority — host-only stops roll back via num_computed_tokens).
     # 1 = off (one dispatch per decode token); 0 = inherit the legacy
-    # decode_chain knob. Decode-only steps fuse; mixed chunked steps and
-    # spec-decode verify rows always run single-step.
+    # decode_chain knob. UNIVERSAL (ISSUE 12): every step shape rides
+    # the scanned body — chunked mixed steps fuse their ragged first
+    # iteration (prefill chunks + decode rows + verify rows) with k-1
+    # scanned decode iterations, spec verify rows resolve accept/reject
+    # ON DEVICE (rejected drafts roll back inside the dispatch via the
+    # lane's position cursor), and a prefill chunk that completes its
+    # prompt continues as a decode row in the same dispatch. The one
+    # forced-k=1 path left is a stop watch wider than the device's
+    # MEGASTEP_WATCH_W slots (surfaced as megastep_forced_single).
     megastep_k: int = 0
 
     # Sequence-parallel long-context prefill: prompts at least this long

@@ -54,8 +54,10 @@ from dynamo_tpu.engine.model import (
 from dynamo_tpu.engine.sampler import (
     LOGPROBS_K,
     gather_feedback,
+    resolve_verify,
     sample_seeded,
     stop_flags,
+    stop_flags_prefix,
     token_logprobs,
 )
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
@@ -279,6 +281,37 @@ def _lp_entry(token: int, chosen, top_ids, top_lps, k: int) -> dict:
     }
 
 
+@dataclass
+class _RaggedBatch:
+    """Host-assembled inputs of one ragged forward over arbitrary rows
+    (:meth:`EngineCore._assemble_ragged`): the iteration the plain
+    single-step dispatch runs, and the universal megastep's first."""
+
+    T: int
+    R: int
+    tokens: np.ndarray
+    positions: np.ndarray
+    write_pages: np.ndarray
+    write_offs: np.ndarray
+    kv_lens: np.ndarray
+    tables: np.ndarray
+    cu: np.ndarray
+    last_rows: np.ndarray
+    gather: np.ndarray
+    counters: np.ndarray
+    seeds: np.ndarray
+    temp: np.ndarray
+    top_k: np.ndarray
+    top_p: np.ndarray
+    feed_idx: np.ndarray | None
+    mm_embeds: np.ndarray
+    mm_mask: np.ndarray
+    need_mask: bool
+    want_lp: bool
+    all_greedy: bool
+    want_mm: bool
+
+
 # Static width of the per-lane on-device stop-watch array ([B, W], -1
 # padded): EOS ids + stop_token_ids. Lanes with more watch ids than fit
 # simply truncate — the device then under-stops (extra masked no-op
@@ -339,6 +372,111 @@ def _megastep_body(
         (tokens, cache, jnp.ones_like(active), positions),
         jnp.arange(n_steps),
     )
+    return _replicate_out(sampled, mesh), _replicate_out(lps, mesh), cache
+
+
+def _megastep_fused_body(
+    params, cache,
+    # -- iteration 0: the ragged program (exactly _dispatch_ragged's shape)
+    tokens, positions, write_pages, write_offs, kv_lens, block_tables,
+    cu_q_lens, num_seqs, gather,
+    seeds_r, counters_r, temp_r, top_k_r, top_p_r,
+    mm_embeds, mm_mask,
+    # -- per-lane continuation state ([S] unless noted)
+    draft, draft_len,        # [S, R-1] drafted tokens, live length
+    cont_active,             # bool — lane continues as a decode row
+    base_pos,                # write position of the first scan write at acc=0
+    seeds, temp, top_k, top_p,
+    watch, budgets, min_left,
+    *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
+    want_mm=False, cfg, engine, mesh=None,
+):
+    """The UNIVERSAL megastep (ISSUE 12): ONE device dispatch fuses an
+    arbitrary ragged first iteration — prefill chunks, decode rows, and
+    speculative verify rows, the exact program :meth:`_dispatch_ragged`
+    runs — with ``n_steps - 1`` scanned decode+sample iterations over
+    the same lanes.
+
+    Iteration 0 samples the [S, R] verify-width slots with per-position
+    ``(seed, counter + j)`` keys, then each lane resolves ON DEVICE
+    (:func:`sampler.resolve_verify`): a verify row accepts the longest
+    drafted prefix the target agrees with and continues from the
+    correction/bonus token at position ``base + accepted`` — a rejected
+    draft rolls back INSIDE the dispatch (its K/V writes sit past the
+    lane's position cursor, never attended, overwritten in place by the
+    continuation) instead of forcing a host round trip. A prefill chunk
+    that completes its prompt continues as a decode row from its
+    first sampled token; mid-prompt chunks run the remaining iterations
+    as masked no-ops (``cont_active`` False). The per-lane stop state
+    (watch ids, budget, min-tokens floor) carries the data-dependent
+    iteration-0 emission count, so a verify row that emits
+    ``accepted + 1`` tokens burns exactly that much budget.
+
+    Returns sampled [n_steps, S, R] (iteration 0 fills the verify width,
+    later iterations broadcast their single token across R) plus
+    matching logprob arrays; the HOST stop-scan stays the authority,
+    exactly as in :func:`_megastep_body`."""
+    logits, cache = forward_tokens(
+        params, cache, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu_q_lens, num_seqs, gather,
+        cfg, engine, mesh,
+        mm_embeds=mm_embeds if want_mm else None,
+        mm_mask=mm_mask if want_mm else None,
+    )
+    t0 = sample_seeded(
+        logits, seeds_r, counters_r, temp_r, top_k_r, top_p_r,
+        need_mask=need_mask, all_greedy=all_greedy,
+    )
+    lp0 = token_logprobs(logits, t0) if want_logprobs else None
+    S = draft.shape[0]
+    R = t0.shape[0] // S
+    t0s = t0.reshape(S, R)
+    acc, cur = resolve_verify(t0s, draft, draft_len)
+    alive0 = cont_active & ~stop_flags_prefix(
+        t0s, acc, watch, budgets, min_left
+    )
+    gen0 = jnp.where(cont_active, acc + 1, 0)   # tokens iteration 0 produced
+    pos0 = base_pos + acc                       # next write position
+    counters0 = counters_r.reshape(S, R)[:, 0]  # per-lane generated base
+
+    def body(carry, _):
+        tok, cache, alive, pos, gen = carry
+        act = alive
+        logits, cache = decode_tokens(
+            params, cache, tok, block_tables, pos, act, cfg, engine, mesh,
+        )
+        nxt = sample_seeded(
+            logits, seeds, counters0 + gen, temp, top_k, top_p,
+            need_mask=need_mask, all_greedy=all_greedy,
+        )
+        out_tok = jnp.where(act, nxt, tok)
+        lp = token_logprobs(logits, out_tok) if want_logprobs else None
+        g = gen + act.astype(jnp.int32)
+        stop = ((nxt[:, None] == watch).any(axis=1) & (g >= min_left)) | (
+            g >= budgets
+        )
+        alive = alive & ~stop
+        pos = pos + act.astype(jnp.int32)
+        return (out_tok, cache, alive, pos, g), (out_tok, lp)
+
+    (_, cache, _, _, _), (rest, rest_lp) = jax.lax.scan(
+        body, (cur, cache, alive0, pos0, gen0), None, length=n_steps - 1
+    )
+    sampled = jnp.concatenate(
+        [t0s[None], jnp.broadcast_to(rest[:, :, None], (n_steps - 1, S, R))],
+        axis=0,
+    )
+    lps = None
+    if want_logprobs:
+        def widen(a0, ar):
+            # a0: [S*R(,K)] iteration-0 slots; ar: [n_steps-1, S(,K)]
+            a0 = a0.reshape((1, S, R) + a0.shape[1:])
+            ar = jnp.broadcast_to(
+                ar[:, :, None], (n_steps - 1, S, R) + ar.shape[2:]
+            )
+            return jnp.concatenate([a0, ar], axis=0)
+
+        lps = tuple(widen(a0, ar) for a0, ar in zip(lp0, rest_lp))
     return _replicate_out(sampled, mesh), _replicate_out(lps, mesh), cache
 
 
@@ -1022,6 +1160,13 @@ class EngineCore:
             "megastep_dispatches": 0,
             "single_step_dispatches": 0,
             "committed_tokens": 0,
+            # Universal megastep (ISSUE 12): dispatches that fused a
+            # ragged mixed/verify first iteration with scanned decode
+            # continuation, and batches forced back to k=1 because a
+            # lane's stop watch overflowed the device's MEGASTEP_WATCH_W
+            # slots (the one documented un-fused path).
+            "fused_mixed_dispatches": 0,
+            "megastep_forced_single": 0,
         }
         # Test hook: set to [] to record ("dispatch", n) / ("land", n)
         # events — the pipelining contract is that dispatch n+1 precedes
@@ -1064,6 +1209,21 @@ class EngineCore:
         self._decode = jax.jit(
             partial(_megastep_body, cfg=model_cfg, engine=engine_cfg, mesh=mesh),
             static_argnames=("n_steps", "need_mask", "all_greedy", "want_logprobs"),
+            donate_argnums=(1,),
+        )
+        # The UNIVERSAL megastep (ISSUE 12): ragged first iteration
+        # (prefill chunks + decode rows + verify rows) fused with
+        # n_steps-1 scanned decode iterations in one dispatch; verify
+        # accept/reject resolves on device.
+        self._fused = jax.jit(
+            partial(
+                _megastep_fused_body, cfg=model_cfg, engine=engine_cfg,
+                mesh=mesh,
+            ),
+            static_argnames=(
+                "n_steps", "need_mask", "all_greedy", "want_logprobs",
+                "want_mm",
+            ),
             donate_argnums=(1,),
         )
         self._prefill_pp = None
@@ -1538,39 +1698,16 @@ class EngineCore:
             seq.pinned_hashes.append(blk.block_hash)
             seq.committed_blocks += 1
 
-    def _dispatch_ragged(
+    def _assemble_ragged(
         self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
         n_sample: list[int] | None = None,
         feed_rows: list[int | None] | None = None,
-    ) -> _PendingFetch:
-        """Assemble and run ONE ragged forward + fused sampling over
-        arbitrary rows. Each row is ``(seq, tokens, pos_start, kv_len)``:
-        a prefill chunk (tokens sliced from the prompt), a decode row
-        (the single pending token at position ``processed``), or a
-        speculative verify row (pending + drafted tokens). Prefill waves,
-        chunked mixed steps, and verify steps all funnel here — mixed
-        batches are exactly what the unified ragged forward was built for
-        (a decode row is q_len=1, a verify row is a q_len=k+1 "prefill
-        chunk" of already-chosen tokens). Programs compile per (token
-        bucket, S, sample width, sampling-variant); S is the caller's
-        static row width.
-
-        ``n_sample`` (aligned with rows) marks verify rows: entry > 1
-        samples that row's FIRST n positions (the per-drafted-token
-        target choices), everything else samples only the last position.
-        The sample gather widens to the engine's static ``spec_k + 1``
-        whenever any row speculates — short drafts pad with duplicate
-        reads — so draft length never mints new compiled programs.
-
-        ``feed_rows`` (aligned with rows) carries the device-resident
-        token feedback: a non-None entry is the flat index of that row's
-        FIRST token in the in-flight step's sampled-token output, and the
-        host placeholder at that slot is overridden by an on-device
-        gather — the just-sampled id never round-trips through the host.
-
-        Returns a :class:`_PendingFetch`; ``land()`` yields the legacy
-        shapes — 2-D ([S, R] tokens, [S, R, ...] logprobs) with
-        ``n_sample``, 1-D without."""
+    ) -> "_RaggedBatch":
+        """Host-side assembly of ONE ragged forward's inputs over
+        arbitrary rows — shared by the plain single-step dispatch
+        (:meth:`_dispatch_ragged`) and the universal megastep's first
+        iteration (:meth:`_dispatch_fused`), so the two can never
+        disagree about row packing, sample gathers, or counter keys."""
         P = self.engine.max_blocks_per_seq
         bs = self.engine.block_size
         total = sum(len(tl) for _, tl, _, _ in rows)
@@ -1669,6 +1806,61 @@ class EngineCore:
             mm_embeds = np.zeros((1, 1), np.float32)
             mm_mask = np.zeros(1, bool)
 
+        return _RaggedBatch(
+            T=T, R=R, tokens=tokens, positions=positions,
+            write_pages=write_pages, write_offs=write_offs,
+            kv_lens=kv_lens, tables=tables, cu=cu, last_rows=last_rows,
+            gather=gather,
+            counters=counters, seeds=seeds, temp=temp, top_k=top_k,
+            top_p=top_p, feed_idx=feed_idx, mm_embeds=mm_embeds,
+            mm_mask=mm_mask, need_mask=need_mask, want_lp=want_lp,
+            all_greedy=all_greedy, want_mm=want_mm,
+        )
+
+    def _dispatch_ragged(
+        self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
+        n_sample: list[int] | None = None,
+        feed_rows: list[int | None] | None = None,
+    ) -> _PendingFetch:
+        """Assemble and run ONE ragged forward + fused sampling over
+        arbitrary rows. Each row is ``(seq, tokens, pos_start, kv_len)``:
+        a prefill chunk (tokens sliced from the prompt), a decode row
+        (the single pending token at position ``processed``), or a
+        speculative verify row (pending + drafted tokens). Prefill waves,
+        chunked mixed steps, and verify steps all funnel here — mixed
+        batches are exactly what the unified ragged forward was built for
+        (a decode row is q_len=1, a verify row is a q_len=k+1 "prefill
+        chunk" of already-chosen tokens). Programs compile per (token
+        bucket, S, sample width, sampling-variant); S is the caller's
+        static row width.
+
+        ``n_sample`` (aligned with rows) marks verify rows: entry > 1
+        samples that row's FIRST n positions (the per-drafted-token
+        target choices), everything else samples only the last position.
+        The sample gather widens to the engine's static ``spec_k + 1``
+        whenever any row speculates — short drafts pad with duplicate
+        reads — so draft length never mints new compiled programs.
+
+        ``feed_rows`` (aligned with rows) carries the device-resident
+        token feedback: a non-None entry is the flat index of that row's
+        FIRST token in the in-flight step's sampled-token output, and the
+        host placeholder at that slot is overridden by an on-device
+        gather — the just-sampled id never round-trips through the host.
+
+        Returns a :class:`_PendingFetch`; ``land()`` yields the legacy
+        shapes — 2-D ([S, R] tokens, [S, R, ...] logprobs) with
+        ``n_sample``, 1-D without."""
+        b = self._assemble_ragged(rows, S, n_sample, feed_rows)
+        R = b.R
+        tokens, positions = b.tokens, b.positions
+        write_pages, write_offs = b.write_pages, b.write_offs
+        kv_lens, tables, cu, gather = b.kv_lens, b.tables, b.cu, b.gather
+        last_rows, counters, seeds = b.last_rows, b.counters, b.seeds
+        temp, top_k, top_p = b.temp, b.top_k, b.top_p
+        feed_idx, mm_embeds, mm_mask = b.feed_idx, b.mm_embeds, b.mm_mask
+        need_mask, want_lp = b.need_mask, b.want_lp
+        all_greedy, want_mm = b.all_greedy, b.want_mm
+
         if self.pp_mesh is not None:
             # want_mm cannot be true here: add_request rejects mm
             # requests on pp engines at admission.
@@ -1743,6 +1935,105 @@ class EngineCore:
         return _PendingFetch(
             self, toks, lps, sr=(S, R) if n_sample is not None else None
         )
+
+    def _dispatch_fused(
+        self,
+        rows: list[tuple[Sequence, list[int], int, int]],
+        S: int,
+        n_sample: list[int],
+        feed_rows: list[int | None],
+        kinds: list[str],
+        drafts: list[list[int]],
+        cont: list[bool],
+        n_steps: int,
+    ) -> _PendingFetch:
+        """Assemble and enqueue one UNIVERSAL megastep (ISSUE 12): the
+        same ragged first iteration :meth:`_dispatch_ragged` would run
+        over these rows — prefill chunks, decode rows, verify rows —
+        fused with ``n_steps - 1`` scanned decode iterations in ONE
+        device dispatch (:func:`_megastep_fused_body`). ``cont``
+        (aligned with rows) marks lanes that continue as decode rows
+        after iteration 0: decode and verify rows always do; a prefill
+        chunk does exactly when it completes its prompt and the planner
+        could reserve its continuation headroom. Verify rows resolve
+        accept/reject on device, so the continuation restarts from the
+        correction token with no host round trip. Returns a pending
+        fetch whose ``land()`` yields ([n_steps, S, R] tokens, matching
+        logprob arrays or None)."""
+        b = self._assemble_ragged(rows, S, n_sample, feed_rows)
+        R = b.R
+        W = MEGASTEP_WATCH_W
+        draft = np.full((S, R - 1), -1, np.int32)
+        draft_len = np.zeros(S, np.int32)
+        cont_a = np.zeros(S, bool)
+        base_pos = np.zeros(S, np.int32)
+        watch = np.full((S, W), -1, np.int32)
+        # Padded / masked lanes never hit their budget (the deepest lane
+        # emits accepted + 1 + (n_steps - 1) <= R + n_steps - 1 tokens).
+        budgets = np.full(S, n_steps + R + 1, np.int32)
+        min_left = np.zeros(S, np.int32)
+        for i, ((seq, toks_list, pos0, _kv), kind) in enumerate(
+            zip(rows, kinds)
+        ):
+            if not cont[i]:
+                continue
+            cont_a[i] = True
+            base_pos[i] = pos0 + (len(toks_list) if kind == "p" else 1)
+            d = drafts[i]
+            if d:
+                draft[i, : len(d)] = d
+                draft_len[i] = len(d)
+            self._arm_stop_inputs(seq, i, watch, budgets, min_left)
+        tok_in = jnp.asarray(b.tokens)
+        if b.feed_idx is not None:
+            tok_in = self._feed(
+                self._inflight.feed_tokens, tok_in, jnp.asarray(b.feed_idx)
+            )
+        out, lps, self.cache = self._fused(
+            self.params,
+            self.cache,
+            tok_in,
+            jnp.asarray(b.positions),
+            jnp.asarray(b.write_pages),
+            jnp.asarray(b.write_offs),
+            jnp.asarray(b.kv_lens),
+            jnp.asarray(b.tables),
+            jnp.asarray(b.cu),
+            jnp.asarray(np.array([len(rows)], np.int32)),
+            jnp.asarray(b.gather.reshape(-1)),
+            jnp.asarray(np.repeat(b.seeds, R)),
+            jnp.asarray(b.counters.reshape(-1)),
+            jnp.asarray(np.repeat(b.temp, R)),
+            jnp.asarray(np.repeat(b.top_k, R)),
+            jnp.asarray(np.repeat(b.top_p, R)),
+            jnp.asarray(b.mm_embeds),
+            jnp.asarray(b.mm_mask),
+            jnp.asarray(draft),
+            jnp.asarray(draft_len),
+            jnp.asarray(cont_a),
+            jnp.asarray(base_pos),
+            jnp.asarray(b.seeds),
+            jnp.asarray(b.temp),
+            jnp.asarray(b.top_k),
+            jnp.asarray(b.top_p),
+            jnp.asarray(watch),
+            jnp.asarray(budgets),
+            jnp.asarray(min_left),
+            n_steps=n_steps,
+            need_mask=b.need_mask and not b.all_greedy,
+            all_greedy=b.all_greedy,
+            want_logprobs=b.want_lp,
+            want_mm=b.want_mm,
+        )
+        self.exec_stats["megastep_dispatches"] += 1
+        if any(k != "d" for k in kinds):
+            # Count as MIXED only when the dispatch actually carried
+            # prefill chunks or verify rows — the same condition the
+            # mocker's gauge uses, so both engines export comparable
+            # series (a batch whose chunks were all skipped is a plain
+            # fused decode dispatch).
+            self.exec_stats["fused_mixed_dispatches"] += 1
+        return _PendingFetch(self, out, lps)  # [n_steps, S, R] on land()
 
     def _plan_prefill_wave(self, seqs: list[Sequence]) -> _PlannedStep | None:
         """Plan one ragged prefill wave: up to ``prefill_batch`` sequences
@@ -2018,6 +2309,30 @@ class EngineCore:
         seq.block_ids = seq.block_ids[: seq.committed_blocks]
         seq.pinned_hashes = []
 
+    def _arm_stop_inputs(
+        self, seq: Sequence, i: int, watch: np.ndarray,
+        budgets: np.ndarray, min_left: np.ndarray,
+    ) -> None:
+        """Fill lane ``i``'s on-device stop inputs — watch ids (EOS +
+        stop_token_ids, truncated to the device's slots), remaining
+        generation budget, min-tokens floor — ONE implementation shared
+        by the decode-only megastep and the fused dispatch, so the two
+        scanned bodies can never disagree about stop semantics."""
+        W = watch.shape[1]
+        wl: list[int] = []
+        if not seq.stop.ignore_eos:
+            wl.extend(sorted(self.eos_token_ids))
+        wl.extend(seq.stop.stop_token_ids)
+        watch[i, : min(W, len(wl))] = wl[:W]
+        if seq.stop.max_tokens is not None:
+            budgets[i] = max(
+                1, seq.stop.max_tokens - self._eff_generated(seq)
+            )
+        if seq.stop.min_tokens:
+            min_left[i] = max(
+                0, seq.stop.min_tokens - self._eff_generated(seq)
+            )
+
     def _dispatch_megastep(
         self, seqs: list[Sequence], n_steps: int,
         feed_lanes: list[int | None] | None = None,
@@ -2068,19 +2383,7 @@ class EngineCore:
             top_p[i] = seq.sampling.top_p
             seeds[i] = seq.seed
             counters[i] = self._eff_generated(seq)
-            wl: list[int] = []
-            if not seq.stop.ignore_eos:
-                wl.extend(sorted(self.eos_token_ids))
-            wl.extend(seq.stop.stop_token_ids)
-            watch[i, : min(W, len(wl))] = wl[:W]
-            if seq.stop.max_tokens is not None:
-                budgets[i] = max(
-                    1, seq.stop.max_tokens - self._eff_generated(seq)
-                )
-            if seq.stop.min_tokens:
-                min_left[i] = max(
-                    0, seq.stop.min_tokens - self._eff_generated(seq)
-                )
+            self._arm_stop_inputs(seq, i, watch, budgets, min_left)
         need_mask = any(
             s.sampling.top_k > 0 or s.sampling.top_p < 1.0 for s in seqs
         )
@@ -2227,9 +2530,18 @@ class EngineCore:
             prefills = [
                 s for s in self.running if not self._eff_prefill_done(s)
             ]
-            plan = (
-                self._plan_mixed(prefills) if prefills else self._plan_decode()
-            )
+            plan = None
+            if prefills and self.engine.megastep > 1 and self.pp_mesh is None:
+                # Universal megastep (ISSUE 12): prefill chunks, decode
+                # rows, and verify rows fuse into one scanned dispatch;
+                # None falls back to the bit-identical single-step path.
+                plan = self._plan_fused(prefills)
+            if plan is None:
+                plan = (
+                    self._plan_mixed(prefills)
+                    if prefills
+                    else self._plan_decode()
+                )
         else:
             plan = self._plan_waves()
         if plan is not None:
@@ -2286,11 +2598,14 @@ class EngineCore:
         return out
 
     def _plan_decode(self) -> _PlannedStep | None:
-        """Plan one decode iteration: speculating lanes peel off into a
-        batched verify dispatch (draft tokens verify as ragged q_len=k+1
-        rows — verify rows always run single-step, k is forced to 1 for
-        that dispatch); the rest ride one decode megastep. Both
-        dispatches share one planned step — their commits run in order.
+        """Plan one decode iteration. With the universal megastep
+        (megastep > 1, ISSUE 12), speculating batches fuse WHOLE: verify
+        rows resolve accept/reject on device and ride the scanned body
+        next to plain decode lanes in one dispatch (_plan_fused). On the
+        k=1 / fallback path, speculating lanes peel off into a batched
+        single-step verify dispatch (draft tokens verify as ragged
+        q_len=k+1 rows) and the rest ride one decode megastep — both
+        dispatches share one planned step, their commits run in order.
 
         ALL block growth happens before ANY dispatch: block pressure must
         surface (preemption, or _NeedDrain under async) while this plan
@@ -2302,6 +2617,15 @@ class EngineCore:
         if not decoding:
             return None
         spec_lanes = [s for s in decoding if s.spec is not None]
+        if spec_lanes and self.engine.megastep > 1 and self.pp_mesh is None:
+            # Universal megastep (ISSUE 12): verify rows resolve
+            # accept/reject on device and fuse with the decode lanes in
+            # ONE scanned dispatch — no more forced-k=1 verify steps.
+            # None (watch overflow / budget edge) falls back to the
+            # legacy merged verify + chain plan below.
+            plan = self._plan_fused([], decoding=decoding)
+            if plan is not None:
+                return plan
         chain_lanes = [s for s in decoding if s.spec is None]
         chain_ready: list[Sequence] = []
         n_steps = 0
@@ -2436,6 +2760,9 @@ class EngineCore:
                     attrs={
                         "seqs": len(ready), "inner_steps": n_steps,
                         "tokens": emitted_total,
+                        "fused_shapes": {
+                            "decode": len(ready), "chunk": 0, "verify": 0,
+                        },
                     },
                     stat=True,
                 )
@@ -2448,15 +2775,20 @@ class EngineCore:
 
     # -- speculative decoding (draft + batched ragged verify) ---------------
 
-    def _draft_for(self, seq: Sequence, max_extra: int) -> list[int]:
+    def _draft_for(
+        self, seq: Sequence, max_extra: int, reserve: int = 0
+    ) -> list[int]:
         """Draft continuation tokens for one speculating sequence, capped
         by the caller's token headroom, the context edge, and the
         remaining generation budget (drafting past ``max_tokens`` is pure
-        waste — the stop scan would discard it)."""
+        waste — the stop scan would discard it). ``reserve`` holds back
+        context-edge room for a fused megastep's continuation iterations
+        (the universal megastep writes up to ``n_steps - 1`` tokens past
+        the verify row)."""
         sc = seq.spec
         d_cap = min(
             sc.k, max_extra,
-            self.engine.max_model_len - self._eff_processed(seq) - 1,
+            self.engine.max_model_len - self._eff_processed(seq) - 1 - reserve,
         )
         if seq.stop.max_tokens is not None:
             d_cap = min(d_cap, seq.stop.max_tokens - self._eff_generated(seq) - 1)
@@ -2638,9 +2970,12 @@ class EngineCore:
         )
 
     def _plan_mixed(self, prefills: list[Sequence]) -> _PlannedStep | None:
-        """Plan one chunked-scheduling step: every runnable decode
-        sequence rides as a q_len=1 row NEXT TO prefill chunks in the
-        same ragged program, under the ``max_num_batched_tokens`` budget.
+        """Plan one SINGLE-STEP chunked-scheduling iteration (the k=1 /
+        fused-fallback path — with megastep > 1 the universal megastep
+        (_plan_fused) runs this same row assembly through the scanned
+        body instead): every runnable decode sequence rides as a q_len=1
+        row NEXT TO prefill chunks in the same ragged program, under the
+        ``max_num_batched_tokens`` budget.
         A long prompt streams through ceil(P/chunk) steps while in-flight
         decodes keep emitting one token per step — prefill waves no
         longer stall decodes, and new arrivals stop queueing behind whole
@@ -2855,6 +3190,363 @@ class EngineCore:
             deterministic=deterministic,
         )
 
+    def _plan_fused(
+        self, prefills: list[Sequence],
+        decoding: list[Sequence] | None = None,
+    ) -> _PlannedStep | None:
+        """Plan one UNIVERSAL megastep (ISSUE 12): every step shape rides
+        the scanned device body. Decode rows and speculative verify rows
+        fuse with ``n_steps - 1`` on-device decode continuations — verify
+        accept/reject resolves inside the dispatch, rejected drafts roll
+        back on device via the lane's position cursor — and prefill
+        chunks ride the same ragged first iteration, continuing as
+        decode rows when they complete their prompt. Returns None when
+        fusion cannot apply (watch overflow — the one documented forced-
+        k=1 path — or a budget/context edge, or nothing that would
+        continue); the caller falls back to the bit-identical legacy
+        single-step paths.
+
+        ALL block growth happens before ANY dispatch (the _plan_decode
+        contract): each lane's full fused headroom — n_steps tokens per
+        decode lane, n_steps + draft per verify lane, chunk + n_steps - 1
+        per completing prefill chunk — is reserved at plan time, so
+        mid-megastep block exhaustion is impossible by construction;
+        pressure surfaces as preemption (or _NeedDrain under async)
+        while nothing is enqueued. Draft growth failure degrades that
+        row to q_len=1; continuation growth failure degrades a
+        completing chunk to the single-step bookkeeping."""
+        t_step = time.time()
+        budget = self.engine.token_budget
+        chunk_cap = self.engine.chunk_size
+        bs = self.engine.block_size
+        S_max = self.engine.decode_buckets[-1]
+
+        if decoding is None:
+            decoding = self._decode_candidates()
+        prefills = [s for s in prefills if s in self.running]
+        if not decoding and not prefills:
+            return None
+        if not prefills and not any(s.spec is not None for s in decoding):
+            # Pure non-speculating decode: the decode-only scanned body
+            # (_plan_megastep) is the cheaper program — no ragged first
+            # iteration, no verify width.
+            return None
+        if not decoding:
+            # Pure-prefill step: fusing pays only when a chunk can
+            # COMPLETE its prompt this step (and continue decoding on
+            # device); a long prompt mid-chunking gains nothing, so
+            # skip the doomed assembly — the single-step path is exact.
+            room = min(budget, chunk_cap)
+            if not any(
+                s.prompt_len - (s.prefilled + self._adv3(s)[0]) <= room
+                for s in prefills
+            ):
+                return None
+        lanes = decoding or prefills
+        n_steps = self._chain_length(lanes)
+        if n_steps <= 1:
+            return None
+
+        # Decode-lane selection mirrors _plan_mixed: reserve one row plus
+        # budget headroom for a prefill chunk, rotate lanes sitting out.
+        # With no prefills the budget still bounds base row tokens (the
+        # legacy _plan_verify deferred over-budget lanes the same way —
+        # a batch of S_max bases must not overflow a small
+        # max_num_batched_tokens on a waves engine).
+        cap = min(S_max - 1, budget - 1) if prefills else min(S_max, budget)
+        if len(decoding) > cap:
+            off = self.iterations % len(decoding)
+            decoding = (decoding + decoding)[off : off + cap]
+        ready = self._grow_or_preempt(decoding, n_steps)
+
+        rows: list[tuple[Sequence, list[int], int, int]] = []
+        kinds: list[str] = []
+        drafts: list[list[int]] = []
+        feed_rows: list[int | None] = []
+        cont: list[bool] = []
+        total = 0
+        # The one-block draft reserve exists so drafting can never starve
+        # prefill admission (_plan_mixed's invariant); with no prefill
+        # rows there is nothing to starve, and the legacy verify path
+        # drafted against the full budget — keep that headroom.
+        spec_budget = budget - bs if prefills else budget
+        for idx, seq in enumerate(ready):
+            draft: list[int] = []
+            if seq.spec is not None:
+                lanes_after = len(ready) - idx - 1
+                draft = self._draft_for(
+                    seq, spec_budget - total - 1 - lanes_after,
+                    reserve=n_steps - 1,
+                )
+                if draft and not self._grow_blocks(seq, n_steps + len(draft)):
+                    draft = []  # block pressure: verify degrades to q_len=1
+            cursor = self._eff_processed(seq)
+            src = self._feed_src(seq)
+            row_toks = [0 if src is not None else seq.pending] + draft
+            rows.append((seq, row_toks, cursor, cursor + len(row_toks)))
+            kinds.append("v" if seq.spec is not None else "d")
+            drafts.append(draft)
+            feed_rows.append(src)
+            cont.append(True)
+            total += len(row_toks)
+        n_decode = len(rows)
+        decode_row_tokens = total
+        t_drafted = time.time()
+        n_spec_rows = sum(1 for d in drafts if d)
+        if n_spec_rows:
+            self._tracer.record(
+                "spec_draft", t_step, t_drafted,
+                attrs={
+                    "seqs": n_spec_rows,
+                    "drafted": sum(len(d) for d in drafts),
+                },
+                stat=True,
+            )
+        for seq in prefills:
+            if seq not in self.running:
+                continue  # preempted above
+            if len(rows) >= S_max:
+                break
+            room = min(budget - total, chunk_cap)
+            if room <= 0:
+                break
+            p0 = seq.prefilled + self._adv3(seq)[0]
+            remaining = seq.prompt_len - p0
+            chunk = min(remaining, room)
+            if chunk < remaining:
+                chunk -= chunk % bs
+                if chunk <= 0:
+                    continue
+            self._mark_first_sched(seq, t_step)
+            # A chunk that completes its prompt continues as a decode
+            # row — when its watch fits the device flags, the context
+            # edge leaves room for the continuation writes, and the
+            # extra block headroom is reservable; otherwise it degrades
+            # to the single-step bookkeeping (first token only).
+            cont_ok = bool(
+                chunk == remaining
+                and self._watch_len(seq) <= MEGASTEP_WATCH_W
+                and self.engine.max_model_len - (p0 + chunk) >= n_steps - 1
+                and self._grow_blocks(seq, chunk + n_steps - 1)
+            )
+            rows.append((seq, seq.prompt[p0 : p0 + chunk], p0, p0 + chunk))
+            kinds.append("p")
+            drafts.append([])
+            feed_rows.append(None)
+            cont.append(cont_ok)
+            total += chunk
+        if not rows or not any(cont):
+            return None  # nothing continues on device: plain step is exact
+
+        n_chunk = len(rows) - n_decode
+        n_sample = [
+            len(tl) if kind == "v" else 1
+            for (_, tl, _, _), kind in zip(rows, kinds)
+        ]
+        S = self._decode_width(len(rows))
+        pend = self._dispatch_fused(
+            rows, S, n_sample, feed_rows, kinds, drafts, cont, n_steps
+        )
+        R = self._spec_R if any(n > 1 for n in n_sample) else 1
+        deterministic = n_spec_rows == 0
+        adv: dict[str, tuple[int, int, int]] = {}
+        feed_index: dict[str, int] = {}
+        last_flat = (n_steps - 1) * S * R
+        for i, ((seq, toks_list, p0, _kv), kind) in enumerate(zip(rows, kinds)):
+            if kind in ("d", "v"):
+                if drafts[i]:
+                    # Data-dependent advance (live draft): the async loop
+                    # commits before planning over it; the overlay only
+                    # needs the guaranteed lower bound.
+                    adv[seq.request_id] = (0, 1, 1)
+                else:
+                    adv[seq.request_id] = (0, n_steps, n_steps)
+                    if deterministic:
+                        feed_index[seq.request_id] = last_flat + i * R
+            else:
+                chunk = len(toks_list)
+                if cont[i]:
+                    adv[seq.request_id] = (chunk, chunk + n_steps - 1, n_steps)
+                    if deterministic:
+                        feed_index[seq.request_id] = last_flat + i * R
+                else:
+                    done = p0 + chunk >= seq.prompt_len
+                    adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
+                    if done and deterministic:
+                        feed_index[seq.request_id] = i * R
+
+        # dynalint: holds-lock(_step_lock) — commits run inside the step
+        def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
+            outputs: list[tuple[Sequence, LLMEngineOutput]] = []
+            toks3, lps3 = pend.land()  # [n_steps, S, R]
+            now = time.time()
+            drafted_total = accepted_total = spec_emitted = 0
+            emitted_total = 0
+            live = {id(s) for s in self.running}
+            # Iteration-0 single-slot views: the k=1 commit shape the
+            # prefill-chunk bookkeeping expects.
+            toks0 = toks3[0, :, 0]
+            lps0 = None if lps3 is None else tuple(a[0, :, 0] for a in lps3)
+            for i, ((seq, toks_list, _pos0, _kv), kind) in enumerate(
+                zip(rows, kinds)
+            ):
+                if seq.finish is not None or seq.cancelled or id(seq) not in live:
+                    continue  # late finish/preempt: discard the lane
+                if kind == "p":
+                    tok, lp = self._advance_prefill_chunk(
+                        seq, len(toks_list), toks0, lps0, i, t_step, now
+                    )
+                    if tok is None:
+                        continue  # mid-prompt: masked no-ops ran on device
+                    if not cont[i]:
+                        # Degraded lane: exactly the single-step books.
+                        seq.pending = tok
+                        seq.generated += 1
+                        outputs.append((seq, self._emit(seq, tok, lp)))
+                        emitted_total += 1
+                        if seq.finish is not None:
+                            self._finish(seq)
+                        continue
+                    # Fused continuation: E = [t0] + scanned tokens; the
+                    # scan wrote E[:-1] past the completed prompt.
+                    E = [tok] + [int(t) for t in toks3[1:, i, 0]]
+                    k_take, finish = self._scan_stop(seq, np.asarray(E))
+                    completed = seq.hashed.extend(E[: k_take - 1])
+                    self._commit_completed(seq, completed)
+                    seq.processed += k_take - 1
+                    seq.generated += k_take
+                    emitted = E[:k_take]
+                    lp_entries = None
+                    if lps3 is not None and seq.logprobs is not None:
+                        lp_entries = [lp] + [
+                            _lp_entry(
+                                emitted[j], lps3[0][j][i][0],
+                                lps3[1][j][i][0], lps3[2][j][i][0],
+                                seq.logprobs,
+                            )
+                            for j in range(1, k_take)
+                        ]
+                    outputs.append(
+                        (seq, self._emit_chunk(seq, emitted, lp_entries, finish))
+                    )
+                    emitted_total += len(emitted)
+                    if finish is not None:
+                        seq.finish = finish
+                        self._finish(seq)
+                    else:
+                        seq.pending = emitted[-1]
+                    continue
+                # Decode / verify rows: replay the device accept — the
+                # longest drafted prefix matching the target's own
+                # per-position choices (deterministic, so host and
+                # device can never disagree).
+                draft = drafts[i]
+                d = len(draft)
+                a = 0
+                while a < d and int(toks3[0, i, a]) == draft[a]:
+                    a += 1
+                if d:
+                    self.spec_stats.observe_row(d, a)
+                E = [int(toks3[0, i, j]) for j in range(a + 1)] + [
+                    int(t) for t in toks3[1:, i, 0]
+                ]
+                k_take, finish = self._scan_stop(seq, np.asarray(E))
+                # Valid cache writes: the old pending token, the accepted
+                # drafted tokens, and the scanned continuation. Rejected
+                # drafts' K/V sits PAST the cursor — never attended, and
+                # overwritten in place by the on-device continuation.
+                written = [seq.pending] + E[: k_take - 1]
+                completed = seq.hashed.extend(written)
+                self._commit_completed(seq, completed)
+                seq.processed += k_take
+                seq.generated += k_take
+                emitted = E[:k_take]
+                lp_entries = None
+                if lps3 is not None and seq.logprobs is not None:
+                    def _at(j, a=a, i=i):
+                        return (0, i, j) if j <= a else (j - a, i, 0)
+                    lp_entries = [
+                        _lp_entry(
+                            emitted[j], lps3[0][_at(j)], lps3[1][_at(j)],
+                            lps3[2][_at(j)], seq.logprobs,
+                        )
+                        for j in range(k_take)
+                    ]
+                outputs.append(
+                    (seq, self._emit_chunk(seq, emitted, lp_entries, finish))
+                )
+                emitted_total += len(emitted)
+                if d:
+                    drafted_total += d
+                    accepted_total += a
+                    spec_emitted += len(emitted)
+                if finish is not None:
+                    seq.finish = finish
+                    self._finish(seq)
+                else:
+                    seq.pending = emitted[-1]
+
+            t_done = time.time()
+            if n_spec_rows:
+                self.spec_stats.verify_steps += 1
+                self._tracer.record(
+                    "spec_verify", t_drafted, t_done,
+                    attrs={
+                        "seqs": n_spec_rows, "drafted": drafted_total,
+                        "accepted": accepted_total, "tokens": spec_emitted,
+                    },
+                    stat=True,
+                )
+            st = self.sched_stats
+            if n_chunk:
+                st["mixed_steps"] += 1
+                st["last_step_batched_tokens"] = total
+                st["last_step_budget_utilization"] = (
+                    total / budget if budget else 0.0
+                )
+                st["chunked_prefills_in_flight"] = sum(
+                    1 for s in self.running
+                    if not s.prefill_done and s.t_first_sched
+                )
+                self._tracer.record(
+                    "engine_mixed_step", t_step, t_done,
+                    attrs={
+                        "seqs": len(rows), "decode_rows": n_decode,
+                        "prefill_tokens": total - decode_row_tokens,
+                        "budget": budget,
+                    },
+                    stat=True,
+                )
+            else:
+                self._tracer.record(
+                    "engine_decode_step", t_step, t_done,
+                    attrs={
+                        "seqs": len(rows), "chain": n_steps,
+                        "tokens": emitted_total,
+                    },
+                    stat=True,
+                )
+            self._tracer.record(
+                "engine_megastep", t_step, t_done,
+                attrs={
+                    "seqs": len(rows), "inner_steps": n_steps,
+                    "tokens": emitted_total,
+                    "fused_shapes": {
+                        "decode": kinds.count("d"),
+                        "chunk": kinds.count("p"),
+                        "verify": kinds.count("v"),
+                    },
+                },
+                stat=True,
+            )
+            return outputs
+
+        return _PlannedStep(
+            core=self, commit_fn=commit, adv=adv,
+            feed_tokens=pend.toks, feed_index=feed_index,
+            deterministic=deterministic,
+        )
+
     def _scan_stop(self, seq: Sequence, toks: np.ndarray) -> tuple[int, str | None]:
         """Vectorized stop scan over a decode chain's sampled tokens:
         returns (tokens emitted, finish reason or None). Token-level
@@ -2911,6 +3603,14 @@ class EngineCore:
         if k_cfg > 1 and any(
             self._watch_len(s) > MEGASTEP_WATCH_W for s in seqs
         ):
+            # The one documented forced-k=1 path: surfaced on /metrics so
+            # the mixed-traffic smoke can assert it never fires for
+            # ordinary requests (ISSUE 12 acceptance). Counted once per
+            # engine iteration — the fused attempt and its legacy
+            # fallback both land here for the same forced batch.
+            if getattr(self, "_forced_single_iter", -1) != self.iterations:
+                self._forced_single_iter = self.iterations
+                self.exec_stats["megastep_forced_single"] += 1
             if not getattr(self, "_watch_overflow_warned", False):
                 self._watch_overflow_warned = True
                 over = next(

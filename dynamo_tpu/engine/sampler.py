@@ -100,6 +100,55 @@ def stop_flags(
     return watch_hit | budget_hit
 
 
+def resolve_verify(
+    sampled: jax.Array,    # [S, R] int32 — target choices per verify slot
+    draft: jax.Array,      # [S, R-1] int32 — drafted tokens, -1 padded
+    draft_len: jax.Array,  # [S] int32 — live draft length (0 = plain row)
+) -> tuple[jax.Array, jax.Array]:  # (accepted [S], next_token [S])
+    """On-device accept/reject for FUSED verify rows (the universal
+    megastep): ``accepted`` is the longest drafted prefix the target
+    agrees with — slot j of ``sampled`` is the target's own
+    ``(seed, counter + j)``-keyed choice after the row's j-th token, so
+    comparing it against ``draft[j]`` replays exactly the host-side
+    accept loop — and ``next_token`` is the target's correction (or
+    bonus) choice at slot ``accepted``, the token the lane continues
+    decoding from inside the same dispatch. Rows that drafted nothing
+    (decode rows, prefill chunks, draft-less verify rows) resolve to
+    ``accepted == 0`` and their slot-0 sample, which is the plain
+    single-step contract."""
+    R = sampled.shape[1]
+    if R == 1:
+        zero = jnp.zeros(sampled.shape[0], jnp.int32)
+        return zero, sampled[:, 0]
+    j = jnp.arange(R - 1, dtype=jnp.int32)[None, :]
+    match = (sampled[:, :-1] == draft) & (j < draft_len[:, None])
+    acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    nxt = jnp.take_along_axis(sampled, acc[:, None], axis=1)[:, 0]
+    return acc, nxt
+
+
+def stop_flags_prefix(
+    sampled: jax.Array,    # [S, R] int32 — iteration-0 sampled slots
+    accepted: jax.Array,   # [S] int32 — emitted slots are 0..accepted
+    watch: jax.Array,      # [S, W] int32 — per-lane stop ids, -1 padded
+    budgets: jax.Array,    # [S] int32 — remaining max-tokens budget
+    min_left: jax.Array,   # [S] int32 — tokens until min_tokens passes
+) -> jax.Array:            # [S] bool — True where the lane stops in iter 0
+    """Stop detection over a fused megastep's FIRST iteration, whose
+    emission count is data-dependent (a verify row emits accepted + 1
+    tokens): slot j — generation j+1 of this dispatch — stops the lane
+    if it is actually emitted (j <= accepted) and samples a watched id
+    past the min-tokens floor, or lands on the budget edge. Same
+    under-stop-never-over-stop contract as :func:`stop_flags`; the host
+    stop-scan stays the authority."""
+    R = sampled.shape[1]
+    gen = jnp.arange(1, R + 1, dtype=jnp.int32)[None, :]
+    emitted = (gen - 1) <= accepted[:, None]
+    watch_hit = (sampled[:, :, None] == watch[:, None, :]).any(axis=2)
+    hit = (watch_hit & (gen >= min_left[:, None])) | (gen >= budgets[:, None])
+    return (hit & emitted).any(axis=1)
+
+
 def token_logprobs(
     logits: jax.Array,   # [B, V] float32 (raw, pre-temperature)
     tokens: jax.Array,   # [B] int32 — the sampled/chosen tokens

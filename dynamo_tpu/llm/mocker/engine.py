@@ -79,14 +79,16 @@ class MockEngineArgs:
     spec_decode: str = "off"
     spec_k: int = 4
     spec_acceptance_rate: float = 0.6
-    # Decode megastep (mirrors EngineConfig.megastep_k): decode-only
-    # iterations fuse k device steps under ONE per-dispatch host overhead
-    # (base_iter_us) — each decode lane runs up to k inner iterations and
-    # the device term prices k lane-iterations per lane (lanes that stop
-    # early still pay the masked no-op iterations, like the real scan).
-    # Mixed prefill+decode iterations and spec verify rows stay
-    # single-step (the real engine's first cut does the same). Token
-    # VALUES are unchanged — the stream is bit-identical to k=1.
+    # UNIVERSAL megastep (mirrors EngineConfig.megastep_k, ISSUE 12):
+    # every iteration with decode work fuses k device steps under ONE
+    # per-dispatch host overhead (base_iter_us) — decode lanes run up to
+    # k inner iterations, spec verify lanes resolve accept/reject inside
+    # the fused iteration and emit (1 + accepted) + (k - 1) tokens, and
+    # prefill chunks ride the same priced dispatch (mixed traffic no
+    # longer forces k=1). The device term prices k lane-iterations per
+    # lane — lanes that stop early still pay the masked no-op
+    # iterations, like the real scan. Token VALUES are unchanged — the
+    # stream is bit-identical to k=1.
     megastep_k: int = 1
     # Quantized KV cache (mirrors EngineConfig.kv_dtype): decode
     # attention is DMA-latency-bound (PERF.md), so the cost model prices
@@ -270,6 +272,12 @@ class MockTpuEngine:
             "megastep_dispatches": 0,
             "single_step_dispatches": 0,
             "committed_tokens": 0,
+            # Universal megastep (ISSUE 12), mirroring EngineCore:
+            # dispatches that fused mixed/verify work, and (real-engine
+            # only — the mocker never truncates a watch) batches forced
+            # to k=1 by the device stop-watch overflow.
+            "fused_mixed_dispatches": 0,
+            "megastep_forced_single": 0,
             # Overload counters (ISSUE 10), mirroring EngineCore.
             "shed_total": 0,
             "deadline_expired_total": 0,
@@ -702,21 +710,25 @@ class MockTpuEngine:
             not s.prefill_done and not s.cancelled for s in self._running
         )
         prefill_only = self.args.scheduling == "waves" and any_prefill
-        # Decode MEGASTEP (first cut mirrors the real engine): only
-        # decode-ONLY iterations fuse — any prefill work this iteration
-        # forces k=1 (a mixed step), and spec verify lanes always run
-        # single-step. k caps at the batch's largest remaining budget,
-        # like EngineCore._chain_length.
+        # UNIVERSAL megastep (ISSUE 12, mirroring the real engine):
+        # every iteration with decode work fuses — prefill chunks ride
+        # the same priced dispatch and spec verify lanes resolve
+        # accept/reject inside it, so mixed traffic no longer forces
+        # k=1. k caps at the batch's largest remaining budget, like
+        # EngineCore._chain_length. (waves scheduling still stalls
+        # decodes during a wave via prefill_only — nothing to fuse.)
         k_mega = 1
-        if self.args.megastep_k > 1 and not any_prefill:
+        if self.args.megastep_k > 1 and not prefill_only:
             remaining = [
                 max(1, s.max_tokens - s.generated)
                 for s in self._running
-                if s.prefill_done and not s.cancelled and not s.spec_k
+                if s.prefill_done and not s.cancelled
             ]
             if remaining:
                 k_mega = min(self.args.megastep_k, max(remaining))
         mega_lanes = 0
+        mega_verify_lanes = 0
+        chunk_rows = 0
         tokens_emitted = 0
         prefill_tokens = 0
         decode_seqs = 0
@@ -744,6 +756,7 @@ class MockTpuEngine:
                 if chunk <= 0:
                     continue
                 self._mark_first_sched(seq)
+                chunk_rows += 1
                 start_block = seq.prefilled // self.args.block_size
                 seq.prefilled += chunk
                 prefill_tokens += chunk
@@ -760,13 +773,16 @@ class MockTpuEngine:
             if prefill_only:
                 continue  # waves: decodes stall for the whole wave
 
-            # Decode: one token per iteration — or a MEGASTEP of up to
-            # k_mega fused inner iterations under one dispatch overhead —
-            # or, speculating, a verify row emitting 1 + accepted tokens
-            # (acceptance simulated; verify rows force k=1). Token VALUES
-            # are unchanged in every mode: the stream is bit-identical,
-            # only the chunking and the virtual clock move.
-            inner = 1 if seq.spec_k else k_mega
+            # Decode: one token per iteration — or a UNIVERSAL MEGASTEP
+            # of up to k_mega fused inner iterations under one dispatch
+            # overhead. A speculating lane's verify row resolves inside
+            # the fused iteration: it emits (1 + accepted) tokens for
+            # iteration 0 plus one per remaining inner iteration,
+            # mirroring the real engine's on-device accept/reject +
+            # scanned continuation. Token VALUES are unchanged in every
+            # mode: the stream is bit-identical, only the chunking and
+            # the virtual clock move.
+            inner = k_mega
             decode_seqs += inner  # lane-iterations: device term prices
             #                       masked no-ops too, like the real scan
             # KV traffic term: each lane-iteration's attention reads the
@@ -777,6 +793,8 @@ class MockTpuEngine:
             kv_blocks_read += lane_blocks
             if inner > 1:
                 mega_lanes += 1
+                if seq.spec_k:
+                    mega_verify_lanes += 1
             drafted = min(
                 seq.spec_k, max(0, budget - prefill_tokens - spec_tokens)
             )
@@ -788,7 +806,7 @@ class MockTpuEngine:
             emitted: list[int] = []
             finish = None
             stalled = False
-            for _ in range((1 + accepted) if seq.spec_k else inner):
+            for _ in range((1 + accepted) + (inner - 1) if seq.spec_k else inner):
                 # 'a'..'z' cycle (ByteTokenizer); replay_base keeps a
                 # migrated continuation on the original cycle position.
                 token = 97 + ((seq.replay_base + seq.generated) % 26)
@@ -815,6 +833,8 @@ class MockTpuEngine:
                 kv_blocks_read -= lane_blocks
                 if inner > 1:
                     mega_lanes -= 1
+                    if seq.spec_k:
+                        mega_verify_lanes -= 1
                 self.sched_stats["decode_stalls"] += 1
                 continue  # stalled this iteration (preemption-lite)
             tokens_emitted += len(emitted)
@@ -871,6 +891,10 @@ class MockTpuEngine:
             st["dispatches"] += 1
             if mega_lanes:
                 st["megastep_dispatches"] += 1
+                if chunk_rows or mega_verify_lanes:
+                    # A fused MIXED dispatch (ISSUE 12): prefill chunks
+                    # and/or verify rows rode the same priced megastep.
+                    st["fused_mixed_dispatches"] += 1
                 now = time.time()
                 # Same span name + attrs as EngineCore's megastep commit
                 # (zero-width on the mocker's free host clock) so /traces
@@ -880,6 +904,11 @@ class MockTpuEngine:
                     attrs={
                         "seqs": mega_lanes, "inner_steps": k_mega,
                         "tokens": tokens_emitted,
+                        "fused_shapes": {
+                            "decode": mega_lanes - mega_verify_lanes,
+                            "chunk": chunk_rows,
+                            "verify": mega_verify_lanes,
+                        },
                     },
                     stat=True,
                 )

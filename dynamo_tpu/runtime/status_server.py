@@ -78,8 +78,22 @@ SCHEDULER_GAUGES: dict[str, tuple[str, str]] = {
     ),
     "single_step_dispatches": (
         "scheduler_single_step_dispatches_total",
-        "Single-iteration device dispatches (prefill waves, mixed steps, "
-        "verify rows, k == 1 decode)",
+        "Single-iteration device dispatches (prefill waves, k == 1 "
+        "mixed steps / verify rows / decode)",
+    ),
+    # Universal megastep (ISSUE 12): the lifted-carve-out evidence.
+    "fused_mixed_dispatches": (
+        "scheduler_fused_mixed_dispatches_total",
+        "Universal-megastep dispatches that fused a ragged mixed/verify "
+        "first iteration (prefill chunks / spec verify rows) with "
+        "scanned decode continuation",
+    ),
+    "megastep_forced_single": (
+        "scheduler_megastep_forced_single_total",
+        "Megastep batches forced back to k=1 because a lane's stop "
+        "watch overflowed the device's slots — the ONE documented "
+        "un-fused path; anything non-zero without >8-stop-id requests "
+        "is a bug",
     ),
     "dispatches_per_token": (
         "engine_dispatches_per_token",

@@ -201,6 +201,60 @@ async def test_stalled_worker_detected_and_migrated_within_budget():
 
 
 # ---------------------------------------------------------------------------
+# Scenario 2b (ISSUE 12): a worker running the UNIVERSAL megastep —
+# chunked scheduling + spec decode, k=8 — fails MID-MEGASTEP with fused
+# verify rows in flight. Kill (dead socket) and stall (wedged loop, only
+# the per-frame deadline can see it) both route the stream through
+# migration, and the replayed continuation is bit-identical to the
+# no-fault run: the fused chunking changes how many tokens ride each
+# frame, never which tokens the client sees.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("action", ["kill", "stall"])
+async def test_fused_megastep_worker_failure_bit_identical(action):
+    args = MockEngineArgs(
+        num_kv_blocks=512, block_size=8, decode_us_per_seq=20000.0,
+        scheduling="chunked", prefill_chunk=8,
+        megastep_k=8, spec_decode="ngram", spec_k=4,
+    )
+
+    # No-fault baseline first (fresh fleet: no shared state).
+    async with Fleet(1, args, stall_s=5.0) as f:
+        baseline: list[int] = []
+        async for out in f.migration.generate(make_req("base-f", max_tokens=40)):
+            baseline.extend(out.token_ids)
+    assert baseline == expected_tokens(40)
+
+    async with Fleet(2, args, stall_s=0.8) as f:
+        tokens: list[int] = []
+        hit = False
+        async for out in f.migration.generate(make_req("fused-1", max_tokens=40)):
+            tokens.extend(out.token_ids)
+            if not hit and len(tokens) >= 3:
+                hit = True
+                victim_rt, victim = f.serving_worker()
+                # The victim really is mid-fused-traffic: fused verify
+                # dispatches ran (not plain single-step decode).
+                assert victim.sched_stats["megastep_dispatches"] >= 1
+                assert victim.sched_stats["fused_mixed_dispatches"] >= 1
+                assert victim.spec_stats.verify_rows >= 1
+                if action == "kill":
+                    await victim_rt.shutdown()  # dies with the stream in flight
+                else:
+                    chaos.install(ChaosPlan([
+                        ChaosRule(
+                            point="engine.step", action="stall",
+                            match=victim.chaos_tag, stall_s=60.0,
+                        ),
+                    ], seed=7))
+        assert hit, "stream finished before the failure landed — slow the mocker"
+        # Bit-identical to the no-fault run: the fused in-flight verify
+        # rows were lost with the worker and replayed exactly.
+        assert tokens == baseline
+
+
+# ---------------------------------------------------------------------------
 # Scenario 3: store session flap — sever the control-plane stream; the
 # session rebuilds (leases re-attached, watches replayed) and the fleet
 # keeps serving.

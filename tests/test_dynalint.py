@@ -56,7 +56,9 @@ PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     # _plan_waves/_maybe_ring_prefill/_run_ring_prefill, the four
     # per-scheduler commit closures, _apply_verify_row, _account_transfer,
     # plus the original _finish/_sweep_expired_holds/transfer endpoints.
-    ("dynamo_tpu/engine/core.py", "holds-lock", "_step_lock"): 15,
+    # +1 in ISSUE 12: the universal-megastep fused commit closure
+    # (_plan_fused.commit) joins the verified chain.
+    ("dynamo_tpu/engine/core.py", "holds-lock", "_step_lock"): 16,
     # Intentional syncs inside blocking-host-sync hot paths: the
     # double-buffered landing point (_PendingFetch.land — tokens +
     # batched logprobs), np.asarray over host block-id lists (dispatch

@@ -1,4 +1,5 @@
-"""Device-side multi-step decode — the MEGASTEP (ISSUE 7).
+"""Device-side multi-step decode — the MEGASTEP (ISSUE 7) and its
+UNIVERSAL extension (ISSUE 12).
 
 The tentpole contract: with ``megastep_k = k`` the engine fuses k decode
 iterations into ONE device dispatch — an on-device scan over the ragged
@@ -13,6 +14,14 @@ roll back via the ``num_computed_tokens`` cursor; block headroom for all
 k tokens per lane is reserved at plan time, so mid-megastep block
 exhaustion is impossible by construction (pressure surfaces as
 drain→preempt BEFORE the dispatch).
+
+ISSUE 12 lifts the first cut's k=1 carve-outs: chunked mixed steps and
+spec verify rows now ride the same scanned body — verify rows resolve
+accept/reject ON DEVICE (rejected drafts roll back inside the dispatch
+via the lane's position cursor) and prefill chunks that complete their
+prompt continue as decode rows in the remaining inner iterations. The
+only forced-k=1 path left is a stop watch wider than the device's
+MEGASTEP_WATCH_W slots, surfaced on the megastep_forced_single gauge.
 """
 
 import asyncio
@@ -400,10 +409,11 @@ def test_single_step_engine_reports_no_megasteps():
     assert st["dispatches_per_token"] >= 1.0  # one dispatch per token + prefill
 
 
-def test_spec_verify_rows_force_single_step():
-    """Speculating lanes never ride a megastep: their verify dispatch is
-    single-step (q_len<=k+1 ragged rows), and the stream still matches
-    the unfused, unspeculated engine."""
+def test_spec_verify_rows_fuse_on_device():
+    """ISSUE 12: speculating lanes RIDE the megastep — verify rows
+    resolve accept/reject inside the scanned dispatch (rejected drafts
+    roll back on device) and the stream still matches the unfused,
+    unspeculated engine bit for bit."""
 
     def run(**kw):
         core = EngineCore(CFG, tiny_engine(**kw), seed=0)
@@ -416,8 +426,267 @@ def test_spec_verify_rows_force_single_step():
     ref, _ = run(megastep_k=1)
     got, core = run(megastep_k=8, spec_decode="ngram", spec_k=4)
     assert got == ref
-    assert core.exec_stats["megastep_dispatches"] == 0
+    assert core.exec_stats["fused_mixed_dispatches"] >= 1
+    assert core.exec_stats["megastep_dispatches"] >= 1
+    assert core.exec_stats["megastep_forced_single"] == 0
     assert core.spec_stats.verify_rows > 0
+
+
+# -- universal megastep (ISSUE 12): fused mixed + spec-verify steps ----------
+
+
+def _spec_workload(core):
+    """Speculation-heavy mixed traffic: repetitive prompts (n-gram bait)
+    across greedy, seeded-temperature, and top-p + logprobs lanes, one
+    incompressible decode lane (drafts rarely), and one long prompt so
+    chunked scheduling interleaves prefill chunks with fused verify
+    rows."""
+    rng = np.random.RandomState(7)
+    return [
+        core.add_request(_req([3, 4, 5] * 4, "sg", max_tokens=18,
+                              ignore_eos=True)),
+        core.add_request(_req([7, 8] * 6, "st", max_tokens=15,
+                              temperature=0.8, seed=21, ignore_eos=True)),
+        core.add_request(_req([2, 4, 6, 2, 4, 6, 2, 4], "sl", max_tokens=12,
+                              temperature=0.9, seed=22, top_p=0.85,
+                              logprobs=3, ignore_eos=True)),
+        core.add_request(_req(list(range(1, 9)), "pd", max_tokens=14,
+                              ignore_eos=True)),
+        core.add_request(_req(list(rng.randint(1, 200, size=120)), "long",
+                              max_tokens=6, ignore_eos=True)),
+    ]
+
+
+@pytest.mark.parametrize("scheduling", ["waves", "chunked"])
+@pytest.mark.parametrize("k", [2, 8])
+def test_parity_fused_mixed_spec(scheduling, k):
+    """The ISSUE 12 acceptance invariant: with spec decode ON and mixed
+    traffic, --megastep-k k fuses verify rows (accept/reject resolved on
+    device) and prefill chunks into scanned dispatches, and the stream —
+    tokens, finish reasons, logprob payloads — is bit-identical to the
+    single-step engine AND to the unspeculated single-step engine."""
+
+    def run(kk, spec):
+        core = EngineCore(
+            CFG,
+            tiny_engine(
+                megastep_k=kk, scheduling=scheduling, prefill_chunk=32,
+                **(dict(spec_decode="ngram", spec_k=4) if spec else {}),
+            ),
+            seed=0,
+        )
+        return drive(core, _spec_workload(core)), core
+
+    base, _ = run(1, spec=False)
+    ref, _ = run(1, spec=True)
+    got, core = run(k, spec=True)
+    assert base == ref == got
+    assert core.exec_stats["fused_mixed_dispatches"] >= 1
+    assert core.exec_stats["megastep_forced_single"] == 0
+    assert core.spec_stats.verify_rows > 0
+
+
+@pytest.mark.parametrize("async_exec", [False, True])
+def test_parity_fused_async_composition(async_exec):
+    """Universal megastep x async-exec: fused steps carrying live drafts
+    are a pipeline barrier (data-dependent advance), draft-less fused
+    steps keep the one-step-ahead overlap — stream identical to the
+    synchronous single-step loop either way."""
+
+    def run(kk, ae, spec):
+        core = EngineCore(
+            CFG,
+            tiny_engine(
+                megastep_k=kk, scheduling="chunked", prefill_chunk=32,
+                async_exec=ae,
+                **(dict(spec_decode="ngram", spec_k=4) if spec else {}),
+            ),
+            seed=0,
+        )
+        return drive(core, _spec_workload(core))
+
+    assert run(1, False, spec=False) == run(8, async_exec, spec=True)
+
+
+def test_eos_inside_fused_verify_continuation():
+    """A seeded lane that samples EOS inside the scanned continuation of
+    a FUSED verify dispatch finishes identically to the single-step
+    engine — the on-device stop flags see it (masked no-ops follow), the
+    host stop-scan confirms it, and the spec machinery never resurrects
+    the lane."""
+    probe = EngineCore(CFG, tiny_engine(megastep_k=1), seed=0)
+    s = probe.add_request(_req(
+        [5, 6] * 4, "p", max_tokens=12, temperature=0.9, seed=42,
+        ignore_eos=True,
+    ))
+    d, _, _ = drive(probe, [s])
+    eos = d["p"][4]
+    if eos in d["p"][:4]:
+        pytest.skip("seeded stream repeats before position 4")
+
+    def run(k):
+        core = EngineCore(
+            CFG,
+            tiny_engine(megastep_k=k, spec_decode="ngram", spec_k=4),
+            seed=0, eos_token_ids=(eos,),
+        )
+        seqs = [
+            core.add_request(_req(
+                [5, 6] * 4, "e", max_tokens=12, temperature=0.9, seed=42,
+            )),
+            core.add_request(_req([3, 4, 5] * 3, "n", max_tokens=12,
+                                  ignore_eos=True)),
+        ]
+        return drive(core, seqs)[:2]
+
+    d1, f1 = run(1)
+    d8, f8 = run(8)
+    assert d1 == d8
+    assert f1 == f8
+    assert f8["e"] == "eos"
+
+
+def test_fused_gauges_and_span_shapes():
+    """Observability (ISSUE 12 satellite): fused mixed dispatches export
+    on the scheduler gauges, and every engine_megastep span carries a
+    fused_shapes attr with decode/chunk/verify row counts."""
+    tracing.configure(enabled=True, sample=1.0)
+    collector = tracing.get_collector()
+    collector.clear()
+    core = EngineCore(
+        CFG,
+        tiny_engine(
+            megastep_k=8, scheduling="chunked", prefill_chunk=32,
+            spec_decode="ngram", spec_k=4,
+        ),
+        seed=0,
+    )
+    drive(core, _spec_workload(core))
+    spans = [s for s in collector.stats() if s.name == "engine_megastep"]
+    assert spans, "engine_megastep span missing"
+    assert all("fused_shapes" in s.attrs for s in spans)
+    assert all(s.attrs["inner_steps"] > 1 for s in spans)
+    assert any(s.attrs["fused_shapes"]["verify"] >= 1 for s in spans)
+    assert any(s.attrs["fused_shapes"]["chunk"] >= 1 for s in spans)
+    st = core.scheduler_stats()
+    assert st["fused_mixed_dispatches"] >= 1
+    assert st["megastep_forced_single"] == 0
+    assert st["megastep_dispatches"] >= 1
+    assert 0 < st["dispatches_per_token"] < 1.0
+
+
+def test_watch_overflow_forces_single_step_with_spec():
+    """The ONE documented forced-k=1 path survives the universal
+    megastep: a speculating request watching more stop ids than the
+    device's MEGASTEP_WATCH_W slots falls back to single-step verify
+    dispatches (host stop-scan sees the full list), the stream stays
+    correct, and the forced-single gauge records it."""
+    probe = EngineCore(CFG, tiny_engine(megastep_k=1), seed=0)
+    s = probe.add_request(_req([3, 4, 5] * 3, "p", max_tokens=20,
+                               ignore_eos=True))
+    d, _, _ = drive(probe, [s])
+    stop_tok = d["p"][5]
+    stop_ids = list(range(300, 300 + MEGASTEP_WATCH_W)) + [stop_tok]
+
+    core = EngineCore(
+        CFG,
+        tiny_engine(megastep_k=8, spec_decode="ngram", spec_k=4),
+        seed=0,
+    )
+    seq = core.add_request(_req(
+        [3, 4, 5] * 3, "x", max_tokens=20, stop_token_ids=stop_ids,
+        ignore_eos=True,
+    ))
+    done, fins, _ = drive(core, [seq])
+    assert done == {"x": d["p"][:6]}
+    assert fins == {"x": "stop"}
+    assert core.exec_stats["megastep_dispatches"] == 0
+    assert core.exec_stats["fused_mixed_dispatches"] == 0
+    assert core.exec_stats["megastep_forced_single"] >= 1
+
+
+@pytest.mark.parametrize("async_exec", [False, True])
+def test_fused_block_headroom_under_pressure(async_exec):
+    """The full fused headroom — n_steps per decode lane, n_steps +
+    draft per verify lane, chunk + n_steps - 1 per completing prefill
+    chunk — is reserved at plan time: pressure surfaces as preemption
+    (or drain-then-preempt under async) BEFORE the dispatch, and the
+    replayed stream still matches an unpressured single-step run."""
+
+    def run(blocks, k, ae, spec):
+        core = EngineCore(
+            CFG,
+            tiny_engine(
+                num_kv_blocks=blocks, max_model_len=64, megastep_k=k,
+                scheduling="chunked", async_exec=ae,
+                **(dict(spec_decode="ngram", spec_k=4) if spec else {}),
+            ),
+            seed=0,
+        )
+        seqs = [
+            core.add_request(_req([5, 6] * 8, "a", max_tokens=24,
+                                  ignore_eos=True)),
+            core.add_request(_req([7, 8] * 8, "b", max_tokens=24,
+                                  ignore_eos=True)),
+        ]
+        done, fins, _ = drive(core, seqs, max_steps=8000)
+        assert core.allocator._partials == 0
+        return done, fins, core
+
+    ref = run(64, 1, False, spec=False)[:2]
+    d, f, core = run(7, 8, async_exec, spec=True)
+    assert (d, f) == ref
+    assert core.sched_stats["preemptions"] >= 1
+
+
+def test_fused_waves_spec_respects_token_budget():
+    """A token budget SMALLER than the speculating lane count (waves
+    engine — chunked validates the budget up front, waves does not):
+    over-budget lanes defer to later fused steps via the rotation cap,
+    exactly like the legacy verify path's budget break — no bucket
+    overflow, and the stream stays bit-identical to k=1."""
+
+    def run(k):
+        core = EngineCore(
+            CFG,
+            tiny_engine(
+                megastep_k=k, spec_decode="ngram", spec_k=4,
+                max_num_batched_tokens=4,
+            ),
+            seed=0,
+        )
+        seqs = [
+            core.add_request(_req([3, 4, 5] * 3, f"s{i}", max_tokens=10,
+                                  ignore_eos=True))
+            for i in range(6)
+        ]
+        return drive(core, seqs)
+
+    assert run(1) == run(8)
+
+
+def test_cancel_mid_fused_megastep_discards_in_flight():
+    """Cancel between steps with a fused mixed/verify dispatch in
+    flight: the lane's optimistic tokens discard at commit and blocks
+    release exactly once."""
+    core = EngineCore(
+        CFG,
+        tiny_engine(
+            megastep_k=8, scheduling="chunked", async_exec=True,
+            spec_decode="ngram", spec_k=4,
+        ),
+        seed=0,
+    )
+    seq = core.add_request(_req([3, 4, 5] * 3, "c", max_tokens=50,
+                                ignore_eos=True))
+    core.step()  # dispatch prefill
+    core.step()  # dispatch fused step 1, commit prefill
+    core.cancel_request(seq)
+    for _ in range(5):
+        core.step()
+    assert not core.has_work()
+    assert seq not in core.running
+    assert core.allocator._partials == 0
 
 
 # -- mocker virtual-clock A/B -------------------------------------------------
@@ -483,24 +752,32 @@ def test_mocker_megastep_ab_halves_tpot_at_k8():
     assert st8["megastep_k"] == 8
 
 
-def test_mocker_megastep_forces_k1_on_mixed_and_spec():
+def test_mocker_megastep_fuses_spec_lanes():
+    """ISSUE 12 mocker mirror: spec verify lanes RIDE the megastep —
+    fused iterations emit (1 + accepted) + (k - 1) tokens per lane under
+    ONE priced dispatch, the stream stays bit-identical to k=1, and the
+    fused_mixed_dispatches gauge records the lifted carve-out."""
     from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine
 
     with pytest.raises(ValueError, match="megastep_k"):
         MockTpuEngine(MockEngineArgs(megastep_k=0))
-    # Spec lanes emit verify-row chunks, never k-fused megasteps.
-    _, st = _mock_megastep_sim_spec()
-    assert st["megastep_dispatches"] == 0
+    s1, st1 = _mock_megastep_sim_spec(1)
+    s8, st8 = _mock_megastep_sim_spec(8)
+    assert s1 == s8
+    assert st1["megastep_dispatches"] == 0
+    assert st8["megastep_dispatches"] > 0
+    assert st8["fused_mixed_dispatches"] > 0
+    assert st8["dispatches"] < st1["dispatches"]
 
 
-def _mock_megastep_sim_spec():
+def _mock_megastep_sim_spec(k: int):
     from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine, _Seq
     from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
 
     args = MockEngineArgs(
         num_kv_blocks=512, block_size=32, max_num_seqs=4,
         max_num_batched_tokens=2048, enable_prefix_caching=False,
-        megastep_k=8, spec_decode="ngram", spec_k=4,
+        megastep_k=k, spec_decode="ngram", spec_k=4,
     )
     eng = MockTpuEngine(args)
     seqs = []

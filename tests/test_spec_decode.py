@@ -285,9 +285,14 @@ def test_many_spec_lanes_never_exceed_budget():
         steps_to_prefill += 1
         assert core.sched_stats["last_step_batched_tokens"] <= budget
         assert steps_to_prefill < 50, "prefill starved by speculation"
-        # In-flight lanes keep emitting every mixed step.
+        # In-flight lanes keep emitting every mixed step. (Under the
+        # universal megastep a fused step emits up to k tokens per lane,
+        # so the whole cohort can finish while the long prompt still
+        # chunks — the guard only applies while lanes remain.)
         emitted_ids = {s.request_id for s, _ in outs}
-        assert any(s.request_id in emitted_ids for s in lanes if s.finish is None)
+        live_lanes = [s for s in lanes if s.finish is None]
+        if live_lanes:
+            assert any(s.request_id in emitted_ids for s in live_lanes)
     run_to_completion(core, lanes + [long])
 
 

@@ -152,6 +152,10 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
         "EngineCore._plan_prefill_wave.commit",
         "EngineCore._plan_megastep.commit",
         "EngineCore._plan_mixed.commit",
+        # Universal megastep (ISSUE 12): the fused mixed/verify commit
+        # closure applies the same cursor algebra — accept-length
+        # replay, chunk advance, scanned-continuation rollback.
+        "EngineCore._plan_fused.commit",
         "EngineCore._apply_verify_row",
     },
     # The allocator owns its bookkeeping wholesale: every public method is

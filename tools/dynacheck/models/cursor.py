@@ -15,11 +15,21 @@ the explorer drives it through every interleaving of:
 - ``step_verify``    a speculative verify step whose advance is
                      data-dependent (non-deterministic: the next plan is
                      barred until it commits, like the engine's barrier),
+- ``step_fused_verify`` the UNIVERSAL megastep (ISSUE 12): the verify
+                     row resolves accept/reject ON DEVICE inside a fused
+                     dispatch — a rejected draft's K/V write sits past
+                     the cursor and is overwritten in place — and the
+                     lane keeps decoding for the remaining scanned
+                     iteration, emitting (accepted + 1) + 1 tokens in
+                     one commit (still non-deterministic: the advance is
+                     data-dependent),
 - ``drain``          commit the in-flight step with no new plan,
 - ``cancel``         client cancel mid-flight (zombie-lane discard).
 
 Initial-state variants place a device-watched EOS and a host-only stop at
-different stream positions, plus a draft-acceptance pattern for verify.
+different stream positions, plus a draft-acceptance pattern for verify —
+including drafts rejected INSIDE a fused iteration, with and without an
+EOS landing in the fused continuation.
 
 Invariant: the emitted stream is ALWAYS a prefix of the synchronous
 reference stream, the cursor always equals prompt + written tokens, and
@@ -166,6 +176,17 @@ class CursorModel(Model):
             ("host-before-eos", _World(eos_at=4, host_at=3)),
             ("eos-at-boundary", _World(eos_at=3, host_at=None,
                                        draft_hits=(False, True))),
+            # ISSUE 12 worlds: drafts rejected INSIDE a fused iteration —
+            # the on-device rollback (correction token + scanned
+            # continuation) must replay the synchronous trace exactly,
+            # including an EOS sampled by the continuation right after a
+            # rejection and a host-only stop the device cannot see.
+            ("reject-inside-fused", _World(eos_at=None, host_at=None,
+                                           draft_hits=(False, False))),
+            ("reject-then-eos", _World(eos_at=3, host_at=None,
+                                       draft_hits=(False,))),
+            ("reject-then-host-stop", _World(eos_at=None, host_at=2,
+                                             draft_hits=(False, True))),
         ]
         for label, w in worlds:
             yield f"init:{label}", _initial(w)
@@ -185,6 +206,7 @@ class CursorModel(Model):
             acts.append(("step_async_k2", lambda s: self._step_async(s, 2)))
             if state.verify_round < len(state.world.draft_hits):
                 acts.append(("step_verify", self._step_verify))
+                acts.append(("step_fused_verify", self._step_fused_verify))
         if state.inflight is not None:
             acts.append(("drain", lambda s: _commit(s)))
             acts.append(("cancel", self._cancel))
@@ -232,6 +254,37 @@ class CursorModel(Model):
         outputs = (target0, target1) if hit else (target0,)
         new_plan = _Plan(
             kind="verify", n_steps=1 + len(draft), outputs=outputs,
+            adv_proc=1, adv_gen=1, deterministic=False, draft=draft,
+        )
+        committed = _commit(state)
+        return replace(
+            committed, inflight=new_plan,
+            verify_round=state.verify_round + 1,
+        )
+
+    def _step_fused_verify(self, state: _State) -> _State:
+        """The UNIVERSAL megastep (ISSUE 12): one dispatch fuses the
+        verify row with a scanned decode continuation. Accept/reject
+        resolves on device — iteration 0 emits accepted + 1 tokens
+        (the last is the target's correction/bonus choice; a rejected
+        draft's K/V write sits past the cursor and the continuation
+        overwrites it in place) — then the remaining inner iteration
+        decodes from the resolved token. The combined emission is a
+        plain chain over the target's own counter-keyed choices, so the
+        commit is exactly the megastep stop-scan; the advance stays
+        data-dependent, so the plan is non-deterministic and the next
+        plan is barred until it commits (the engine's barrier)."""
+        hit = state.world.draft_hits[state.verify_round]
+        gen0 = state.eff_generated
+        target0 = state.world.token(gen0)
+        draft = (target0,) if hit else (target0 + 100,)
+        # Iteration-0 emission (accepted + 1) plus ONE scanned decode
+        # iteration; EOS inside either part dead-pads the rest, exactly
+        # like _device_outputs' megastep contract.
+        n_out = (2 if hit else 1) + 1
+        outputs = _device_outputs(state.world, gen0, n_out)
+        new_plan = _Plan(
+            kind="fused-verify", n_steps=2, outputs=outputs,
             adv_proc=1, adv_gen=1, deterministic=False, draft=draft,
         )
         committed = _commit(state)

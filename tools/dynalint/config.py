@@ -153,8 +153,9 @@ HOST_SYNC_ASARRAY_ROOTS = {"np", "numpy"}
 HOT_STEP_FUNCS: dict[str, set[str]] = {
     "dynamo_tpu/engine/core.py": {
         "_plan_step", "_plan_waves", "_plan_prefill_wave", "_plan_decode",
-        "_plan_megastep", "_plan_verify", "_plan_mixed", "_merge_plans",
-        "_dispatch_ragged", "_dispatch_megastep", "_grow_or_preempt",
+        "_plan_megastep", "_plan_verify", "_plan_mixed", "_plan_fused",
+        "_merge_plans", "_dispatch_ragged", "_dispatch_megastep",
+        "_dispatch_fused", "_assemble_ragged", "_grow_or_preempt",
         "_admit", "land",
     },
     # Detector fixtures (linted directly by tests; excluded from the tree).

@@ -3,13 +3,24 @@ streams BIT-IDENTICAL output to a twin deployment running single-step
 (k=1), and the k=8 worker records ``engine_megastep`` stat spans (the
 per-dispatch fusion evidence) that the k=1 worker must not.
 
-This is the user-visible contract of device-side multi-step decode
-(ISSUE 7): fusing k decode iterations into one device dispatch changes
-HOW OFTEN the host and device talk — one fixed dispatch overhead per k
-tokens instead of per token — never which tokens are emitted. The same
-greedy request runs against a k=8 deployment and a k=1 deployment
-(fresh store + worker + frontend each, so no state leaks between the
-two), and the full streamed text must match byte for byte.
+Two phases:
+
+1. DECODE-ONLY (ISSUE 7): one greedy request against a plain decode
+   deployment — the original megastep contract.
+2. MIXED TRAFFIC (ISSUE 12): chunked scheduling + spec decode, a short
+   request decoding WHILE a long prompt chunks through the scheduler —
+   the universal-megastep contract. Both streams must match the k=1
+   twin byte for byte, the worker must record >= 1 FUSED mixed dispatch
+   (prefill chunks / verify rows riding the scanned body, the
+   ``fused_mixed_dispatches`` gauge), and ZERO batches may fall back to
+   forced k=1 (``megastep_forced_single`` — only a stop watch wider
+   than the device's 8 slots may ever trip it, and no request here
+   carries one).
+
+This is the user-visible contract of device-side multi-step decode:
+fusing k iterations into one device dispatch changes HOW OFTEN the host
+and device talk — one fixed dispatch overhead per k tokens instead of
+per token — never which tokens are emitted.
 
 CI usage (`.github/workflows/ci.yml` megastep-smoke step) and local:
 
@@ -43,9 +54,24 @@ async def stream_text(session, url: str, body: dict) -> str:
     return "".join(parts)
 
 
-async def run_one(megastep_k: int) -> tuple[str, int]:
-    """Boot store + mocker (megastep k) + frontend, stream one greedy
-    request, and return (streamed text, engine_megastep span count)."""
+def _chat_body(content: str, max_tokens: int) -> dict:
+    return {
+        "model": "mock",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "temperature": 0,
+        "stream": True,
+    }
+
+
+async def run_one(megastep_k: int, mixed: bool) -> tuple[list[str], dict]:
+    """Boot store + mocker (megastep k) + frontend and stream the phase's
+    request(s); return (streamed texts, worker scheduler gauges).
+
+    ``mixed`` drives the ISSUE 12 traffic shape: chunked scheduling +
+    spec decode, with a LONG prompt fired while a short request is
+    mid-decode — its prefill chunks and the short request's fused verify
+    rows must share dispatches."""
     import aiohttp
 
     from dynamo_tpu import tracing
@@ -59,21 +85,37 @@ async def run_one(megastep_k: int) -> tuple[str, int]:
     collector = tracing.get_collector()
     collector.clear()
 
+    if mixed:
+        args = MockEngineArgs(
+            num_kv_blocks=8192,
+            block_size=8,
+            megastep_k=megastep_k,
+            scheduling="chunked",
+            prefill_chunk=256,
+            spec_decode="ngram",
+            spec_k=4,
+            speedup_ratio=50.0,
+        )
+    else:
+        args = MockEngineArgs(
+            num_kv_blocks=8192,
+            block_size=8,
+            megastep_k=megastep_k,
+            speedup_ratio=50.0,
+        )
+
     store = StoreServer()
     await store.start()
     worker_rt = await DistributedRuntime.create(store.address)
     served = asyncio.Event()
+    engines: list = []
     worker = asyncio.create_task(
         run_mocker(
             worker_rt,
             model_name="mock",
-            engine_args=MockEngineArgs(
-                num_kv_blocks=8192,
-                block_size=8,
-                megastep_k=megastep_k,
-                speedup_ratio=50.0,
-            ),
+            engine_args=args,
             served_event=served,
+            engine_out=engines,
         )
     )
     await asyncio.wait_for(served.wait(), 30)
@@ -98,17 +140,26 @@ async def run_one(megastep_k: int) -> tuple[str, int]:
         else:
             raise TimeoutError("model never appeared on frontend")
 
-        text = await stream_text(
-            s, f"{base}/v1/chat/completions",
-            {
-                "model": "mock",
-                "messages": [{"role": "user", "content": "megastep smoke test"}],
-                "max_tokens": 32,
-                "temperature": 0,
-                "stream": True,
-            },
-        )
+        url = f"{base}/v1/chat/completions"
+        if mixed:
+            # Short request first; once its stream is flowing, fire the
+            # LONG prompt (2000 byte-tokens, chunked at 256/step) so its
+            # prefill chunks share iterations with the short request's
+            # fused decode/verify rows.
+            short_task = asyncio.create_task(
+                stream_text(s, url, _chat_body("megastep mixed smoke", 96))
+            )
+            await asyncio.sleep(0.15)  # short request is mid-decode
+            long_text = await stream_text(
+                s, url, _chat_body("long " * 500, 48)
+            )
+            texts = [await short_task, long_text]
+        else:
+            texts = [
+                await stream_text(s, url, _chat_body("megastep smoke test", 32))
+            ]
 
+    stats = dict(engines[0].scheduler_stats()) if engines else {}
     megasteps = [
         sp for sp in collector.stats() if sp.name == "engine_megastep"
     ]
@@ -117,6 +168,9 @@ async def run_one(megastep_k: int) -> tuple[str, int]:
         assert all(
             sp.attrs.get("inner_steps", 0) > 1 for sp in megasteps
         ), "engine_megastep span missing the inner-iteration count"
+        assert all(
+            "fused_shapes" in sp.attrs for sp in megasteps
+        ), "engine_megastep span missing the fused_shapes attr"
     else:
         assert not megasteps, "k=1 worker reported fused megasteps"
 
@@ -125,20 +179,44 @@ async def run_one(megastep_k: int) -> tuple[str, int]:
     for rt in (worker_rt, front_rt):
         await rt.shutdown()
     await store.stop()
-    return text, len(megasteps)
+    return texts, stats
 
 
 async def run() -> None:
-    text_k8, megasteps = await run_one(8)
-    text_k1, _ = await run_one(1)
-    assert text_k8, "megastep deployment streamed nothing"
-    assert text_k8 == text_k1, (
-        f"megastep k=8 stream diverged from k=1:\n  k8: {text_k8!r}\n"
-        f"  k1: {text_k1!r}"
+    # Phase 1 (ISSUE 7): decode-only fusion, byte-identical streams.
+    texts_k8, _ = await run_one(8, mixed=False)
+    texts_k1, _ = await run_one(1, mixed=False)
+    assert texts_k8[0], "megastep deployment streamed nothing"
+    assert texts_k8 == texts_k1, (
+        f"megastep k=8 stream diverged from k=1:\n  k8: {texts_k8!r}\n"
+        f"  k1: {texts_k1!r}"
     )
+
+    # Phase 2 (ISSUE 12): chunked + spec mixed traffic. Byte-identical
+    # streams, >= 1 FUSED mixed dispatch on the gauges, zero forced-k=1
+    # batches (the watch-overflow path never applies to these requests).
+    mixed_k8, st8 = await run_one(8, mixed=True)
+    mixed_k1, st1 = await run_one(1, mixed=True)
+    assert all(mixed_k8), "mixed-traffic deployment streamed nothing"
+    assert mixed_k8 == mixed_k1, (
+        f"universal megastep k=8 mixed stream diverged from k=1:\n"
+        f"  k8: {mixed_k8!r}\n  k1: {mixed_k1!r}"
+    )
+    assert st8.get("megastep_dispatches", 0) >= 1, st8
+    assert st8.get("fused_mixed_dispatches", 0) >= 1, (
+        "mixed traffic produced no fused mixed dispatches", st8,
+    )
+    assert st8.get("megastep_forced_single", 0) == 0, (
+        "a batch was forced back to k=1 outside the watch-overflow path",
+        st8,
+    )
+    assert st1.get("megastep_dispatches", 0) == 0, st1
+
     print(
-        f"megastep-smoke OK: {len(text_k8)} chars bit-identical k=8 vs "
-        f"k=1; {megasteps} engine_megastep spans recorded", flush=True,
+        f"megastep-smoke OK: decode-only {len(texts_k8[0])} chars + mixed "
+        f"{sum(len(t) for t in mixed_k8)} chars bit-identical k=8 vs k=1; "
+        f"{st8['fused_mixed_dispatches']} fused mixed dispatches, "
+        f"0 forced-single", flush=True,
     )
 
 
