@@ -391,6 +391,8 @@ async def run_jax_worker(
     model_path: str | None = None,
     nnodes: int = 1,
     node_rank: int = 0,
+    obs_publish: bool = True,
+    obs_interval_s: float = 1.0,
 ) -> None:
     if component is None:
         component = "prefill" if role == "prefill" else "backend"
@@ -524,6 +526,40 @@ async def run_jax_worker(
         return st
 
     bind_kv_pool_gauges(runtime.status, _kv_pool_stats)
+
+    # Fleet observability (ISSUE 13): periodic metric snapshots over the
+    # event plane — the same stats callables the gauges above bind, plus
+    # cumulative phase totals and finished-request SLO records. The
+    # publish path is a loop task reading host dicts: nothing is added
+    # to plan/dispatch, no host sync, no step-lock hold. A graceful
+    # drain publishes the `retired` retraction (series leave the fleet
+    # view NOW, like the KV-inventory clear above).
+    core.flight.name = f"worker-{worker_id}"
+    if obs_publish:
+        from dynamo_tpu import tracing as _tracing
+        from dynamo_tpu.obs.slo import PhaseScanner
+        from dynamo_tpu.obs.snapshot import SnapshotPublisher
+
+        snap_pub = SnapshotPublisher(
+            runtime.store, namespace, worker_id,
+            role="worker", component=component, interval_s=obs_interval_s,
+        )
+        snap_pub.collectors = {
+            "scheduler": core.scheduler_stats,
+            "spec": core.spec_decode_stats,
+            "kv_cache": core.kv_cache_stats,
+            "kv_pool": _kv_pool_stats,
+        }
+        snap_pub.tenant_source = core.fair_queue_stats
+        _obs_collector = _tracing.get_collector()
+        snap_pub.phase_source = _obs_collector.phase_totals
+        snap_pub.request_source = PhaseScanner(_obs_collector).scan
+        await snap_pub.start()
+
+        async def _retire_snapshot() -> None:
+            await snap_pub.retire(timeout=5.0)
+
+        runtime.on_drain.append(_retire_snapshot)
 
     # Multimodal: encoder-fleet clients (idle watches when no encoder
     # component is deployed; _resolve_mm falls back to local encode).
@@ -1234,6 +1270,13 @@ def main() -> None:
              "(GPipe prefill waves + wavefront decode chains; exclusive "
              "with tp/dp/sp)",
     )
+    ap.add_argument("--obs-publish", default="on", choices=["on", "off"],
+                    help="publish periodic metric snapshots on the event "
+                         "plane for the fleet aggregator (a loop task "
+                         "reading host stats dicts — nothing added to "
+                         "the plan/dispatch hot path)")
+    ap.add_argument("--obs-interval-s", type=float, default=1.0,
+                    help="metric-snapshot publish interval")
     ap.add_argument("--role", default="aggregated", choices=["aggregated", "prefill", "decode"])
     # Multi-host (reference parity: sglang multinode flags dist-init-addr/
     # nnodes/node-rank, multinode-examples.md:10). Rank 0 serves; other
@@ -1320,6 +1363,8 @@ def main() -> None:
             model_path=args.model_path,
             nnodes=args.nnodes,
             node_rank=args.node_rank,
+            obs_publish=args.obs_publish == "on",
+            obs_interval_s=args.obs_interval_s,
         )
 
     entry()

@@ -115,6 +115,8 @@ async def run_mocker(
     context_length: int = 16384,
     served_event: asyncio.Event | None = None,
     engine_out: list | None = None,
+    obs_publish: bool = True,
+    obs_interval_s: float = 1.0,
 ) -> None:
     args = engine_args or MockEngineArgs()
     engine = MockTpuEngine(args)
@@ -124,6 +126,8 @@ async def run_mocker(
     # Chaos targeting: `engine.step` rules match this worker by id (and
     # by model name, so a plan can wedge "one worker of model X").
     engine.chaos_tag = f"worker-{worker_id}/{model_name}"
+    # Flight-recorder artifacts carry the worker identity.
+    engine.flight.name = f"worker-{worker_id}"
 
     kv_pub = KvEventPublisher(runtime.store, namespace, component, worker_id)
     # Anti-entropy + drain retraction, mirroring the jax worker: the
@@ -151,6 +155,37 @@ async def run_mocker(
         runtime.store, namespace, component, worker_id, engine.metrics, interval_s=0.5
     )
     await metrics_pub.start()
+
+    # Fleet observability (ISSUE 13): periodic metric snapshots over the
+    # event plane — the same stats dicts the /metrics gauges bind, plus
+    # cumulative phase totals and finished-request SLO records. Entirely
+    # off the priced sim step; a graceful drain publishes the `retired`
+    # retraction so the aggregator drops this worker's series NOW.
+    if obs_publish:
+        from dynamo_tpu import tracing
+        from dynamo_tpu.obs.slo import PhaseScanner
+        from dynamo_tpu.obs.snapshot import SnapshotPublisher
+
+        snap_pub = SnapshotPublisher(
+            runtime.store, namespace, worker_id,
+            role="worker", component=component, interval_s=obs_interval_s,
+        )
+        snap_pub.collectors = {
+            "scheduler": engine.scheduler_stats,
+            "spec": engine.spec_decode_stats,
+            "kv_cache": engine.kv_cache_stats,
+            "kv_pool": lambda: {**kv_pub.stats(), **engine.kv_pool_stats()},
+        }
+        snap_pub.tenant_source = engine.fair_queue_stats
+        _collector = tracing.get_collector()
+        snap_pub.phase_source = _collector.phase_totals
+        snap_pub.request_source = PhaseScanner(_collector).scan
+        await snap_pub.start()
+
+        async def _retire_snapshot() -> None:
+            await snap_pub.retire(timeout=5.0)
+
+        runtime.on_drain.append(_retire_snapshot)
 
     # Same scheduler + speculation gauges as the real worker (mock fleets
     # exercise the policies CPU-only; dashboards see identical series).
@@ -287,6 +322,13 @@ def main() -> None:
                          "requests new submits get a typed retryable "
                          "shed error (migration retries elsewhere). "
                          "0 = unbounded")
+    ap.add_argument("--obs-publish", default="on", choices=["on", "off"],
+                    help="publish periodic metric snapshots on the event "
+                         "plane for the fleet aggregator (off the sim "
+                         "step; <2%% TPOT overhead asserted by bench "
+                         "run_fleet_obs_ab)")
+    ap.add_argument("--obs-interval-s", type=float, default=1.0,
+                    help="metric-snapshot publish interval")
     ap.add_argument("--chaos-plan", default="",
                     help="fault-injection plan: inline JSON or @file "
                          "(same format as $DYN_CHAOS_PLAN; see "
@@ -334,6 +376,8 @@ def main() -> None:
             component=args.component,
             engine_args=engine_args,
             context_length=args.context_length,
+            obs_publish=args.obs_publish == "on",
+            obs_interval_s=args.obs_interval_s,
         )
 
     entry()

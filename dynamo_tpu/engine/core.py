@@ -1168,6 +1168,15 @@ class EngineCore:
             "fused_mixed_dispatches": 0,
             "megastep_forced_single": 0,
         }
+        # Crash/stall flight recorder (ISSUE 13): one record per step
+        # with outputs — step shape, lane cursors, cumulative dispatch
+        # counters — dumped to a redacted JSON artifact on SIGTERM
+        # drain, stall-deadline fire, breaker open, and chaos kill. The
+        # record is a host-side dict append on the COMMIT side (never
+        # plan/dispatch); the backend CLI renames it to the worker id.
+        from dynamo_tpu.obs.flight_recorder import FlightRecorder
+
+        self.flight = FlightRecorder(f"engine-{id(self) & 0xFFFF:04x}")
         # Test hook: set to [] to record ("dispatch", n) / ("land", n)
         # events — the pipelining contract is that dispatch n+1 precedes
         # the landing of step n's outputs in steady-state decode.
@@ -2477,6 +2486,34 @@ class EngineCore:
             # burst's first dispatch doesn't record request inter-arrival
             # time as per-dispatch host overhead.
             self._t_prev_dispatch = 0.0
+        if self.flight.capacity and outputs:
+            # Flight-recorder step record (counts + cursors only; the
+            # dump is redacted by contract): one dict append per
+            # committed step, never on the plan/dispatch path.
+            self.flight.record_step(
+                i=self.iterations,
+                outputs=[
+                    {
+                        "rid": s.request_id,
+                        "emitted": len(o.token_ids),
+                        "generated": s.generated,
+                        "finish": o.finish_reason or "",
+                    }
+                    for s, o in outputs[:64]
+                ],
+                outputs_truncated=len(outputs) > 64,
+                dispatches=self.exec_stats["dispatches"],
+                megastep_dispatches=self.exec_stats["megastep_dispatches"],
+                fused_mixed_dispatches=self.exec_stats[
+                    "fused_mixed_dispatches"
+                ],
+                committed_tokens=self.exec_stats["committed_tokens"],
+                shed_total=self.sched_stats["shed_total"],
+                deadline_expired_total=self.sched_stats[
+                    "deadline_expired_total"
+                ],
+                running=len(self.running),
+            )
         return outputs
 
     # dynalint: holds-lock(_step_lock) — only called from _step_locked

@@ -110,6 +110,7 @@ class TpuEngine:
                         attrs={
                             "request_id": seq.request_id,
                             "prompt_tokens": seq.prompt_len,
+                            "tenant": seq.tenant_id or "default",
                         },
                     )
                 self._tracer.record(
@@ -118,11 +119,16 @@ class TpuEngine:
                         "request_id": seq.request_id,
                         "prompt_tokens": seq.prompt_len,
                         "cached_tokens": seq.num_cached_tokens,
+                        "tenant": seq.tenant_id or "default",
                     },
                 )
                 self._tracer.record(
                     "decode", t_first, t_last, headers=context.headers,
-                    attrs={"request_id": seq.request_id, "tokens": seq.generated},
+                    attrs={
+                        "request_id": seq.request_id,
+                        "tokens": seq.generated,
+                        "tenant": seq.tenant_id or "default",
+                    },
                 )
 
     def metrics(self):

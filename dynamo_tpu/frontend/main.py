@@ -30,6 +30,10 @@ async def run_frontend(
     tls_cert: str | None = None,
     tls_key: str | None = None,
     admission: AdmissionConfig | None = None,
+    fleet_obs: bool = True,
+    obs_namespace: str = "dynamo",
+    obs_interval_s: float = 1.0,
+    aggregators_out: dict | None = None,
 ) -> None:
     manager = ModelManager(runtime, router_mode=router_mode, router_config=router_config)
     await manager.start()
@@ -40,6 +44,47 @@ async def run_frontend(
         # refuses new LLM requests with a retryable shed error.
         draining_fn=lambda: runtime.draining,
     )
+    aggregators: dict = {}
+    snap_pub = None
+    if fleet_obs:
+        # Fleet observability (ISSUE 13), embedded mode: per-namespace
+        # aggregators compose worker snapshots into the frontend's own
+        # /metrics (worker_id labels + rollups) and serve /fleet; the
+        # frontend publishes its OWN snapshot (request/latency counters,
+        # http/tokenize/route phase records) so a standalone aggregator
+        # and the planner's fleet observer see the full picture too.
+        from dynamo_tpu import tracing
+        from dynamo_tpu.obs.service import attach_aggregator
+        from dynamo_tpu.obs.slo import (
+            FRONTEND_COMPLETE_ON,
+            FRONTEND_PHASES,
+            PhaseScanner,
+        )
+        from dynamo_tpu.obs.snapshot import SnapshotPublisher, frontend_totals
+
+        aggregators = await attach_aggregator(
+            runtime, manager, service, out=aggregators_out
+        )
+        snap_pub = SnapshotPublisher(
+            runtime.store, obs_namespace, runtime.primary_lease_id,
+            role="frontend", component="frontend",
+            interval_s=obs_interval_s,
+        )
+        snap_pub.collectors = {
+            "frontend": lambda: frontend_totals(service.metrics)
+        }
+        _collector = tracing.get_collector()
+        snap_pub.phase_source = _collector.phase_totals
+        snap_pub.request_source = PhaseScanner(
+            _collector, names=FRONTEND_PHASES,
+            complete_on=FRONTEND_COMPLETE_ON,
+        ).scan
+        await snap_pub.start()
+
+        async def _retire_snapshot() -> None:
+            await snap_pub.retire(timeout=5.0)
+
+        runtime.on_drain.append(_retire_snapshot)
     await service.start()
     if service_out is not None:
         service_out.append(service)
@@ -48,6 +93,10 @@ async def run_frontend(
     try:
         await runtime.wait_for_shutdown()
     finally:
+        if snap_pub is not None:
+            await snap_pub.stop()
+        for agg in aggregators.values():
+            await agg.stop()
         await service.stop()
         await manager.stop()
 
@@ -97,6 +146,24 @@ def main() -> None:
              "0 = auto from the rate",
     )
     ap.add_argument(
+        "--fleet-obs", default="on", choices=["on", "off"],
+        help="embed the fleet metrics aggregator: worker snapshots from "
+             "the event plane compose onto this frontend's /metrics "
+             "(worker_id labels + rollups) and /fleet renders the "
+             "per-tenant SLO breakdown",
+    )
+    ap.add_argument(
+        "--obs-interval-s", type=float, default=1.0,
+        help="this frontend's own metric-snapshot publish interval",
+    )
+    ap.add_argument(
+        "--obs-namespace", default="dynamo",
+        help="namespace this frontend publishes its OWN snapshot under "
+             "(request/latency counters + http/tokenize/route phase "
+             "records); must match the workers' --namespace or the "
+             "aggregator never merges the frontend side",
+    )
+    ap.add_argument(
         "--max-inflight-requests", type=int, default=0,
         help="concurrently admitted LLM requests across all tenants; at "
              "the ceiling new requests get a retryable 503. 0 = unbounded",
@@ -128,6 +195,9 @@ def main() -> None:
             tls_cert=args.tls_cert_path,
             tls_key=args.tls_key_path,
             admission=admission,
+            fleet_obs=args.fleet_obs == "on",
+            obs_namespace=args.obs_namespace,
+            obs_interval_s=args.obs_interval_s,
         )
 
     entry()

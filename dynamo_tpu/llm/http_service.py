@@ -104,7 +104,14 @@ class HttpService:
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/traces", self.traces)
+        self.app.router.add_get("/fleet", self.fleet)
         self._runner: web.AppRunner | None = None
+        # Fleet observability (ISSUE 13): hooks run before each /metrics
+        # render (the embedded aggregator syncs its worker_id-labeled
+        # series here), and fleet_fn serves the /fleet status payload
+        # when an aggregator is attached (obs/service.attach_aggregator).
+        self.before_metrics: list = []
+        self.fleet_fn = None
         # Client-supplied request ids currently in flight (duplicates get
         # a fresh mint; see _request_id).
         self._inflight_rids: set[str] = set()
@@ -307,7 +314,18 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        for hook in self.before_metrics:
+            hook()
         return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def fleet(self, request: web.Request) -> web.Response:
+        """Fleet status page: live workers + per-tenant SLO breakdown
+        (populated when the fleet aggregator is embedded)."""
+        if self.fleet_fn is None:
+            return web.json_response(
+                {"error": "no fleet aggregator attached"}, status=404
+            )
+        return web.json_response(self.fleet_fn())
 
     async def traces(self, request: web.Request) -> web.Response:
         from dynamo_tpu.runtime.status_server import render_traces
@@ -661,7 +679,12 @@ class HttpService:
         root = self._tracer.span(
             "http",
             headers=request.headers,
-            attrs={"request_id": rid, "endpoint": endpoint, "model": body.model},
+            attrs={
+                "request_id": rid, "endpoint": endpoint, "model": body.model,
+                # Tenant identity on the trace: the SLO attributor keys
+                # per-request budget breakdowns by it.
+                "tenant": tenant or "default",
+            },
         )
         try:
             with self._tracer.span("tokenize", parent=root):

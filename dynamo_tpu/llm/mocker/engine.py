@@ -242,6 +242,13 @@ class MockTpuEngine:
         # shape the client-side stall deadline exists to catch.
         self.chaos_tag = ""
         self._dead = False
+        # Crash/stall flight recorder (ISSUE 13): one record per sim
+        # iteration with decode/prefill work — step shape, lane cursors,
+        # timestamps — dumped to a redacted artifact on chaos kill /
+        # stall / drain. run_mocker renames it to the worker id.
+        from dynamo_tpu.obs.flight_recorder import FlightRecorder
+
+        self.flight = FlightRecorder(f"mock-{id(self) & 0xFFFF:04x}")
         self._tracer = tracing.get_tracer("engine")
         # Queue-wait stat spans under their own service (the waterfall
         # sched_admit twin in _trace_phases is service "engine"; sharing
@@ -316,6 +323,10 @@ class MockTpuEngine:
             # like EngineCore's — migration moves the request to a
             # less-loaded worker.
             self.sched_stats["shed_total"] += 1
+            self.flight.record_event(
+                "shed_queue_full", rid=pre.request_id or context.id,
+                waiting=len(self._waiting), limit=limit,
+            )
             raise EngineOverloadedError(
                 f"scheduler queue full ({limit} requests waiting); "
                 f"retry on another instance"
@@ -383,6 +394,7 @@ class MockTpuEngine:
                 attrs={
                     "request_id": seq.request_id,
                     "prompt_tokens": len(seq.prompt),
+                    "tenant": seq.tenant_id or "default",
                 },
             )
         if seq.t_prefill_done:
@@ -392,12 +404,17 @@ class MockTpuEngine:
                     "request_id": seq.request_id,
                     "prompt_tokens": len(seq.prompt),
                     "cached_tokens": seq.cached_blocks * self.args.block_size,
+                    "tenant": seq.tenant_id or "default",
                 },
             )
         if seq.generated and seq.t_last_token and seq.t_prefill_done:
             self._tracer.record(
                 "decode", seq.t_prefill_done, seq.t_last_token, headers=headers,
-                attrs={"request_id": seq.request_id, "tokens": seq.generated},
+                attrs={
+                    "request_id": seq.request_id,
+                    "tokens": seq.generated,
+                    "tenant": seq.tenant_id or "default",
+                },
             )
 
     def scheduler_stats(self) -> dict:
@@ -594,6 +611,13 @@ class MockTpuEngine:
                         self.chaos_tag, len(self._running),
                     )
                     self._dead = True
+                    # Post-mortem (ISSUE 13): the victim's final steps
+                    # dump to a redacted artifact before the loop dies —
+                    # chaos tests reconstruct the killed worker's last
+                    # megasteps from it.
+                    from dynamo_tpu.obs import flight_recorder
+
+                    flight_recorder.dump_all("chaos_kill", self.chaos_tag)
                     return
             self._admit()
             prefill_tokens, decode_seqs = self._step()
@@ -629,6 +653,10 @@ class MockTpuEngine:
         expired = [s for s in swept if not s.cancelled]
         for seq in expired:
             self.sched_stats["deadline_expired_total"] += 1
+            self.flight.record_event(
+                "deadline_expired", rid=seq.request_id,
+                tenant=seq.tenant_id or "default",
+            )
             waited_ms = (now - seq.t_submit) * 1e3 if seq.t_submit else 0.0
             out = LLMEngineOutput(
                 token_ids=[], finish_reason="error",
@@ -739,6 +767,9 @@ class MockTpuEngine:
         spec_tokens = 0
         spec_rows = spec_drafted = spec_accepted = spec_emitted = 0
         finished: list[_Seq] = []
+        # Flight-recorder lane cursors for this iteration (counts only —
+        # the dump artifact is redacted by contract, never token values).
+        lane_records: list[dict] = []
 
         for seq in self._running:
             if seq.cancelled:
@@ -760,6 +791,13 @@ class MockTpuEngine:
                 start_block = seq.prefilled // self.args.block_size
                 seq.prefilled += chunk
                 prefill_tokens += chunk
+                lane_records.append(
+                    {
+                        "rid": seq.request_id, "kind": "chunk",
+                        "chunk": chunk, "prefilled": seq.prefilled,
+                        "prompt": len(seq.prompt),
+                    }
+                )
                 end_block = seq.prefilled // self.args.block_size
                 for i in range(max(start_block, seq.cached_blocks), end_block):
                     h = seq.prompt_hashes[i]
@@ -838,6 +876,15 @@ class MockTpuEngine:
                 self.sched_stats["decode_stalls"] += 1
                 continue  # stalled this iteration (preemption-lite)
             tokens_emitted += len(emitted)
+            lane_records.append(
+                {
+                    "rid": seq.request_id,
+                    "kind": "verify" if drafted else "decode",
+                    "emitted": len(emitted), "generated": seq.generated,
+                    "inner": inner,
+                    "finish": finish or "",
+                }
+            )
             if drafted:
                 # Charge + account the verify row only once it actually
                 # ran (the real engine drops the draft under block
@@ -924,6 +971,30 @@ class MockTpuEngine:
             1 for s in self._running if not s.prefill_done and s.t_first_sched
         )
         self._last_kv_blocks_read = kv_blocks_read
+        if self.flight.capacity and lane_records:
+            # One flight-recorder record per iteration with work: step
+            # shape + lane cursors (the chaos-kill artifact reconstructs
+            # the victim's final megasteps from these). One dict append —
+            # no work added to the priced step itself.
+            self.flight.record_step(
+                i=self._iterations,
+                k=k_mega,
+                shape={
+                    "decode": sum(
+                        1 for r in lane_records if r["kind"] == "decode"
+                    ),
+                    "chunk": chunk_rows,
+                    "verify": sum(
+                        1 for r in lane_records if r["kind"] == "verify"
+                    ),
+                },
+                batched=batched,
+                emitted=tokens_emitted,
+                lanes=lane_records[:64],
+                lanes_truncated=len(lane_records) > 64,
+                shed_total=st["shed_total"],
+                deadline_expired_total=st["deadline_expired_total"],
+            )
         return prefill_tokens + spec_tokens, decode_seqs
 
     def _check_stop(self, seq: _Seq, token: int) -> str | None:

@@ -126,3 +126,20 @@ class MetricsObserver:
             observed_itl_s=(delta(f"{_ITL}_sum") / itl_c) if itl_c else None,
             phase_means=phase_means or None,
         )
+
+
+class FleetMetricsObserver:
+    """The event-plane observation source (ISSUE 13): the planner reads
+    the fleet aggregator's composed state instead of point-scraping one
+    frontend's /metrics. Same per-window diff math as
+    :class:`MetricsObserver` (it lives in
+    ``obs/aggregator.FleetAggregator.observation``), but fed by metric
+    snapshots from LIVE workers only — a dead worker's counters leave
+    the aggregate the moment its series retire, so the planner never
+    plans against ghosts."""
+
+    def __init__(self, aggregator):
+        self.aggregator = aggregator
+
+    async def observe(self) -> Observation:
+        return self.aggregator.observation()

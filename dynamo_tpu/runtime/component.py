@@ -168,6 +168,19 @@ class DistributedRuntime:
                 await cb()
             except Exception:  # noqa: BLE001 — retraction is best-effort; lease expiry is the backstop
                 log.warning("drain: retraction hook failed", exc_info=True)
+        # Flight-recorder post-mortem (ISSUE 13): every engine ring in
+        # this process dumps a redacted artifact before the lease goes —
+        # the SIGTERM twin of the chaos-kill dump. Off the loop: the
+        # dump is file I/O.
+        from dynamo_tpu.obs import flight_recorder
+
+        if flight_recorder.enabled():
+            try:
+                await asyncio.to_thread(
+                    flight_recorder.dump_all, "sigterm_drain"
+                )
+            except Exception:  # noqa: BLE001 — a failed dump must not block the drain
+                log.warning("drain: flight-recorder dump failed", exc_info=True)
         completed = True
         if self._ingress_started:
             completed = await self.ingress.drain(timeout)

@@ -80,6 +80,36 @@ from dynamo_tpu.runtime.tasks import spawn_logged
 
 log = logging.getLogger("dynamo_tpu.dataplane")
 
+
+def _flight_dump(reason: str, detail: str) -> None:
+    """Failure-path flight-recorder dump (stall deadline / breaker open).
+
+    These sites fire INSIDE the containment path, on the event loop —
+    serializing + writing every ring synchronously here would delay the
+    very eviction/failover the dump is documenting (and starve lease
+    keepalives in single-process deployments). So when a loop is running
+    the dump is handed to the default executor; the rings keep recording
+    and the wedged victim's ring is static anyway, so a few milliseconds
+    of deferral loses nothing. dump_all is budgeted per reason with a
+    cooldown. No-op when nothing records."""
+    from dynamo_tpu.obs import flight_recorder
+
+    if not flight_recorder.enabled():
+        return
+
+    def _dump() -> None:
+        try:
+            flight_recorder.dump_all(reason, detail)
+        except Exception:  # noqa: BLE001 — a failed dump must not change containment behavior
+            log.exception("flight dump failed (%s)", reason)
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        _dump()
+        return
+    loop.run_in_executor(None, _dump)
+
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
 
 # Distinguished err payload a draining server answers new requests with;
@@ -164,6 +194,10 @@ class CircuitBreaker:
         self.opens_total = 0
         self._opened_at = 0.0
         self._probe_at = 0.0
+        # Optional closed->open notification (the flight recorder's
+        # breaker_open dump trigger). None = no observer (the dynacheck
+        # model and unit tests drive the breaker bare).
+        self.on_open: Callable[[], None] | None = None
 
     def allow(self) -> bool:
         if self.state == self.CLOSED:
@@ -193,10 +227,16 @@ class CircuitBreaker:
             self.state == self.HALF_OPEN
             or self.consecutive_failures >= self.threshold
         ):
-            if self.state != self.OPEN:
+            opened = self.state != self.OPEN
+            if opened:
                 self.opens_total += 1
             self.state = self.OPEN
             self._opened_at = self._clock()
+            if opened and self.on_open is not None:
+                try:
+                    self.on_open()
+                except Exception:  # noqa: BLE001 — observability must not change breaker behavior
+                    log.exception("breaker on_open hook failed")
 
     def stats(self) -> dict:
         return {
@@ -626,6 +666,12 @@ class EgressClient:
                 threshold=self.policy.breaker_threshold,
                 reset_s=self.policy.breaker_reset_s,
             )
+            # Flight-recorder trigger (ISSUE 13): a breaker opening is a
+            # containment event worth a post-mortem — dump every engine
+            # ring in this process (budgeted + cooldown inside dump_all).
+            br.on_open = lambda addr=address: _flight_dump(
+                "breaker_open", addr
+            )
         return br
 
     def _on_conn_dead(self, conn: _EgressConn) -> None:
@@ -646,6 +692,12 @@ class EgressClient:
         anyway — closing fails them over NOW instead of one stall budget
         each."""
         self._stalls[address] = self._stalls.get(address, 0) + 1
+        # Flight-recorder trigger (ISSUE 13): the stall deadline firing
+        # means a worker wedged mid-stream — dump every engine ring in
+        # this process (in single-process deployments the victim's
+        # recorder lives here too; its ring is static while wedged, so
+        # the dump riding the executor loses nothing).
+        _flight_dump("stall_deadline", address)
         self._breaker(address).record_failure()
         conn = self._conns.pop(address, None)
         if conn is not None:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import secrets
+import threading
 import time
 import weakref
 from collections import deque
@@ -170,9 +171,18 @@ NOOP_SPAN = _NoopSpan()
 # Collector: the lock-free per-process ring buffer
 # ---------------------------------------------------------------------------
 
+# Phase-histogram bucket edges, tuned to the MEASURED phase ranges
+# (ISSUE 13 satellite; the old edges were generic defaults): the fast end
+# resolves sub-ms decode iterations and host_gap stats (50 µs floor), the
+# middle covers queue/route/TTFT (10 ms – 1 s), and the slow end keeps
+# resolution through multi-second chunked prefills and megastep drains up
+# to 120 s — so a p99 estimated off /metrics interpolates inside a
+# bucket instead of saturating the top one. Pinned by
+# tests/test_obs.py::test_phase_buckets_cover_measured_ranges.
 _PHASE_BUCKETS = (
-    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1,
-    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.5, 4.0, 6.0,
+    10.0, 15.0, 30.0, 60.0, 120.0,
 )
 
 
@@ -191,6 +201,15 @@ class TraceCollector:
         # live in their own, smaller ring so a busy decode loop can never
         # evict per-request spans out of the trace buffer.
         self._stats: deque[Span] = deque(maxlen=min(1024, capacity))
+        # Cumulative per-phase (count, sum-seconds) totals — the metric
+        # snapshots ship these over the event plane so the fleet
+        # aggregator can diff per-window phase means without scraping.
+        # Unlike the rings these survive eviction, so they are CUMULATIVE
+        # counters like the prometheus histograms. The tiny lock guards
+        # the two-field update against the engine-thread/event-loop race
+        # (the ring appends stay lock-free).
+        self._phase_lock = threading.Lock()
+        self._phase_totals: dict[str, list[float]] = {}
         # Bound metrics registries: per-phase latency histograms
         # (planner/observer.py consumes these for the TTFT/ITL
         # decomposition). Held weakly — a restarted service's dead
@@ -215,6 +234,13 @@ class TraceCollector:
         self._observe(span)
 
     def _observe(self, span: Span) -> None:
+        key = f"{span.service}/{span.name}"
+        with self._phase_lock:
+            totals = self._phase_totals.get(key)
+            if totals is None:
+                totals = self._phase_totals[key] = [0.0, 0.0]
+            totals[0] += 1.0
+            totals[1] += span.duration_s
         dead = False
         for ref in self._metrics:
             registry = ref()
@@ -238,9 +264,17 @@ class TraceCollector:
             live.append(weakref.ref(registry))
         self._metrics[:] = live
 
+    def phase_totals(self) -> dict[str, tuple[float, float]]:
+        """Cumulative ``{"service/phase": (count, sum_seconds)}`` since
+        process start — the snapshot publisher's phase source."""
+        with self._phase_lock:
+            return {k: (v[0], v[1]) for k, v in self._phase_totals.items()}
+
     def clear(self) -> None:
         self._spans.clear()
         self._stats.clear()
+        with self._phase_lock:
+            self._phase_totals.clear()
 
     def spans(self) -> list[Span]:
         return list(self._spans)

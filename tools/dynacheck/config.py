@@ -131,6 +131,10 @@ CURSOR_ATTRS = {
     # the per-worker tier ledger IS the routing truth — an out-of-band
     # write would desynchronize it from the radix tree it feeds.
     "_tiers": "global-index per-worker tier ledger",
+    # Snapshot-publisher buffer (obs/snapshot.py, ISSUE 13): bounded +
+    # ordered like the KV event buffer; an out-of-band write could
+    # reorder or unbound the fleet view's feed.
+    "_snapbuf": "bounded snapshot-publisher buffer",
 }
 
 # {file suffix -> set of audited writer qualnames}. Nested defs are dotted
@@ -207,6 +211,13 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
         "MockKvManager.clear",
         # Cluster-pool import (ISSUE 11): register_inactive's mocker twin.
         "MockKvManager.import_block",
+    },
+    # The snapshot publisher owns its bounded buffer (tick task enqueues,
+    # one drain task pops — both loop-affine); the rule guards OTHER
+    # files reaching into `pub._snapbuf`.
+    "dynamo_tpu/obs/snapshot.py": {
+        "SnapshotPublisher.publish_nowait",
+        "SnapshotPublisher._drain",
     },
     # The global index owns its tier ledger wholesale (single event-task
     # writer); the rule guards OTHER files reaching into `idx._tiers`.

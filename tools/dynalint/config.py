@@ -119,6 +119,12 @@ GUARDED_BY = {
         # threads hop in via call_soon_threadsafe; one drain task pops).
         ("KvEventPublisher", "_buf"): EXTERNAL,
     },
+    "dynamo_tpu/obs/snapshot.py": {
+        # Bounded snapshot buffer (ISSUE 13): loop-affine like the KV
+        # event publisher — the tick task enqueues, the single drain
+        # task pops, both on one event loop.
+        ("SnapshotPublisher", "_snapbuf"): EXTERNAL,
+    },
 }
 
 # Mutating method names: `x.<name>(...)` counts as a mutation of `x`.

@@ -1,0 +1,237 @@
+"""Fleet-observability smoke: two mocker workers behind the real OpenAI
+frontend with the fleet aggregator EMBEDDED (the default `--fleet-obs on`
+deployment shape of ISSUE 13).
+
+Asserts the user-visible contract:
+
+- the frontend's /metrics carries BOTH workers' snapshot-fed series with
+  ``worker_id`` labels plus ``dynamo_fleet_*`` rollups (sum/max/p50/p99
+  across live workers) — the fleet view composed from the event plane,
+  no per-worker scraping;
+- ``/fleet`` renders the per-tenant SLO breakdown (requests, TTFT/TPOT
+  percentiles, attainment, phase means) stitched from the request's
+  trace spans;
+- a chaos-killed worker leaves a PARSEABLE flight-recorder dump whose
+  step records carry the victim's final lane cursors — and the client's
+  stream still completes (migration replays it on the survivor).
+
+CI usage (`.github/workflows/ci.yml` obs-smoke step) and local:
+
+    python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+FLIGHT_DIR = os.path.join(tempfile.gettempdir(), "dynamo_obs_smoke_flight")
+os.environ["DYN_FLIGHT_DIR"] = FLIGHT_DIR
+
+BODY = {
+    "model": "mock",
+    "messages": [{"role": "user", "content": "fleet observability smoke"}],
+    "max_tokens": 8,
+    "temperature": 0,
+    "stream": False,
+}
+
+
+async def _boot():
+    """Store + 2 mocker workers (fast snapshot cadence) + the real
+    frontend with the aggregator embedded."""
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    runtimes, tasks = [], []
+    for _ in range(2):
+        rt = await DistributedRuntime.create(store.address)
+        served = asyncio.Event()
+        tasks.append(
+            asyncio.create_task(
+                run_mocker(
+                    rt, model_name="mock",
+                    engine_args=MockEngineArgs(
+                        num_kv_blocks=1024, block_size=8, speedup_ratio=50.0
+                    ),
+                    served_event=served, obs_interval_s=0.1,
+                )
+            )
+        )
+        await asyncio.wait_for(served.wait(), 30)
+        runtimes.append(rt)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    tasks.append(
+        asyncio.create_task(
+            run_frontend(
+                front_rt, http_host="127.0.0.1", http_port=0,
+                router_mode="round_robin", ready_event=ready,
+                service_out=services, obs_interval_s=0.1,
+            )
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    wids = [rt.primary_lease_id for rt in runtimes]
+    return (
+        (store, runtimes + [front_rt], tasks),
+        f"http://127.0.0.1:{services[0].port}",
+        wids,
+    )
+
+
+async def _teardown(handles) -> None:
+    store, runtimes, tasks = handles
+    for t in tasks:
+        t.cancel()
+    for rt in runtimes:
+        try:
+            await rt.shutdown()
+        except (ConnectionError, OSError):
+            pass
+    await store.stop()
+
+
+async def _wait_model(s, base: str) -> None:
+    for _ in range(200):
+        async with s.get(f"{base}/v1/models") as r:
+            if (await r.json())["data"]:
+                return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("model never appeared on frontend")
+
+
+async def run() -> None:
+    import aiohttp
+
+    from dynamo_tpu.runtime import chaos
+    from dynamo_tpu.runtime.chaos import ChaosPlan, ChaosRule
+
+    for f in Path(FLIGHT_DIR).glob("flight-*.json") if Path(FLIGHT_DIR).exists() else []:
+        f.unlink()
+
+    handles, base, wids = await _boot()
+    try:
+        async with aiohttp.ClientSession() as s:
+            await _wait_model(s, base)
+
+            # Phase 1: traffic to both workers (round robin), then the
+            # fleet /metrics must compose BOTH workers' series + rollups.
+            for _ in range(4):
+                async with s.post(
+                    f"{base}/v1/chat/completions", json=dict(BODY),
+                    headers={"x-tenant-id": "smoke"},
+                ) as r:
+                    assert r.status == 200, await r.text()
+            text = ""
+            for _ in range(100):
+                async with s.get(f"{base}/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+                if all(f'worker_id="{w}"' in text for w in wids):
+                    break
+                await asyncio.sleep(0.1)
+            for w in wids:
+                assert f'worker_id="{w}"' in text, (
+                    f"fleet /metrics missing worker {w}'s series"
+                )
+            for stat in ("sum", "max", "p50", "p99"):
+                assert (
+                    f'dynamo_fleet_scheduler_running_seqs{{namespace="dynamo",'
+                    f'service="engine",stat="{stat}"}}' in text
+                ), f"fleet rollup stat={stat} missing"
+
+            # Phase 2: /fleet renders the per-tenant SLO breakdown.
+            fleet = {}
+            for _ in range(100):
+                async with s.get(f"{base}/fleet") as r:
+                    assert r.status == 200
+                    fleet = (await r.json()).get("dynamo", {})
+                slo = fleet.get("slo", {}).get("tenants", {}).get("smoke", {})
+                if slo.get("requests"):
+                    break
+                await asyncio.sleep(0.1)
+            smoke = fleet["slo"]["tenants"]["smoke"]
+            assert smoke["requests"] >= 1, fleet
+            assert smoke["ttft_p50_ms"] > 0
+            for phase in ("queue", "prefill_compute", "decode"):
+                assert phase in smoke["phase_mean_ms"], smoke
+            assert sorted(fleet["live_workers"]) == sorted(wids)
+
+            # Phase 3: chaos-kill one worker mid-decode; the stream must
+            # still complete (migration) and the victim must leave a
+            # parseable flight-recorder dump.
+            kill = ChaosRule(point="engine.step", action="kill",
+                             match="mock", after=12, count=1)
+            chaos.install(ChaosPlan([kill]))
+            try:
+                body = dict(BODY, max_tokens=48)
+                async with s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    headers={"x-tenant-id": "smoke"},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+                # The kill rule fires exactly once (count=1); if it
+                # somehow hasn't yet, one more request forces the
+                # victim's engine loop past `after`.
+                if kill.fires < 1:
+                    async with s.post(
+                        f"{base}/v1/chat/completions", json=body,
+                        headers={"x-tenant-id": "smoke"},
+                    ) as r:
+                        assert r.status == 200, await r.text()
+                        out = await r.json()
+                assert kill.fires >= 1, "chaos kill never fired"
+                assert out["choices"][0]["message"]["content"], (
+                    "migrated stream returned no content"
+                )
+            finally:
+                chaos.uninstall()
+            dumps = sorted(Path(FLIGHT_DIR).glob("flight-*chaos_kill*.json"))
+            assert dumps, (
+                f"chaos kill left no flight-recorder artifact in {FLIGHT_DIR}"
+            )
+            payload = json.loads(dumps[0].read_text())
+            assert payload["reason"] == "chaos_kill"
+            steps = [
+                r for r in payload["records"] if r.get("kind") == "step"
+            ]
+            assert steps, "flight dump carries no step records"
+            assert any(r.get("lanes") for r in steps), (
+                "no lane cursors in the victim's step records"
+            )
+            assert "token_ids" not in json.dumps(payload), "dump not redacted"
+    finally:
+        await _teardown(handles)
+
+    print(
+        f"obs-smoke OK: fleet /metrics composed {len(wids)} workers' series "
+        f"+ rollups, /fleet rendered the SLO breakdown "
+        f"({smoke['requests']} request(s), ttft_p50 {smoke['ttft_p50_ms']} "
+        f"ms), chaos kill left a parseable redacted flight dump "
+        f"({len(steps)} step records)",
+        flush=True,
+    )
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
