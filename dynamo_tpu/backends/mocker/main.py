@@ -99,6 +99,9 @@ async def _pull_peer_prefix_mock(
     elapsed_ms = (time.monotonic() - t0) * 1e3
     st.pull_ms_total += elapsed_ms
     st.last_pull_ms = elapsed_ms
+    peer = hint.get("worker_id")
+    if peer is not None:
+        st.note_pull(int(peer), imported, elapsed_ms, ok)
     if ok:
         st.pulls_succeeded += 1
     else:

@@ -4306,4 +4306,11 @@ class EngineCore:
                 if self._spec_default is not None or self.spec_stats.verify_rows
                 else None
             ),
+            # Measured per-peer pull cost, installed by PeerKvClient when
+            # the cluster-pool role wiring creates one (NetKV routing).
+            net=(
+                self.net_stats_source() or None
+                if getattr(self, "net_stats_source", None) is not None
+                else None
+            ),
         )

@@ -1,0 +1,32 @@
+"""Fleet-scale proof harness (ISSUE 14): tens of mocker workers on one
+virtual clock, a synthetic multi-tenant workload at hundreds of thousands
+of users, the closed-loop planner in the loop, and chaos plans — the
+environment the autoscaling + network-aware-routing claims are proven in.
+"""
+
+from dynamo_tpu.fleet.harness import (
+    ChaosEvent,
+    FleetHarness,
+    FleetReport,
+    FleetSpec,
+    SimConnector,
+    mocker_profile,
+    run_fleet_ab,
+    run_routing_ab,
+)
+from dynamo_tpu.fleet.workload import Arrival, TenantSpec, generate_arrivals, rate_at
+
+__all__ = [
+    "Arrival",
+    "ChaosEvent",
+    "FleetHarness",
+    "FleetReport",
+    "FleetSpec",
+    "SimConnector",
+    "TenantSpec",
+    "generate_arrivals",
+    "mocker_profile",
+    "rate_at",
+    "run_fleet_ab",
+    "run_routing_ab",
+]

@@ -1,0 +1,1073 @@
+"""The fleet-scale proof harness (ISSUE 14, ROADMAP item 2).
+
+Tens of mocker workers on ONE virtual clock, a synthetic multi-tenant
+workload (diurnal + bursty arrivals over hundreds of thousands of users,
+shared-prefix populations), the real router cost functions choosing
+placement, the real closed-loop controller scaling the pool, and chaos
+plans killing/partitioning workers mid-run. Everything the autoscaling
+and network-aware-routing claims rest on is *driven through the
+production code paths* — ``DefaultWorkerSelector`` /
+``NetworkAwareSelector`` score candidates, ``PeerPullStats.note_pull`` →
+``ForwardPassMetrics.net`` feeds the ``NetCostModel``,
+``PlannerController.cycle`` actuates a Connector — only the transport
+(HTTP, store, dataplane) is replaced by direct calls on the simulated
+timeline.
+
+Simulation model
+----------------
+Each worker is a :class:`MockTpuEngine` with its own local virtual clock
+``vt``; fleet events (arrivals, controller ticks, chaos) are processed
+in global time order, and between events every worker steps its
+admit/step loop forward until it catches up. Iteration cost uses the
+mocker's priced cost model (``base_iter_us + p*prefill_us_per_token +
+d*decode_us_per_seq``), identical to bench run_overload_ab. Peer-prefix
+pulls are priced per SOURCE (``pull_ms_per_block`` × blocks moved) so a
+slow peer is measurably slow — and the measurement flows through the
+same ``note_pull`` EWMA the jax worker publishes.
+
+Scale-down is a graceful drain, never a kill: a drained worker stops
+receiving new placements, finishes everything it holds (waiting AND
+running — admission was a promise), and only then retires. A chaos
+``kill`` is the opposite: in-flight streams stop mid-token and are
+migrated — replayed on a surviving worker with ``replay_base`` carrying
+the committed position, so the client-visible stream continues
+bit-identically (the PR 6 migration contract).
+
+Determinism: arrivals are generated once per seed and replayed
+identically by every scenario; the selector runs at temperature 0; the
+mocker's token function depends only on stream position. Any two
+scenarios that complete the same request emit byte-identical tokens —
+which is exactly what the routing/drain/chaos audits assert.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from dynamo_tpu.fleet.workload import (
+    Arrival,
+    TenantSpec,
+    generate_arrivals,
+    tenant_hue,
+)
+from dynamo_tpu.llm.kv_router.netcost import NetCostModel, NetworkAwareSelector
+from dynamo_tpu.llm.kv_router.protocols import RouterConfig
+from dynamo_tpu.llm.kv_router.router import best_peer_hint
+from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequences
+from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine, _Seq
+from dynamo_tpu.llm.protocols.common import StopConditions
+from dynamo_tpu.planner.controller import ControllerConfig, PlannerController
+from dynamo_tpu.planner.perf_interpolation import from_profile
+from dynamo_tpu.planner.planner_core import (
+    Observation,
+    Planner,
+    PlannerConfig,
+    SlaTargets,
+)
+from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
+
+# Wall-clock budget a failed (partitioned) pull burns before the breaker
+# path gives up — the cost a stalled peer charges the puller's clock.
+PULL_TIMEOUT_MS = 50.0
+# Hard ceiling on post-workload drain, as a multiple of the duration — a
+# wedged sim fails loudly instead of spinning forever.
+MAX_OVERRUN = 4.0
+
+
+def mocker_profile(
+    base_iter_us: float,
+    prefill_us_per_token: float,
+    decode_us_per_seq: float,
+    max_num_seqs: int,
+) -> dict:
+    """The mocker cost model swept into the planner's offline profile —
+    the virtual-fleet equivalent of running ``benchmarks/profile_sla.py``
+    against one replica. TTFT(isl) is one monolithic prefill iteration;
+    ITL(conc) is one decode iteration at that batch (every lane emits a
+    token per iteration, so seconds/iteration IS seconds/token)."""
+    isl_grid = [32.0, 128.0, 512.0, 2048.0, 8192.0]
+    conc_grid = [float(c) for c in range(1, max_num_seqs + 1)]
+    return {
+        "prefill": {
+            "isl": isl_grid,
+            "ttft_s": [
+                (base_iter_us + isl * prefill_us_per_token) / 1e6
+                for isl in isl_grid
+            ],
+        },
+        "decode": {
+            "concurrency": conc_grid,
+            "itl_s": [
+                (base_iter_us + c * decode_us_per_seq) / 1e6 for c in conc_grid
+            ],
+        },
+    }
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """A mid-run fault: ``kill`` stops a worker dead (in-flight streams
+    migrate), ``partition`` makes every pull touching the worker fail for
+    ``duration_s`` (placements degrade to local recompute), and ``drain``
+    forces a graceful scale-down of the worker at that instant (the
+    chaos-tested kill-during-scale-down scenario composes drain + kill)."""
+
+    t: float
+    action: str                      # "kill" | "partition" | "drain"
+    worker: int = -1                 # worker id; -1 = newest draining worker
+    duration_s: float = 0.0
+
+
+@dataclass
+class FleetSpec:
+    tenants: list[TenantSpec]
+    duration_s: float = 240.0
+    seed: int = 0
+    block_size: int = 8
+    # One worker's cost model (tens of these make the fleet).
+    max_num_seqs: int = 4
+    num_kv_blocks: int = 2048
+    max_waiting: int = 0             # bounded admission queue (0 = unbounded)
+    base_iter_us: float = 20_000.0
+    prefill_us_per_token: float = 100.0
+    decode_us_per_seq: float = 5_000.0
+    # Routing.
+    network_aware: bool = False
+    overlap_weight: float = 1.0
+    queue_weight: float = 1.0
+    pull_enabled: bool = True
+    pull_ms_per_block: float = 0.2   # default per-SOURCE transfer cost
+    worker_pull_ms: dict[int, float] = field(default_factory=dict)
+    # Per-worker iteration-cost multiplier (> 1 = slower hardware / hot
+    # node): the heterogeneity NetKV's queue-depth term exists for.
+    worker_speed: dict[int, float] = field(default_factory=dict)
+    # Autoscaling. planner_on=False freezes the pool at static_replicas —
+    # the equal-budget baseline the A/B compares against.
+    planner_on: bool = True
+    static_replicas: int = 4
+    initial_replicas: int = 2
+    min_replicas: int = 1
+    max_replicas: int = 16
+    # 2.5 s control interval: fast enough that a 10 s tenant burst gets
+    # one reactive scale-up while it still matters; hysteresis (not the
+    # interval) is what stops flapping.
+    control_interval_s: float = 2.5
+    controller: ControllerConfig | None = None
+    sla: SlaTargets = field(default_factory=lambda: SlaTargets(ttft_s=0.35, itl_s=0.08))
+    chaos: list[ChaosEvent] = field(default_factory=list)
+    # Out-of-band load: worker id -> background requests/second injected
+    # straight into that worker's admission queue, NOT routed through
+    # the selector. Another frontend's traffic, in effect: invisible to
+    # this router's ActiveSequences bookkeeping (no placement was ever
+    # announced here) and visible only through the worker's own reported
+    # queue/slot metrics — the exact signal NetKV's queue-depth term
+    # exists to read.
+    background_rps: dict[int, float] = field(default_factory=dict)
+    background_isl: int = 32
+    background_osl: int = 6
+    # Keep per-request token streams in the report (the bit-identity
+    # audits want them; the big bench fleet turns them off to save RAM).
+    keep_streams: bool = True
+
+
+@dataclass
+class _Rec:
+    """One request's client-side ledger across its whole life (including
+    migration hops)."""
+
+    arrival: Arrival
+    t_first: float | None = None     # fleet time of first streamed token
+    t_last: float | None = None
+    tokens: list[int] = field(default_factory=list)
+    n_tokens: int = 0
+    shed: str | None = None          # typed shed reason, None = served
+    finishes: int = 0
+    workers: list[int] = field(default_factory=list)
+    done: bool = False
+
+
+class SimWorker:
+    def __init__(self, wid: int, spec: FleetSpec, t0: float):
+        self.id = wid
+        self.spec = spec
+        self.vt = t0                           # local virtual clock
+        self.draining = False
+        self.dead = False
+        self.pull_ms_per_block = spec.worker_pull_ms.get(
+            wid, spec.pull_ms_per_block
+        )
+        self.speed = spec.worker_speed.get(wid, 1.0)
+        self.eng = MockTpuEngine(
+            MockEngineArgs(
+                num_kv_blocks=spec.num_kv_blocks,
+                block_size=spec.block_size,
+                max_num_seqs=spec.max_num_seqs,
+                max_num_batched_tokens=4096,
+                max_waiting=spec.max_waiting,
+                base_iter_us=spec.base_iter_us,
+                prefill_us_per_token=spec.prefill_us_per_token,
+                decode_us_per_seq=spec.decode_us_per_seq,
+                kv_pull_us_per_block=0.0,      # pulls priced per-source here
+            )
+        )
+        # Deadline expiry judged on the worker's virtual clock.
+        self.eng.clock = lambda: self.vt
+        # Sequences routed here whose out queues the harness still
+        # drains — a finished seq leaves eng._running inside _step, so
+        # the harness must keep its own handle to collect final frames.
+        self.inflight: list[_Seq] = []
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.eng._waiting or self.eng._running)
+
+    def step(self) -> None:
+        a = self.eng.args
+        self.eng._admit()
+        p, d = self.eng._step()
+        self.vt += self.speed * (
+            a.base_iter_us
+            + p * a.prefill_us_per_token
+            + d * a.decode_us_per_seq
+        ) / 1e6
+
+
+class SimConnector:
+    """The harness's Connector: ``set_replicas`` spawns instantly and
+    scales down by marking the least-loaded workers draining — the
+    in-sim twin of LocalProcessConnector's spawn / SIGTERM-drain, on the
+    virtual clock. Never kills."""
+
+    def __init__(self, harness: "FleetHarness"):
+        self.harness = harness
+        self.calls: list[tuple[float, str, int]] = []
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        h = self.harness
+        self.calls.append((h.t, component, replicas))
+        live = [w for w in h.workers if not w.dead and not w.draining]
+        if replicas > len(live):
+            for _ in range(replicas - len(live)):
+                h.spawn_worker()
+            self.scale_ups += 1
+        elif replicas < len(live):
+            # Victim choice mirrors an orchestrator draining the
+            # emptiest pods first; ties break to the newest worker so
+            # long-warmed prefix caches survive.
+            load = {
+                w.id: len(w.eng._running) + len(w.eng._waiting) for w in live
+            }
+            victims = sorted(live, key=lambda w: (load[w.id], -w.id))
+            for w in victims[: len(live) - replicas]:
+                w.draining = True
+            self.scale_downs += 1
+
+    def current(self, component: str) -> int:
+        return sum(
+            1 for w in self.harness.workers if not w.dead and not w.draining
+        )
+
+
+@dataclass
+class FleetReport:
+    scenario: str
+    duration_s: float
+    requests: int
+    completed: int
+    shed: int
+    broken_streams: int
+    attainment_ttft: float
+    attainment_tpot: float
+    goodput_tok_s: float
+    ttft_p50_ms: float
+    ttft_p99_ms: float
+    tpot_p50_ms: float
+    replica_seconds: float
+    mean_replicas: float
+    peak_replicas: int
+    decisions: dict
+    scale_ups: int
+    scale_downs: int
+    drained_retired: int
+    migrations: int
+    placements: dict[int, int]
+    pulls_by_source: dict[int, int]
+    failed_pulls: int
+    streams: dict[str, list[int]] | None
+
+    def summary(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items() if k != "streams"}
+        d["placements"] = dict(sorted(self.placements.items()))
+        d["pulls_by_source"] = dict(sorted(self.pulls_by_source.items()))
+        return d
+
+
+class FleetHarness:
+    def __init__(self, spec: FleetSpec):
+        self.spec = spec
+        self.t = 0.0
+        self.workers: list[SimWorker] = []
+        self._next_wid = 0
+        self.retired_drained = 0
+        self.migrations = 0
+        self.failed_pulls = 0
+        self.placements: dict[int, int] = {}
+        self.pulls_by_source: dict[int, int] = {}
+        self.recs: dict[str, _Rec] = {}
+        self._partitioned: dict[int, float] = {}   # worker id -> until t
+        self._replica_seconds = 0.0
+        self._peak = 0
+        self._last_acct_t = 0.0
+        self.active = ActiveSequences(block_size=spec.block_size)
+        self.rconfig = RouterConfig(
+            overlap_weight=spec.overlap_weight,
+            temperature=0.0,
+            network_aware=spec.network_aware,
+            queue_weight=spec.queue_weight,
+            block_size=spec.block_size,
+        )
+        # Recompute yardstick: what one block of local prefill costs on
+        # this fleet's priced cost model.
+        self.netcost = NetCostModel(
+            recompute_ms_per_block=(
+                spec.block_size * spec.prefill_us_per_token / 1e3
+            ),
+            fleet_view=self._fleet_view,
+            cache_s=0.0,
+            clock=lambda: self.t,
+        )
+        if spec.network_aware:
+            self.selector: DefaultWorkerSelector = NetworkAwareSelector(
+                self.netcost
+            )
+        else:
+            self.selector = DefaultWorkerSelector()
+        # The closed loop: mocker cost model swept into the profile the
+        # planner interpolates, controller clocked on fleet time.
+        prefill_i, decode_i = from_profile(
+            mocker_profile(
+                spec.base_iter_us,
+                spec.prefill_us_per_token,
+                spec.decode_us_per_seq,
+                spec.max_num_seqs,
+            )
+        )
+        self.connector = SimConnector(self)
+        self.planner = Planner(
+            prefill_i,
+            decode_i,
+            self.connector,
+            sla=spec.sla,
+            config=PlannerConfig(
+                adjustment_interval_s=spec.control_interval_s,
+                min_replicas=spec.min_replicas,
+                max_replicas=spec.max_replicas,
+                predictor="ar",
+                # Plan with ramp headroom: the diurnal slope moves faster
+                # than one control interval, and capacity arriving a tick
+                # late is a queue already formed.
+                utilization_target=0.8,
+            ),
+        )
+        self.controller = PlannerController(
+            self.planner,
+            self.connector,
+            pools={"backend": "max"},   # aggregated mocker fleet
+            config=spec.controller
+            or ControllerConfig(
+                interval_s=spec.control_interval_s,
+                scale_up_cooldown_s=spec.control_interval_s,
+                scale_down_cooldown_s=2 * spec.control_interval_s,
+                down_stable_cycles=2,
+                max_step_up=4,
+                max_step_down=1,
+                queue_depth_per_replica=8.0,
+                min_replicas=spec.min_replicas,
+                max_replicas=spec.max_replicas,
+            ),
+            clock=lambda: self.t,
+        )
+        start = spec.initial_replicas if spec.planner_on else spec.static_replicas
+        for pool in self.controller.pools.values():
+            pool.target = pool.desired = start
+        for _ in range(start):
+            self.spawn_worker()
+        # Per-window stats the controller tick turns into an Observation.
+        self._win = self._fresh_window()
+
+    # -- fleet plumbing ----------------------------------------------------
+
+    def spawn_worker(self) -> SimWorker:
+        w = SimWorker(self._next_wid, self.spec, self.t)
+        self._next_wid += 1
+        self.workers.append(w)
+        self.placements.setdefault(w.id, 0)
+        return w
+
+    def _live(self, routable: bool = False) -> list[SimWorker]:
+        return [
+            w
+            for w in self.workers
+            if not w.dead and not (routable and w.draining)
+        ]
+
+    def _fleet_view(self) -> dict:
+        """The WorkerMonitor twin: live workers' ForwardPassMetrics —
+        queue depths + each worker's measured per-peer pull costs — the
+        NetCostModel folds exactly as it would from the real monitor."""
+        out = {}
+        for w in self._live():
+            m = w.eng.metrics()
+            m.worker_id = w.id
+            out[w.id] = m
+        return out
+
+    def _fresh_window(self) -> dict:
+        return {
+            "arrivals": 0,
+            "isl_sum": 0.0,
+            "osl_sum": 0.0,
+            "ttft": [],
+            "tpot": [],
+            "sheds": 0,
+        }
+
+    def _account(self, until: float) -> None:
+        """Integrate replica-seconds (draining workers still bill — their
+        capacity is not yet released) up to fleet time ``until``."""
+        n = len(self._live())
+        self._peak = max(self._peak, n)
+        self._replica_seconds += n * max(0.0, until - self._last_acct_t)
+        self._last_acct_t = until
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(
+        self,
+        arr: Arrival,
+        *,
+        replay_base: int = 0,
+        max_tokens: int | None = None,
+        exclude: set[int] | None = None,
+        deadline: bool = True,
+    ) -> None:
+        cands = [
+            w
+            for w in self._live(routable=True)
+            if not exclude or w.id not in exclude
+        ]
+        if not cands:
+            # Whole fleet draining/dead: nothing routable. Count as a
+            # typed shed (the frontend would return a retryable 503).
+            rec = self.recs[arr.rid]
+            rec.shed = "no_workers"
+            rec.done = True
+            self._win["sheds"] += 1
+            return
+        by_id = {w.id: w for w in cands}
+        prompt = arr.token_ids
+        hashes = compute_seq_hashes(prompt, self.spec.block_size)
+        overlaps = {w.id: w.eng.kv.match_prefix(hashes) for w in cands}
+        sel = self.selector.select_worker(
+            list(by_id), overlaps, len(prompt), self.active, self.rconfig
+        )
+        w = by_id[sel.worker_id]
+        w.vt = max(w.vt, self.t)
+        self.placements[w.id] = self.placements.get(w.id, 0) + 1
+        # Peer-prefix pull, cost-decided in network-aware mode and
+        # most-blocks in overlap-only mode (the router.peer_hint split).
+        if self.spec.pull_enabled:
+            hint = self._peer_hint(sel, overlaps)
+            if hint is not None:
+                self._pull(w, hint[0], hashes[: hint[1]])
+        seq = _Seq(
+            request_id=arr.rid,
+            prompt=list(prompt),
+            max_tokens=max_tokens if max_tokens is not None else arr.osl,
+            out=asyncio.Queue(),
+            seq=TokenBlockSequence(list(prompt), self.spec.block_size),
+            prompt_hashes=hashes,
+            stop=StopConditions(
+                max_tokens=max_tokens if max_tokens is not None else arr.osl,
+                ignore_eos=True,
+            ),
+            tenant_id=arr.tenant,
+            replay_base=replay_base,
+        )
+        if deadline and arr.deadline_ms is not None:
+            seq.deadline_epoch = arr.t + arr.deadline_ms / 1e3
+        w.eng._waiting.append(seq)
+        w.inflight.append(seq)
+        self.active.add_request(
+            arr.rid, w.id, len(prompt), sel.overlap_blocks
+        )
+        self.recs[arr.rid].workers.append(w.id)
+
+    def _peer_hint(self, sel, overlaps: dict[int, int]) -> tuple[int, int] | None:
+        if self.spec.network_aware:
+            return sel.pull_hint
+        if not overlaps:
+            return None
+        peer, blocks = best_peer_hint(overlaps)
+        if peer != sel.worker_id and blocks > sel.overlap_blocks:
+            return peer, blocks
+        return None
+
+    def _pull(self, w: SimWorker, source: int, hashes: list[int]) -> None:
+        """Move a peer's cached prefix onto ``w`` at the SOURCE's priced
+        per-block cost; failures (partition, dead source) charge the
+        timeout budget and fall back to local recompute — the PR 6
+        degrade-never-stall contract."""
+        src = next((x for x in self.workers if x.id == source), None)
+        cut = self._partitioned
+        blocked = (
+            src is None
+            or src.dead
+            or cut.get(source, 0.0) > self.t
+            or cut.get(w.id, 0.0) > self.t
+        )
+        if blocked:
+            self.failed_pulls += 1
+            w.vt += PULL_TIMEOUT_MS / 1e3
+            w.eng.peer_stats.note_pull(source, 0, PULL_TIMEOUT_MS, False)
+            return
+        parents = [hashes[i - 1] if i else None for i in range(len(hashes))]
+        imported, _ = w.eng.import_peer_blocks(hashes, parents)
+        if not imported:
+            return
+        cost_ms = imported * src.pull_ms_per_block
+        w.vt += cost_ms / 1e3
+        w.eng.peer_stats.note_pull(source, imported, cost_ms, True)
+        self.pulls_by_source[source] = (
+            self.pulls_by_source.get(source, 0) + imported
+        )
+
+    # -- stream collection -------------------------------------------------
+
+    def _drain_frames(self, w: SimWorker) -> None:
+        done: list[_Seq] = []
+        for seq in w.inflight:
+            self._drain_seq(w, seq)
+            rec = self.recs.get(seq.request_id)
+            if rec is not None and rec.done and seq.out.empty():
+                done.append(seq)
+        for seq in done:
+            w.inflight.remove(seq)
+
+    def _drain_seq(self, w: SimWorker, seq: _Seq) -> None:
+        rec = self.recs.get(seq.request_id)
+        if rec is None:
+            return
+        while not seq.out.empty():
+            item = seq.out.get_nowait()
+            if not isinstance(item, dict):
+                continue
+            toks = item.get("token_ids") or []
+            if toks and rec.t_first is None:
+                rec.t_first = w.vt
+            if toks:
+                rec.t_last = w.vt
+                rec.n_tokens += len(toks)
+                if self.spec.keep_streams:
+                    rec.tokens.extend(toks)
+            fin = item.get("finish_reason")
+            if fin:
+                rec.finishes += 1
+                if fin == "error":
+                    rec.shed = (item.get("meta") or {}).get("shed", "error")
+                    self._win["sheds"] += 1
+                    rec.done = True
+                    self.active.free(rec.arrival.rid)
+                elif rec.n_tokens >= self._budget(rec):
+                    rec.done = True
+                    self.active.free(rec.arrival.rid)
+                    self._finish_stats(rec)
+
+    def _budget(self, rec: _Rec) -> int:
+        return rec.arrival.osl
+
+    def _finish_stats(self, rec: _Rec) -> None:
+        arr = rec.arrival
+        if rec.t_first is None:
+            return
+        ttft = rec.t_first - arr.t
+        self._win["ttft"].append(ttft)
+        if arr.osl > 1 and rec.t_last is not None and rec.t_last > rec.t_first:
+            self._win["tpot"].append(
+                (rec.t_last - rec.t_first) / (arr.osl - 1)
+            )
+
+    # -- engine advance ----------------------------------------------------
+
+    def _advance(self, until: float) -> None:
+        for w in list(self.workers):
+            if w.dead:
+                continue
+            while w.vt < until and w.busy:
+                w.step()
+                self._drain_frames(w)
+            if not w.busy:
+                w.vt = max(w.vt, until)
+                if w.draining:
+                    # Graceful drain complete: everything the worker
+                    # accepted has streamed; now it retires.
+                    w.dead = True
+                    self.retired_drained += 1
+                    self.active.remove_worker(w.id)
+
+    # -- control loop ------------------------------------------------------
+
+    def _tick(self, loop: asyncio.AbstractEventLoop) -> None:
+        win, spec = self._win, self.spec
+        window = spec.control_interval_s
+        n = win["arrivals"]
+        ttfts, tpots = win["ttft"], win["tpot"]
+        att: dict[str, float] = {}
+        if ttfts:
+            att["ttft"] = sum(
+                1 for v in ttfts if v <= spec.sla.ttft_s
+            ) / len(ttfts)
+        if tpots:
+            att["tpot"] = sum(
+                1 for v in tpots if v <= spec.sla.itl_s
+            ) / len(tpots)
+        live = self._live(routable=True)
+        # observed_ttft_s is deliberately NOT fed: the harness's client
+        # TTFT includes queue wait, and the prefill correction factor
+        # must never be driven by queueing (planner_core's own rule —
+        # it prefers the tracer's prefill-phase mean for this reason).
+        # Queue pressure reaches the controller through queue_depth /
+        # sheds / slo_attainment instead.
+        obs = Observation(
+            request_rate=n / window,
+            mean_isl=(win["isl_sum"] / n) if n else 128.0,
+            mean_osl=(win["osl_sum"] / n) if n else 16.0,
+            observed_itl_s=(sum(tpots) / len(tpots)) if tpots else None,
+            queue_depth=float(
+                sum(len(w.eng._waiting) for w in self._live())
+            ),
+            shed_delta=float(win["sheds"]),
+            slo_attainment=att or None,
+            live_workers={"backend": len(live)},
+        )
+        loop.run_until_complete(self.controller.cycle(obs))
+        self._win = self._fresh_window()
+
+    def _chaos(self, ev: ChaosEvent) -> None:
+        if ev.action == "partition":
+            wid = ev.worker
+            self._partitioned[wid] = max(
+                self._partitioned.get(wid, 0.0), self.t + ev.duration_s
+            )
+            return
+        if ev.action == "drain":
+            w = next(
+                (x for x in self.workers if x.id == ev.worker and not x.dead),
+                None,
+            )
+            if w is not None:
+                w.draining = True
+            return
+        if ev.action != "kill":
+            raise ValueError(f"unknown chaos action {ev.action!r}")
+        victim: SimWorker | None = None
+        if ev.worker >= 0:
+            victim = next(
+                (w for w in self.workers if w.id == ev.worker and not w.dead),
+                None,
+            )
+        else:
+            draining = [w for w in self.workers if w.draining and not w.dead]
+            victim = draining[-1] if draining else None
+        if victim is None:
+            return
+        self._kill(victim)
+
+    def _kill(self, w: SimWorker) -> None:
+        """Chaos kill: the worker stops mid-decode. Frames already in the
+        out queues were committed (the client received them) — keep them;
+        everything unfinished migrates with ``replay_base`` at the
+        committed position, continuing each stream bit-identically on a
+        survivor (the PR 6 migration replay, on the sim timeline)."""
+        w.dead = True
+        w.eng._dead = True
+        victims = list(w.inflight)
+        for seq in victims:
+            self._drain_seq(w, seq)
+        w.inflight.clear()
+        self.active.remove_worker(w.id)
+        for seq in victims:
+            rec = self.recs.get(seq.request_id)
+            if rec is None or rec.done:
+                continue
+            remaining = rec.arrival.osl - rec.n_tokens
+            if remaining <= 0:
+                continue
+            self.migrations += 1
+            # No deadline on the replay: migration is a completion
+            # promise — tokens already streamed must never be followed
+            # by a shed (the PR 6 bit-identical replay contract).
+            self._route(
+                rec.arrival,
+                replay_base=rec.n_tokens,
+                max_tokens=remaining,
+                exclude={w.id},
+                deadline=False,
+            )
+
+    # -- run ---------------------------------------------------------------
+
+    def _background_events(self) -> list[tuple[float, int]]:
+        """(t, worker_id) grid of out-of-band arrivals, deterministic."""
+        spec = self.spec
+        out: list[tuple[float, int]] = []
+        for wid, rps in spec.background_rps.items():
+            if rps <= 0:
+                continue
+            step = 1.0 / rps
+            t = step / 2.0
+            while t < spec.duration_s:
+                out.append((t, wid))
+                t += step
+        return out
+
+    def _inject_background(self, wid: int, n: int) -> None:
+        """One out-of-band request straight into the worker's admission
+        queue — another frontend's traffic, bypassing this router."""
+        spec = self.spec
+        w = next(
+            (x for x in self.workers if x.id == wid and not x.dead), None
+        )
+        if w is None:
+            return
+        prompt = [251 - (wid % 4)] * max(
+            spec.block_size, spec.background_isl
+        )
+        seq = _Seq(
+            request_id=f"bg-{wid}-{n}",
+            prompt=prompt,
+            max_tokens=spec.background_osl,
+            out=asyncio.Queue(),
+            seq=TokenBlockSequence(prompt, spec.block_size),
+            prompt_hashes=compute_seq_hashes(prompt, spec.block_size),
+            stop=StopConditions(
+                max_tokens=spec.background_osl, ignore_eos=True
+            ),
+            tenant_id="background",
+        )
+        w.eng._waiting.append(seq)
+
+    def run(self) -> FleetReport:
+        spec = self.spec
+        arrivals = generate_arrivals(
+            spec.tenants, spec.duration_s, seed=spec.seed,
+            block_size=spec.block_size,
+        )
+        for a in arrivals:
+            self.recs[a.rid] = _Rec(arrival=a)
+        # Fleet events in time order: arrivals first at a tie (the
+        # controller observes a window that includes them), chaos next,
+        # controller ticks last.
+        events: list[tuple[float, int, object]] = [
+            (a.t, 0, a) for a in arrivals
+        ]
+        events += [
+            (tb, 0, ("bg", wid, i))
+            for i, (tb, wid) in enumerate(self._background_events())
+        ]
+        events += [(c.t, 1, c) for c in spec.chaos]
+        if spec.planner_on:
+            n_ticks = int(spec.duration_s / spec.control_interval_s)
+            events += [
+                (i * spec.control_interval_s, 2, "tick")
+                for i in range(1, n_ticks + 1)
+            ]
+        # Stable sort on (t, kind) only — payloads don't order, and ties
+        # (same-instant arrivals, drain+kill chaos pairs) keep insertion
+        # order.
+        events.sort(key=lambda e: (e[0], e[1]))
+        loop = asyncio.new_event_loop()
+        try:
+            for te, _, ev in events:
+                self._advance(te)
+                self._account(te)
+                self.t = te
+                if isinstance(ev, Arrival):
+                    self._win["arrivals"] += 1
+                    self._win["isl_sum"] += len(ev.token_ids)
+                    self._win["osl_sum"] += ev.osl
+                    self._route(ev)
+                elif isinstance(ev, ChaosEvent):
+                    self._chaos(ev)
+                elif isinstance(ev, tuple) and ev[0] == "bg":
+                    self._inject_background(ev[1], ev[2])
+                else:
+                    self._tick(loop)
+            # Drain the tail: advance everyone until nothing is in
+            # flight (bounded — a wedged fleet fails loudly).
+            deadline = spec.duration_s * (1.0 + MAX_OVERRUN)
+            while any(w.busy for w in self._live()):
+                horizon = (
+                    max(w.vt for w in self._live() if w.busy) + 1.0
+                )
+                if horizon > deadline:
+                    raise RuntimeError(
+                        "fleet failed to drain: "
+                        f"{sum(w.busy for w in self._live())} workers busy "
+                        f"past t={deadline:.0f}s"
+                    )
+                self._advance(horizon)
+                self._account(min(horizon, spec.duration_s))
+                self.t = horizon
+        finally:
+            loop.close()
+        return self._report(arrivals)
+
+    def _report(self, arrivals: list[Arrival]) -> FleetReport:
+        spec = self.spec
+        completed = shed = broken = tokens = 0
+        ttfts: list[float] = []
+        tpots: list[float] = []
+        for rec in self.recs.values():
+            arr = rec.arrival
+            if rec.shed is not None:
+                # A typed shed must be clean: no tokens ever streamed.
+                shed += 1
+                if rec.n_tokens:
+                    broken += 1
+                continue
+            if rec.done and rec.n_tokens == arr.osl:
+                completed += 1
+                tokens += rec.n_tokens
+                if rec.t_first is not None:
+                    ttfts.append(rec.t_first - arr.t)
+                    if (
+                        arr.osl > 1
+                        and rec.t_last is not None
+                        and rec.t_last > rec.t_first
+                    ):
+                        tpots.append(
+                            (rec.t_last - rec.t_first) / (arr.osl - 1)
+                        )
+            else:
+                broken += 1
+        total = len(arrivals)
+        # SLO attainment over EVERY request: sheds and broken streams are
+        # misses — unserved traffic cannot count as meeting the SLA.
+        ok_ttft = sum(1 for v in ttfts if v <= spec.sla.ttft_s)
+        ok_tpot = sum(1 for v in tpots if v <= spec.sla.itl_s)
+        ttfts.sort()
+        tpots.sort()
+
+        def pct(vals: list[float], q: float) -> float:
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+        return FleetReport(
+            scenario=(
+                ("planner" if spec.planner_on else "static")
+                + ("+netroute" if spec.network_aware else "")
+            ),
+            duration_s=spec.duration_s,
+            requests=total,
+            completed=completed,
+            shed=shed,
+            broken_streams=broken,
+            attainment_ttft=round(ok_ttft / total, 4) if total else 0.0,
+            attainment_tpot=(
+                round(ok_tpot / max(1, len(tpots)), 4) if tpots else 0.0
+            ),
+            goodput_tok_s=round(tokens / max(spec.duration_s, 1e-9), 1),
+            ttft_p50_ms=round(pct(ttfts, 0.50) * 1e3, 1),
+            ttft_p99_ms=round(pct(ttfts, 0.99) * 1e3, 1),
+            tpot_p50_ms=round(pct(tpots, 0.50) * 1e3, 2),
+            replica_seconds=round(self._replica_seconds, 1),
+            mean_replicas=round(
+                self._replica_seconds / max(spec.duration_s, 1e-9), 2
+            ),
+            peak_replicas=self._peak,
+            decisions=dict(self.controller.decisions),
+            scale_ups=self.connector.scale_ups,
+            scale_downs=self.connector.scale_downs,
+            drained_retired=self.retired_drained,
+            migrations=self.migrations,
+            placements=dict(self.placements),
+            pulls_by_source=dict(self.pulls_by_source),
+            failed_pulls=self.failed_pulls,
+            streams=(
+                {
+                    rid: rec.tokens
+                    for rid, rec in sorted(self.recs.items())
+                }
+                if spec.keep_streams
+                else None
+            ),
+        )
+
+
+# -- the two headline A/Bs -------------------------------------------------
+
+
+def default_tenants(
+    scale: float = 1.0,
+    users: int = 120_000,
+    deadline_ms: float | None = 4000.0,
+) -> list[TenantSpec]:
+    """The standard diurnal multi-tenant mix: a big consumer tenant with
+    the full 4x peak/trough swing, an enterprise tenant half a period out
+    of phase, and a small bursty agent tenant. ``scale`` multiplies every
+    rate; ``users`` sizes the consumer population."""
+    return [
+        TenantSpec(
+            name="consumer",
+            users=users,
+            rps=18.0 * scale,
+            diurnal_amplitude=0.6,
+            diurnal_period_s=240.0,
+            isl=64,
+            osl=8,
+            shared_prefix_tokens=32,
+            deadline_ms=deadline_ms,
+        ),
+        TenantSpec(
+            name="enterprise",
+            users=max(1, users // 10),
+            rps=8.0 * scale,
+            diurnal_amplitude=0.6,
+            diurnal_period_s=240.0,
+            isl=96,
+            osl=8,
+            shared_prefix_tokens=64,
+            deadline_ms=deadline_ms,
+        ),
+        TenantSpec(
+            name="agents",
+            users=max(1, users // 100),
+            rps=4.0 * scale,
+            burst_rps=12.0 * scale,
+            burst_every_s=60.0,
+            burst_len_s=10.0,
+            isl=64,
+            osl=8,
+            shared_prefix_tokens=32,
+            deadline_ms=deadline_ms,
+        ),
+    ]
+
+
+def run_fleet_ab(
+    tenants: list[TenantSpec] | None = None,
+    duration_s: float = 360.0,
+    seed: int = 0,
+    sla: SlaTargets | None = None,
+    max_replicas: int = 16,
+    keep_streams: bool = False,
+    chaos: list[ChaosEvent] | None = None,
+) -> dict:
+    """The autoscaling A/B: planner-on first (it discovers its own
+    capacity trajectory), then a static pool frozen at the planner's
+    MEAN replica count — the equal-budget baseline. Under the diurnal
+    swing the same average capacity, fixed in time, starves the peak."""
+    sla = sla or SlaTargets(ttft_s=0.35, itl_s=0.08)
+    tenants = tenants or default_tenants()
+
+    def spec(planner_on: bool, static: int = 0) -> FleetSpec:
+        return FleetSpec(
+            tenants=tenants,
+            duration_s=duration_s,
+            seed=seed,
+            planner_on=planner_on,
+            static_replicas=static,
+            # Warm start at the t=0 load's requirement, like a real
+            # autoscaler taking over a provisioned deployment — a cold
+            # 1-2 worker start would charge the A/B for deployment
+            # bring-up, which both scenarios are entitled to skip.
+            initial_replicas=4,
+            max_replicas=max_replicas,
+            sla=sla,
+            chaos=list(chaos or []),
+            keep_streams=keep_streams,
+        )
+
+    planner = FleetHarness(spec(True)).run()
+    budget = max(1, round(planner.mean_replicas))
+    static = FleetHarness(spec(False, static=budget)).run()
+    return {
+        "planner": planner,
+        "static": static,
+        "static_budget_replicas": budget,
+    }
+
+
+def run_routing_ab(
+    duration_s: float = 60.0,
+    seed: int = 1,
+    workers: int = 4,
+    slow_worker: int = 0,
+    slow_pull_ms: float = 25.0,
+    fast_pull_ms: float = 0.2,
+    background_rps: float = 6.0,
+    slow_factor: float = 3.0,
+) -> dict:
+    """The NetKV A/B: a fixed fleet with one slow, LOADED peer that
+    happens to hold the hottest shared prefix — ``slow_factor`` slower
+    hardware, ``slow_pull_ms`` per block on the wire, and carrying
+    ``background_rps`` of traffic from another frontend (visible only
+    through the worker's reported queue metrics). Overlap-only routing
+    keeps placing
+    on it (best overlap; the out-of-band load is invisible to its cost)
+    and keeps pulling from it (most blocks); the network-aware cost
+    model measures its per-block pull latency and queue depth within a
+    few transfers and shifts BOTH decisions to cheap, unloaded peers.
+    Streams must be byte-identical either way — routing only moves
+    where work lands."""
+    tenants = [
+        TenantSpec(
+            name="shared",
+            users=50_000,
+            rps=24.0,
+            isl=128,
+            osl=6,
+            shared_prefix_tokens=96,
+        ),
+    ]
+
+    def run(aware: bool) -> FleetReport:
+        spec = FleetSpec(
+            tenants=tenants,
+            duration_s=duration_s,
+            seed=seed,
+            planner_on=False,
+            static_replicas=workers,
+            network_aware=aware,
+            # One queued request is roughly a prompt's worth of blocks
+            # of pending work — weigh reported queue depth accordingly.
+            queue_weight=float(tenants[0].isl // 8),
+            worker_pull_ms={slow_worker: slow_pull_ms},
+            worker_speed={slow_worker: slow_factor},
+            pull_ms_per_block=fast_pull_ms,
+            background_rps={slow_worker: background_rps},
+            sla=SlaTargets(ttft_s=0.35, itl_s=0.08),
+            keep_streams=True,
+        )
+        h = FleetHarness(spec)
+        # Pre-warm the slow worker with the tenant's shared prefix so it
+        # overlaps best from the first arrival (the trap overlap-only
+        # scoring walks into). Token derivation mirrors workload.py.
+        spt = tenants[0].shared_prefix_tokens
+        prefix_len = spt - (spt % spec.block_size) or spec.block_size
+        th = tenant_hue(tenants[0].name)
+        prefix = [(th + i) % 251 for i in range(prefix_len)]
+        hashes = compute_seq_hashes(prefix, spec.block_size)
+        parents = [hashes[i - 1] if i else None for i in range(len(hashes))]
+        h.workers[slow_worker].eng.import_peer_blocks(hashes, parents)
+        return h.run()
+
+    base = run(aware=False)
+    aware = run(aware=True)
+    return {"overlap_only": base, "network_aware": aware}

@@ -135,6 +135,28 @@ def main() -> None:
              "queue limit are skipped at that limit automatically)",
     )
     ap.add_argument(
+        "--network-aware-routing", default="off", choices=["on", "off"],
+        help="extend KV routing cost beyond prefix overlap: candidates "
+             "are charged their queue depth, and the prefill a candidate "
+             "could skip by pulling a peer's cached prefix is discounted "
+             "by that peer's MEASURED per-block transfer cost "
+             "(ForwardPassMetrics.net) — decode placement and "
+             "peer-prefix pulls both shift away from slow/loaded peers. "
+             "Streams are bit-identical on or off",
+    )
+    ap.add_argument(
+        "--queue-weight", type=float, default=1.0,
+        help="blocks-equivalent routing cost per queued request on a "
+             "candidate (network-aware routing's load term)",
+    )
+    ap.add_argument(
+        "--recompute-ms-per-block", type=float, default=2.0,
+        help="local prefill recompute cost per KV block in ms — the "
+             "yardstick a MEASURED peer pull must beat before "
+             "network-aware routing counts the pull as relief; set from "
+             "the engine profile (block_size * prefill us/token / 1000)",
+    )
+    ap.add_argument(
         "--tenant-rate-limit", type=float, default=0.0,
         help="per-tenant sustained requests/second (x-tenant-id header "
              "keys the bucket); over-limit answers 429 + Retry-After. "
@@ -177,6 +199,9 @@ def main() -> None:
         replica_sync=args.kv_replica_sync,
         busy_threshold=args.busy_threshold,
         queue_threshold=args.queue_threshold,
+        network_aware=args.network_aware_routing == "on",
+        queue_weight=args.queue_weight,
+        recompute_ms_per_block=args.recompute_ms_per_block,
     )
     admission = AdmissionConfig(
         tenant_rate=args.tenant_rate_limit,

@@ -31,7 +31,7 @@ import asyncio
 import logging
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.dataplane import BreakerOpenError
@@ -48,6 +48,12 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+# EWMA weight for per-peer cost samples: heavy enough that a peer
+# turning slow is noticed within a few pulls, light enough that one
+# outlier frame doesn't condemn a healthy peer.
+NET_EWMA_ALPHA = 0.3
+
+
 @dataclass
 class PeerPullStats:
     """Shared counter shape for the jax client and the mocker mirror
@@ -62,6 +68,43 @@ class PeerPullStats:
     last_pull_ms: float = 0.0
     breaker_fast_fails: int = 0
     dtype_mismatches: int = 0
+    # Per-peer MEASURED transfer cost (NetKV, ISSUE 14): worker_id of the
+    # pull source -> {"pulls", "failures", "blocks", "ms_per_block"}
+    # where ms_per_block is an EWMA of observed per-block pull latency.
+    # Published in ForwardPassMetrics.net so routers can weigh decode
+    # placement and peer-prefix hints by what transfers actually cost,
+    # per address, instead of assuming the network is uniform.
+    per_peer: dict[int, dict] = field(default_factory=dict)
+
+    def note_pull(
+        self, peer_id: int, blocks: int, elapsed_ms: float, ok: bool
+    ) -> None:
+        """Fold one pull outcome into the peer's measured cost. A failed
+        pull charges its whole elapsed wall-clock as if it moved one
+        block — a stalled/severed peer's EWMA absorbs the frame-timeout
+        budget it burned, which is exactly the cost routing should avoid."""
+        st = self.per_peer.setdefault(
+            int(peer_id),
+            {"pulls": 0, "failures": 0, "blocks": 0, "ms_per_block": 0.0},
+        )
+        st["pulls"] += 1
+        if ok:
+            st["blocks"] += blocks
+            sample = elapsed_ms / max(1, blocks)
+        else:
+            st["failures"] += 1
+            sample = elapsed_ms
+        prev = st["ms_per_block"]
+        st["ms_per_block"] = (
+            sample
+            if st["pulls"] == 1
+            else (1 - NET_EWMA_ALPHA) * prev + NET_EWMA_ALPHA * sample
+        )
+
+    def net_dict(self) -> dict[int, dict]:
+        """Wire shape for ForwardPassMetrics.net (value copies — the
+        publisher must not race live mutation)."""
+        return {p: dict(st) for p, st in self.per_peer.items()}
 
     def as_dict(self) -> dict:
         return {
@@ -100,6 +143,9 @@ class PeerKvClient:
         )
         self.chunk_blocks = chunk_blocks
         self.stats = PeerPullStats()
+        # Publish this worker's measured per-peer pull costs through the
+        # engine's ForwardPassMetrics (the network-aware router's feed).
+        core.net_stats_source = self.stats.net_dict
 
     async def pull_prefix(self, hint: dict, token_ids: list[int]) -> int:
         """Pull the peer's cached prefix of ``token_ids`` that this worker
@@ -194,6 +240,13 @@ class PeerKvClient:
         st.pull_ms_total += elapsed_ms
         st.last_pull_ms = elapsed_ms
         st.blocks_pulled += imported
+        peer = hint.get("worker_id")
+        if peer is not None:
+            # Per-peer measured cost (NetKV): success charges elapsed /
+            # blocks, failure charges the whole elapsed budget — the
+            # router's network-aware scoring reads this via
+            # ForwardPassMetrics.net.
+            st.note_pull(int(peer), imported, elapsed_ms, ok)
         if ok:
             st.pulls_succeeded += 1
             log.debug(

@@ -128,19 +128,32 @@ class ForwardPassMetrics:
     # Disagg KV transfer accounting (imported/skipped/dropped block
     # counts; see EngineCore.transfer_stats). None = engine predates it.
     transfer: dict[str, int] | None = None
+    # Network-aware routing (NetKV, ISSUE 14): this worker's MEASURED
+    # per-peer KV-pull cost — {source worker_id: {"pulls", "failures",
+    # "blocks", "ms_per_block"}} from PeerPullStats.net_dict(). Routers
+    # fold every reporter's view of a peer into one fleet-wide transfer
+    # cost per source. None = no pulls observed / engine predates it.
+    net: dict[int, dict] | None = None
 
     def to_wire(self) -> bytes:
-        return msgpack.packb(asdict(self))
+        d = asdict(self)
+        if d.get("net"):
+            # Stringify map keys: msgpack's default strict unpacker
+            # refuses integer map keys.
+            d["net"] = {str(k): v for k, v in d["net"].items()}
+        return msgpack.packb(d)
 
     @classmethod
     def from_wire(cls, raw: bytes) -> "ForwardPassMetrics":
         d = msgpack.unpackb(raw, raw=False)
+        net = d.get("net")
         return cls(
             worker_id=d["worker_id"],
             worker=WorkerStats(**d["worker"]),
             kv=KvStats(**d["kv"]),
             spec_decode=d.get("spec_decode"),
             transfer=d.get("transfer"),
+            net={int(k): v for k, v in net.items()} if net else None,
         )
 
 
@@ -160,6 +173,24 @@ class RouterConfig:
     # least this many queued requests. None = auto — workers exporting a
     # bounded-queue limit are skipped when their queue reaches it.
     queue_threshold: int | None = None
+    # Network-aware routing (NetKV, ISSUE 14): extend the cost beyond
+    # prefix overlap with (a) each candidate's queue depth and (b) the
+    # MEASURED per-peer KV-pull cost — prefill a candidate can avoid by
+    # pulling a peer's cached prefix only counts as avoided in proportion
+    # to how cheap that peer's transfers actually are. Off (default) the
+    # selector and peer hints are byte-identical to the overlap-only
+    # router.
+    network_aware: bool = False
+    # Blocks-equivalent cost per queued request on a candidate (the load
+    # term NetKV weighs next to transfer cost). Used only when
+    # network_aware is on.
+    queue_weight: float = 1.0
+    # Per-block local prefill recompute cost in ms — the yardstick a
+    # measured peer pull must beat (a pull at or above this never counts
+    # as prefill relief). Set it from the engine profile
+    # (block_size * prefill us/token / 1000); the default suits the
+    # mocker's timing. Used only when network_aware is on.
+    recompute_ms_per_block: float = 2.0
     # None → inherit the model card's kv_block_size at model-add time.
     # Must match the worker's KV block size or seq hashes never overlap.
     block_size: int | None = None

@@ -55,7 +55,24 @@ class KvRouter:
             config = dataclasses.replace(config, block_size=32)
         self.config = config
         self.active = ActiveSequences(block_size=self.config.block_size)
-        self.selector = DefaultWorkerSelector()
+        # Network-aware scoring (NetKV, ISSUE 14): measured transfer cost
+        # + queue depth extend the overlap cost. The netcost model's
+        # fleet view is wired by KvPushRouter from its WorkerMonitor.
+        self.netcost = None
+        if self.config.network_aware:
+            from dynamo_tpu.llm.kv_router.netcost import (
+                NetCostModel,
+                NetworkAwareSelector,
+            )
+
+            self.netcost = NetCostModel(
+                recompute_ms_per_block=self.config.recompute_ms_per_block
+            )
+            self.selector: DefaultWorkerSelector = NetworkAwareSelector(
+                self.netcost
+            )
+        else:
+            self.selector = DefaultWorkerSelector()
         if self.config.use_kv_events:
             self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(
                 store,
@@ -145,6 +162,20 @@ class KvRouter:
         self.indexer.remove_worker(worker_id)
         return self.active.remove_worker(worker_id)
 
+    def peer_hint(self, selection: SelectionResult) -> tuple[int, int] | None:
+        """The peer-prefix pull hint for a selection: network-aware mode
+        uses the selector's cost-decided source (None when no pull beats
+        recomputing — a slow peer is left alone even if it overlaps
+        best); overlap-only mode keeps the historical most-blocks hint."""
+        if self.config.network_aware:
+            return selection.pull_hint
+        if not selection.overlaps:
+            return None
+        peer, blocks = best_peer_hint(selection.overlaps)
+        if peer != selection.worker_id and blocks > selection.overlap_blocks:
+            return peer, blocks
+        return None
+
 
 class KvPushRouter:
     """EndpointClient + KvRouter glued into one `generate` surface."""
@@ -156,6 +187,11 @@ class KvPushRouter:
         # routing when the config sets a busy_threshold; its aggregator
         # also feeds ProcessedEndpoints snapshots to observers.
         self.monitor = monitor
+        if monitor is not None and getattr(router, "netcost", None) is not None:
+            # The netcost model reads queue depths and every worker's
+            # measured per-peer pull costs from the monitor's
+            # ForwardPassMetrics view (one subscription, shared).
+            router.netcost.fleet_view = lambda: monitor.metrics
         self._tracer = tracing.get_tracer("router")
         client.on_instance_removed.append(self._on_worker_gone)
 
@@ -203,11 +239,16 @@ class KvPushRouter:
             else:
                 config = self.router.config
                 if "overlap_weight" in overrides or "router_temperature" in overrides:
-                    config = RouterConfig(
-                        overlap_weight=overrides.get("overlap_weight", config.overlap_weight),
-                        temperature=overrides.get("router_temperature", config.temperature),
-                        use_kv_events=config.use_kv_events,
-                        block_size=config.block_size,
+                    # replace() keeps every other knob (network_aware,
+                    # queue_weight, thresholds) at the router's values.
+                    config = dataclasses.replace(
+                        config,
+                        overlap_weight=overrides.get(
+                            "overlap_weight", config.overlap_weight
+                        ),
+                        temperature=overrides.get(
+                            "router_temperature", config.temperature
+                        ),
                     )
                 selection = self.router.find_best_match(request_id, token_ids, workers, config)
                 if route_span.recording and selection.score_end_s > selection.score_start_s:
@@ -233,14 +274,16 @@ class KvPushRouter:
         # a worker with LESS of this prompt cached than some peer —
         # busy-avoidance, temperature sampling, migration exclusion — the
         # hint lets the chosen worker pull the peer's blocks (device or
-        # offload tiers) over the data plane instead of recomputing.
-        if selection.overlaps:
-            peer, blocks = best_peer_hint(selection.overlaps)
-            if peer != selection.worker_id and blocks > selection.overlap_blocks:
-                payload["kv_transfer_params"] = dict(
-                    payload.get("kv_transfer_params") or {},
-                    peer_prefix={"worker_id": peer, "blocks": blocks},
-                )
+        # offload tiers) over the data plane instead of recomputing. In
+        # network-aware mode the hint is cost-decided: a slow/loaded peer
+        # is skipped even when it overlaps best (router.peer_hint).
+        hint = self.router.peer_hint(selection)
+        if hint is not None:
+            peer, blocks = hint
+            payload["kv_transfer_params"] = dict(
+                payload.get("kv_transfer_params") or {},
+                peer_prefix={"worker_id": peer, "blocks": blocks},
+            )
 
         first = True
         stream = None

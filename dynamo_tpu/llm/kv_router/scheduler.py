@@ -43,6 +43,11 @@ class SelectionResult:
     # itself has no trace context, so the span is recorded upstream.
     score_start_s: float = 0.0
     score_end_s: float = 0.0
+    # Network-aware routing (netcost.NetworkAwareSelector): the chosen
+    # worker's cost-decided pull source — (source_worker_id, blocks held
+    # there) — already discounted by measured transfer cost. None = no
+    # pull beats recomputing (or the selector is overlap-only).
+    pull_hint: tuple[int, int] | None = None
 
 
 class WorkerSelector(Protocol):
@@ -87,6 +92,27 @@ class DefaultWorkerSelector:
     def __init__(self, rng: random.Random | None = None):
         self._rng = rng or random.Random()
 
+    def _score(
+        self,
+        worker_id: int,
+        overlap: int,
+        prefill_blocks: float,
+        decode_blocks: float,
+        overlaps: dict[int, int],
+        prompt_blocks: int,
+        config: RouterConfig,
+    ) -> tuple[float, object]:
+        """One candidate's cost, plus an opaque note handed to
+        :meth:`_annotate` if this candidate wins. Subclasses extend the
+        scoring HERE (NetworkAwareSelector) so the candidate loop itself
+        exists once and the two routing modes cannot silently diverge."""
+        return config.overlap_weight * prefill_blocks + decode_blocks, None
+
+    def _annotate(self, result: SelectionResult, note: object) -> SelectionResult:
+        """Post-selection hook: the winning candidate's note from
+        :meth:`_score`."""
+        return result
+
     def select_worker(
         self,
         workers: list[int],
@@ -101,16 +127,20 @@ class DefaultWorkerSelector:
         block_size = active.block_size
         prompt_blocks = math.ceil(prompt_tokens / block_size) if prompt_tokens else 0
         costs: dict[int, float] = {}
+        notes: dict[int, object] = {}
         for w in workers:
             overlap = min(overlaps.get(w, 0), prompt_blocks)
             decode_blocks, prefill_tokens = active.potential_blocks_and_tokens(
                 w, prompt_tokens, overlap
             )
             prefill_blocks = prefill_tokens / block_size
-            costs[w] = config.overlap_weight * prefill_blocks + decode_blocks
+            costs[w], notes[w] = self._score(
+                w, overlap, prefill_blocks, decode_blocks, overlaps,
+                prompt_blocks, config,
+            )
         chosen = softmax_sample(costs, config.temperature, self._rng)
         overlap = min(overlaps.get(chosen, 0), prompt_blocks)
-        return SelectionResult(
+        result = SelectionResult(
             worker_id=chosen,
             overlap_blocks=overlap,
             required_prefill_tokens=max(0, prompt_tokens - overlap * block_size),
@@ -118,3 +148,4 @@ class DefaultWorkerSelector:
             score_start_s=t_score,
             score_end_s=time.time(),
         )
+        return self._annotate(result, notes[chosen])

@@ -547,6 +547,9 @@ class MockTpuEngine:
                 if self._spec_default is not None or self.spec_stats.verify_rows
                 else None
             ),
+            # Measured per-peer pull cost (NetKV): routers read this to
+            # weigh decode placement / peer hints by real transfer cost.
+            net=self.peer_stats.net_dict() or None,
         )
 
     # -- simulation loop ---------------------------------------------------

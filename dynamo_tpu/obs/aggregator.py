@@ -85,6 +85,10 @@ class FleetAggregator:
         self._sub = None
         self._task: asyncio.Task | None = None
         self._metrics = None  # MetricsRegistry the fleet series land on
+        # Closed-loop controller (ISSUE 14): when attached, its decision
+        # counters/replica gauges export with the fleet series and the
+        # /fleet payload grows a "planner" section.
+        self._controller = None
         # Removal bookkeeping: what was exported, so retirement can
         # remove exactly those series (never zero them).
         self._exported_workers: set[int] = set()
@@ -93,6 +97,9 @@ class FleetAggregator:
         # observation() diff state.
         self._prev_totals: dict[str, float] | None = None
         self._prev_t: float = 0.0
+        # Last-seen cumulative typed-shed counter per worker: sheds are
+        # diffed per worker (retirement-aware), never on the fleet total.
+        self._prev_sheds: dict[int, float] = {}
         self._last_means = (256.0, 128.0)
 
     # -- lifecycle ---------------------------------------------------------
@@ -158,6 +165,14 @@ class FleetAggregator:
         """Retire on lease loss: discovery instance-removal events (the
         same watch the router uses to drop dead workers)."""
         client.on_instance_removed.append(self.remove_worker)
+
+    def attach_controller(self, controller) -> None:
+        """Export a PlannerController's decision counters and replica
+        gauges on the fleet registry (``planner_*`` series, synced on
+        every render like the rest) and surface its status in the
+        ``/fleet`` payload — the operator reads what the control loop
+        did and why from the same place they read the fleet's load."""
+        self._controller = controller
 
     def live_workers(self) -> list[int]:
         return sorted(self.latest)
@@ -257,6 +272,7 @@ class FleetAggregator:
                         f"live workers) of {name}: {doc}",
                     ).set(rollups[stat])
         self._sync_tenants()
+        self._sync_planner()
         # Aggregator health.
         agg = self._metrics.scoped(namespace=self.namespace, service="obs")
         agg.gauge(
@@ -315,6 +331,40 @@ class FleetAggregator:
                 "The tenant's DRR deficit balance, summed across live workers",
             ).set(st["deficit"])
 
+    def _sync_planner(self) -> None:
+        """Planner decision observability (ISSUE 14): decision counters
+        by action, per-pool current/desired replica gauges — the
+        controller's stats() payload re-exported as fleet series."""
+        if self._controller is None or self._metrics is None:
+            return
+        st = self._controller.stats()
+        base = self._metrics.scoped(namespace=self.namespace, service="planner")
+        base.gauge(
+            "planner_cycles_total",
+            "Closed-loop adjustment cycles the controller has run",
+        ).set(float(st.get("cycles", 0)))
+        for action, n in (st.get("decisions") or {}).items():
+            self._metrics.scoped(
+                namespace=self.namespace, service="planner", action=action
+            ).gauge(
+                "planner_decisions_total",
+                "Controller decisions by outcome (scale_up / scale_down / "
+                "hold / cooldown_hold / hysteresis_hold)",
+            ).set(float(n))
+        for comp, pool in (st.get("pools") or {}).items():
+            scoped = self._metrics.scoped(
+                namespace=self.namespace, service="planner", component=comp
+            )
+            scoped.gauge(
+                "planner_current_replicas",
+                "Replica count the controller last actuated for this pool",
+            ).set(float(pool.get("target", 0)))
+            scoped.gauge(
+                "planner_target_replicas",
+                "This cycle's desired replica count (pre-hysteresis/"
+                "cooldown), from the plan math + reactive pressure",
+            ).set(float(pool.get("desired", 0)))
+
     # -- planner feed ------------------------------------------------------
 
     def _totals(self) -> dict[str, float]:
@@ -334,6 +384,13 @@ class FleetAggregator:
                 totals[f"phase_sum/{phase}"] = (
                     totals.get(f"phase_sum/{phase}", 0.0) + sec
                 )
+        # Closed-loop signals (ISSUE 14): the SLO attributor's attainment
+        # counters, diffed per window by observation(). (Typed sheds are
+        # NOT totalled here — observation() diffs them per worker so a
+        # retiring worker's cumulative counter leaving the sum cannot
+        # clamp the fleet-wide delta to zero.)
+        for k, v in self.slo.attainment_counters().items():
+            totals[f"slo_{k}"] = v
         return totals
 
     def observation(self):
@@ -345,6 +402,20 @@ class FleetAggregator:
         self.sweep_stale()
         now = time.monotonic()
         cur = self._totals()
+        # Typed sheds: per-worker cumulative counters diffed per worker.
+        # A retired worker simply drops out of the dict; a worker id
+        # reused by a restarted process restarts near zero and clamps.
+        cur_sheds: dict[int, float] = {}
+        for wid, snap in self.latest.items():
+            sched = snap.families.get("scheduler") or {}
+            cur_sheds[wid] = float(sched.get("shed_total", 0) or 0) + float(
+                sched.get("deadline_expired_total", 0) or 0
+            )
+        shed_delta = sum(
+            max(0.0, v - self._prev_sheds.get(wid, 0.0))
+            for wid, v in cur_sheds.items()
+        )
+        self._prev_sheds = cur_sheds
         prev, prev_t = self._prev_totals, self._prev_t
         self._prev_totals, self._prev_t = cur, now
         if prev is None:
@@ -375,6 +446,27 @@ class FleetAggregator:
             c = delta(key)
             if c > 0:
                 phase_means[phase] = delta(f"phase_sum/{phase}") / c
+        # Closed-loop signals: point-in-time fleet queue depth, windowed
+        # typed sheds, windowed SLO attainment (None when nothing
+        # finished this window), live worker counts per component.
+        queue_depth = 0.0
+        queue_by_comp: dict[str, float] = {}
+        live: dict[str, int] = {}
+        for snap in self.latest.values():
+            sched = snap.families.get("scheduler") or {}
+            waiting = float(sched.get("waiting", 0) or 0)
+            queue_depth += waiting
+            queue_by_comp[snap.component] = (
+                queue_by_comp.get(snap.component, 0.0) + waiting
+            )
+            live[snap.component] = live.get(snap.component, 0) + 1
+        attainment: dict[str, float] = {}
+        ttft_n = delta("slo_ttft_n")
+        if ttft_n > 0:
+            attainment["ttft"] = delta("slo_ttft_ok") / ttft_n
+        tpot_n = delta("slo_tpot_n")
+        if tpot_n > 0:
+            attainment["tpot"] = delta("slo_tpot_ok") / tpot_n
         return Observation(
             request_rate=delta("requests_total") / window,
             mean_isl=isl,
@@ -382,6 +474,11 @@ class FleetAggregator:
             observed_ttft_s=(delta("ttft_sum") / ttft_c) if ttft_c else None,
             observed_itl_s=(delta("itl_sum") / itl_c) if itl_c else None,
             phase_means=phase_means or None,
+            queue_depth=queue_depth,
+            queue_depths=queue_by_comp or None,
+            shed_delta=shed_delta,
+            slo_attainment=attainment or None,
+            live_workers=live or None,
         )
 
     # -- /fleet payload ----------------------------------------------------
@@ -421,6 +518,11 @@ class FleetAggregator:
                 for w, s in sorted(self.frontends.items())
             },
             "slo": self.slo.summary(),
+            "planner": (
+                self._controller.status_payload()
+                if self._controller is not None
+                else None
+            ),
             "snapshots_received": self.snapshots_received_total,
             "workers_retired": self.workers_retired_total,
         }

@@ -325,6 +325,19 @@ class SloAttributor:
                     buckets=SLO_TTFT_BUCKETS,
                 ).observe(v)
 
+    def attainment_counters(self) -> dict[str, float]:
+        """Cumulative fleet-wide attainment counters (all tenants): the
+        aggregator diffs consecutive snapshots of these into the planner
+        Observation's *windowed* SLO attainment, so the controller reacts
+        to the last window's misses rather than the lifetime average."""
+        out = {"ttft_ok": 0.0, "ttft_n": 0.0, "tpot_ok": 0.0, "tpot_n": 0.0}
+        for st in self._tenants.values():
+            out["ttft_ok"] += st.ttft_ok
+            out["ttft_n"] += st.n
+            out["tpot_ok"] += st.tpot_ok
+            out["tpot_n"] += st.tpot_n
+        return out
+
     # -- summary (/fleet + bench) ------------------------------------------
 
     def summary(self) -> dict:

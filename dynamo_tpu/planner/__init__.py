@@ -11,6 +11,10 @@ from dynamo_tpu.planner.perf_interpolation import (
     PrefillInterpolator,
     from_profile,
 )
+from dynamo_tpu.planner.controller import (
+    ControllerConfig,
+    PlannerController,
+)
 from dynamo_tpu.planner.planner_core import (
     Connector,
     Observation,
@@ -25,6 +29,7 @@ __all__ = [
     "ARPredictor",
     "ConstantPredictor",
     "Connector",
+    "ControllerConfig",
     "DecodeInterpolator",
     "MovingAveragePredictor",
     "Observation",
@@ -32,6 +37,7 @@ __all__ = [
     "Plan",
     "Planner",
     "PlannerConfig",
+    "PlannerController",
     "PrefillInterpolator",
     "RecordingConnector",
     "SlaTargets",

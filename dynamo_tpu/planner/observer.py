@@ -37,9 +37,18 @@ _PHASE_LABEL_RE = re.compile(r'phase="([^"]+)"')
 
 
 def parse_prometheus(text: str) -> dict[str, float]:
-    """Sum every sample of each metric family (labels collapsed) — except
-    the tracer's per-phase histograms, whose ``_sum``/``_count`` series
-    are *also* kept per phase label (keyed ``{family}_sum{{phase}}``) so
+    """Sum every sample of each metric family (labels collapsed) AND keep
+    every labeled sample addressable under its full ``name{labels}`` key,
+    exactly as written on the wire. The family total is what the rate/ISL
+    diff math wants; the labeled keys are what the closed-loop controller
+    wants — per-worker (``{...worker_id="7"...}``) and per-tenant
+    (``{...tenant="acme"...}``) series read directly, without the
+    aggregator's rollups collapsing them. Two spellings of the same
+    series sum (a family name never contains ``{``, so labeled keys can
+    never collide with family totals).
+
+    The tracer's per-phase histograms additionally keep their historical
+    phase-only keys (``{family}_sum{{phase}}``) so
     :meth:`MetricsObserver.observe` can decompose TTFT/ITL by phase."""
     totals: dict[str, float] = {}
     for line in text.splitlines():
@@ -55,6 +64,9 @@ def parse_prometheus(text: str) -> dict[str, float]:
         except ValueError:
             continue
         totals[name] = totals.get(name, 0.0) + v
+        if "{" in name_part:
+            # The labeled sample stays addressable verbatim.
+            totals[name_part] = totals.get(name_part, 0.0) + v
         if name.startswith(_PHASE) and name != f"{_PHASE}_bucket":
             m = _PHASE_LABEL_RE.search(name_part)
             if m:

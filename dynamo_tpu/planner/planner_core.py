@@ -26,6 +26,27 @@ class SlaTargets:
     ttft_s: float = 0.2
     itl_s: float = 0.05
 
+    @classmethod
+    def from_env(cls) -> "SlaTargets":
+        """The same env knobs the SLO attributor reads
+        (``DYN_SLO_TTFT_MS`` / ``DYN_SLO_TPOT_MS``) — one spelling of the
+        targets across attribution and autoscaling, so ``/fleet``
+        attainment and the controller's scaling pressure can never judge
+        against different budgets."""
+        import os
+
+        def ms(name: str, default_s: float) -> float:
+            raw = os.environ.get(name)
+            try:
+                return float(raw) / 1e3 if raw is not None else default_s
+            except ValueError:
+                return default_s
+
+        return cls(
+            ttft_s=ms("DYN_SLO_TTFT_MS", cls.ttft_s),
+            itl_s=ms("DYN_SLO_TPOT_MS", cls.itl_s),
+        )
+
 
 @dataclass
 class PlannerConfig:
@@ -51,6 +72,22 @@ class Observation:
     # planner tell a routing regression from a prefill regression instead
     # of reasoning from totals alone.
     phase_means: dict[str, float] | None = None
+    # Closed-loop signals (ISSUE 14), filled by the fleet aggregator's
+    # event-plane feed: point-in-time queue depth summed over live
+    # workers, typed sheds (queue-full + deadline) observed in the
+    # window, per-target SLO attainment over the attributor's recent
+    # records ({"ttft": frac, "tpot": frac}), and live worker counts per
+    # component. The rate math above ignores these; the controller reads
+    # them as reactive scaling pressure.
+    queue_depth: float = 0.0
+    # Queue depth keyed by worker component (e.g. prefill/decode/backend)
+    # when the feed can attribute it — lets the controller aim backlog
+    # pressure at the pool that actually holds the backlog. None = only
+    # the fleet-wide total above is known.
+    queue_depths: dict[str, float] | None = None
+    shed_delta: float = 0.0
+    slo_attainment: dict[str, float] | None = None
+    live_workers: dict[str, int] | None = None
 
 
 @dataclass

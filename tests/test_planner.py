@@ -155,3 +155,166 @@ async def test_local_process_connector_scales_real_workers():
             conn.shutdown()
             await client.stop()
             await rt.shutdown()
+
+
+# -- ISSUE 14 satellites ----------------------------------------------------
+
+
+def test_ar_predictor_on_ramp():
+    """A linear ramp must be extrapolated BEYOND the last observation —
+    the anticipation the closed-loop controller leans on at diurnal
+    upswings."""
+    ar = ARPredictor(order=4)
+    for v in range(10, 60):
+        ar.observe(float(v))
+    pred = ar.predict()
+    assert pred > 59.0, f"ramp not extrapolated: {pred}"
+    assert pred < 80.0, f"ramp wildly overshot: {pred}"
+
+
+def test_ar_predictor_on_seasonal():
+    """On a sinusoid the AR fit must track the wave, not the mean: the
+    prediction at a rising zero-crossing exceeds the prediction at a
+    falling one."""
+    import numpy as np
+
+    period = 32
+
+    def run_until(phase_idx: int) -> float:
+        ar = ARPredictor(window=128, order=8)
+        for i in range(phase_idx):
+            ar.observe(10.0 + 8.0 * math.sin(2 * math.pi * i / period))
+        return ar.predict()
+
+    rising = run_until(3 * period)            # next value heads up
+    falling = run_until(3 * period + period // 2)
+    assert rising > falling
+    # And the fit is tight on a clean signal: within the wave's envelope.
+    assert 1.0 < rising < 19.0
+
+
+def test_ar_predictor_constant_and_stability():
+    """A constant signal predicts (approximately) itself, forever — no
+    drift, no blow-up, never negative."""
+    ar = ARPredictor(order=4)
+    for _ in range(200):
+        ar.observe(7.5)
+    for _ in range(20):
+        p = ar.predict()
+        assert p == pytest.approx(7.5, abs=0.5)
+        ar.observe(7.5)
+    # Decaying-to-zero load must never produce a negative rate.
+    ar2 = ARPredictor(order=4)
+    for v in [50.0, 20.0, 5.0, 1.0, 0.2, 0.0, 0.0, 0.0, 0.0, 0.0]:
+        ar2.observe(v)
+    assert ar2.predict() >= 0.0
+
+
+def test_ar_predictor_window_shorter_than_order():
+    """Fewer observations than the AR order: fall back to
+    last-value (and 0.0 on a cold start) instead of a degenerate fit."""
+    ar = ARPredictor(order=8)
+    assert ar.predict() == 0.0
+    for v in (3.0, 4.0):
+        ar.observe(v)
+    assert ar.predict() == 4.0
+    # Exactly order+1 observations is still too few for the lstsq rows.
+    for v in range(7):
+        ar.observe(float(v))
+    assert ar.predict() == 6.0
+
+
+def test_parse_prometheus_keeps_labeled_series_addressable():
+    """ISSUE 14 satellite: labeled samples of one family must stay
+    individually addressable (the controller reads per-worker and
+    per-tenant series directly) while the family total still sums."""
+    from dynamo_tpu.planner.observer import parse_prometheus
+
+    text = "\n".join(
+        [
+            "# HELP dynamo_queue_depth Queued requests",
+            "# TYPE dynamo_queue_depth gauge",
+            'dynamo_queue_depth{namespace="dynamo",worker_id="7"} 3',
+            'dynamo_queue_depth{namespace="dynamo",worker_id="9"} 5',
+            'dynamo_tenant_shed_total{tenant="acme"} 2',
+            'dynamo_tenant_shed_total{tenant="gumbo"} 4',
+            "dynamo_requests_total 11",
+        ]
+    )
+    t = parse_prometheus(text)
+    # Family totals (labels collapsed) keep the historical diff math.
+    assert t["dynamo_queue_depth"] == 8.0
+    assert t["dynamo_tenant_shed_total"] == 6.0
+    assert t["dynamo_requests_total"] == 11.0
+    # Labeled samples stay addressable exactly as written on the wire.
+    assert t['dynamo_queue_depth{namespace="dynamo",worker_id="7"}'] == 3.0
+    assert t['dynamo_queue_depth{namespace="dynamo",worker_id="9"}'] == 5.0
+    assert t['dynamo_tenant_shed_total{tenant="acme"}'] == 2.0
+
+
+def test_connector_sigterm_drain_and_reap():
+    """ISSUE 14 satellite: scale-down sends SIGTERM (graceful drain),
+    reaps exit codes (no zombies), and only escalates to SIGKILL when a
+    worker overstays the drain window."""
+    import signal
+    import time as _time
+
+    from dynamo_tpu.planner.connector import LocalProcessConnector
+    import asyncio
+
+    async def scenario():
+        # Cooperative child: default SIGTERM disposition -> exits at once.
+        conn = LocalProcessConnector(
+            "unused:0",
+            worker_argv={"w": ["-c", "import time; time.sleep(120)"]},
+            drain_timeout_s=10.0,
+        )
+        try:
+            await conn.set_replicas("w", 2)
+            assert conn.current("w") == 2
+            await conn.set_replicas("w", 1)
+            assert conn.current("w") == 1
+            deadline = _time.monotonic() + 10.0
+            while conn.draining_count() and _time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert conn.draining_count() == 0, "drained child never reaped"
+            assert conn.kills_escalated == 0
+            assert len(conn.exit_codes) == 1
+            _, rc = conn.exit_codes[0]
+            assert rc == -signal.SIGTERM, f"expected SIGTERM exit, got {rc}"
+        finally:
+            conn.shutdown()
+        # Every child's exit code collected by shutdown: zombie-free.
+        assert len(conn.exit_codes) == 2
+
+        # Wedged child: ignores SIGTERM -> escalated to SIGKILL after
+        # the (short) drain window.
+        conn2 = LocalProcessConnector(
+            "unused:0",
+            worker_argv={
+                "w": [
+                    "-c",
+                    "import signal, time; "
+                    "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                    "time.sleep(120)",
+                ]
+            },
+            drain_timeout_s=0.5,
+        )
+        try:
+            await conn2.set_replicas("w", 1)
+            # Let the child install its signal handler before TERMing it.
+            await asyncio.sleep(1.0)
+            await conn2.set_replicas("w", 0)
+            deadline = _time.monotonic() + 15.0
+            while conn2.draining_count() and _time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert conn2.draining_count() == 0, "escalation never landed"
+            assert conn2.kills_escalated == 1
+            assert any(rc == -signal.SIGKILL for _, rc in conn2.exit_codes), (
+                conn2.exit_codes
+            )
+        finally:
+            conn2.shutdown()
+
+    asyncio.run(scenario())
