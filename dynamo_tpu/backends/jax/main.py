@@ -507,8 +507,14 @@ async def run_jax_worker(
         bind_kv_pool_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
+        bind_store_gauges,
     )
 
+    # Control-plane connectivity (ISSUE 15): same store_connected /
+    # outage / keepalive series as the mocker — /health reports degraded
+    # (not unhealthy) while the store is dark and serving continues on
+    # cached discovery state.
+    bind_store_gauges(runtime.status, runtime.store)
     bind_scheduler_gauges(runtime.status, core.scheduler_stats)
     bind_spec_gauges(runtime.status, core.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, core.kv_cache_stats)

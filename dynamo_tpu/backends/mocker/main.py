@@ -198,8 +198,14 @@ async def run_mocker(
         bind_kv_pool_gauges,
         bind_scheduler_gauges,
         bind_spec_gauges,
+        bind_store_gauges,
     )
 
+    # Control-plane connectivity (ISSUE 15): store_connected /
+    # store_outage_seconds / keepalive-failure counters on /metrics, and
+    # /health's control_plane section (degraded, never unhealthy, while
+    # the store is dark — the data plane keeps serving).
+    bind_store_gauges(runtime.status, runtime.store)
     bind_scheduler_gauges(runtime.status, engine.scheduler_stats)
     bind_spec_gauges(runtime.status, engine.spec_decode_stats)
     bind_kv_cache_gauges(runtime.status, engine.kv_cache_stats)

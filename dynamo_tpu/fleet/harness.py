@@ -110,12 +110,19 @@ def mocker_profile(
 class ChaosEvent:
     """A mid-run fault: ``kill`` stops a worker dead (in-flight streams
     migrate), ``partition`` makes every pull touching the worker fail for
-    ``duration_s`` (placements degrade to local recompute), and ``drain``
+    ``duration_s`` (placements degrade to local recompute), ``drain``
     forces a graceful scale-down of the worker at that instant (the
-    chaos-tested kill-during-scale-down scenario composes drain + kill)."""
+    chaos-tested kill-during-scale-down scenario composes drain + kill),
+    and ``store_outage`` blacks out the control plane fleet-wide for
+    ``duration_s`` (ISSUE 15): every store session severs at once, leases
+    expire one TTL in, and what happens next depends on
+    ``FleetSpec.discovery_stale_grace_s`` — degraded mode keeps routing
+    on the cached instance snapshot (data-plane liveness), grace = 0
+    replays the pre-ISSUE-15 collapse (lease-expiry deletes drop every
+    instance and new requests shed)."""
 
     t: float
-    action: str                      # "kill" | "partition" | "drain"
+    action: str            # "kill" | "partition" | "drain" | "store_outage"
     worker: int = -1                 # worker id; -1 = newest draining worker
     duration_s: float = 0.0
 
@@ -167,6 +174,16 @@ class FleetSpec:
     background_rps: dict[int, float] = field(default_factory=dict)
     background_isl: int = 32
     background_osl: int = 6
+    # Control-plane model (ISSUE 15): worker registrations live under
+    # leases of this TTL; a ``store_outage`` chaos event expires them one
+    # TTL in and recovery re-registers every surviving worker within one
+    # further TTL (deterministically staggered, the full-jitter twin).
+    lease_ttl_s: float = 10.0
+    # Degraded-mode knob (the sim twin of DYN_DISCOVERY_STALE_GRACE_S):
+    # > 0 quarantines lease-expiry deletes while the data plane answers —
+    # routing keeps the last-known-good snapshot through the blackout;
+    # 0 honors every delete immediately (the collapse baseline).
+    discovery_stale_grace_s: float = 30.0
     # Keep per-request token streams in the report (the bit-identity
     # audits want them; the big bench fleet turns them off to save RAM).
     keep_streams: bool = True
@@ -298,6 +315,13 @@ class FleetReport:
     pulls_by_source: dict[int, int]
     failed_pulls: int
     streams: dict[str, list[int]] | None
+    # Control-plane blackout audit (ISSUE 15; all zero without a
+    # store_outage event).
+    model_flaps: int = 0             # discovery add/remove transitions
+    blackout_routed: int = 0         # NEW requests placed mid-blackout
+    blackout_shed: int = 0           # NEW requests shed mid-blackout
+    reregister_lag_s: float = 0.0    # slowest post-recovery re-register
+    kv_resyncs: int = 0              # inventory resyncs on session replay
 
     def summary(self) -> dict:
         d = {k: v for k, v in self.__dict__.items() if k != "streams"}
@@ -319,6 +343,15 @@ class FleetHarness:
         self.pulls_by_source: dict[int, int] = {}
         self.recs: dict[str, _Rec] = {}
         self._partitioned: dict[int, float] = {}   # worker id -> until t
+        # Control-plane blackout state (ISSUE 15).
+        self._outage_start: float | None = None
+        self._outage_end: float = 0.0
+        self._outage_workers: set[int] = set()   # leased when it began
+        self._resynced: set[int] = set()
+        self._model_present = True
+        self.model_flaps = 0
+        self.blackout_routed = 0
+        self.blackout_shed = 0
         self._replica_seconds = 0.0
         self._peak = 0
         self._last_acct_t = 0.0
@@ -426,6 +459,63 @@ class FleetHarness:
             out[w.id] = m
         return out
 
+    # -- control-plane blackout model (ISSUE 15) ---------------------------
+
+    def _rereg_delay(self, wid: int) -> float:
+        """Deterministic post-recovery re-register stagger in
+        (0, lease_ttl_s) — the sim twin of the client's full-jitter
+        redial + session replay, always within one TTL."""
+        return self.spec.lease_ttl_s * (
+            0.15 + 0.8 * ((wid * 2654435761 % 97) / 97.0)
+        )
+
+    @property
+    def _store_dark(self) -> bool:
+        return (
+            self._outage_start is not None
+            and self._outage_start <= self.t < self._outage_end
+        )
+
+    def _discovered(self, w: SimWorker, t: float) -> bool:
+        """The router's discovery view of one worker: the twin of
+        EndpointClient under a store blackout. Before lease expiry the
+        cached entry is simply current; after it, degraded mode
+        quarantines the lease-expiry delete while the worker's data
+        plane answers (``not w.dead`` here — the sim's probe), while
+        grace = 0 honors the delete and the worker only reappears when
+        its client's session replay re-registers it after recovery."""
+        if self._outage_start is None or w.id not in self._outage_workers:
+            return True
+        expiry = self._outage_start + self.spec.lease_ttl_s
+        if t < expiry:
+            return True
+        if self.spec.discovery_stale_grace_s > 0:
+            return not w.dead
+        return not w.dead and t >= self._outage_end + self._rereg_delay(w.id)
+
+    def _track_control_plane(self, t: float) -> None:
+        """Advance the discovery timeline to ``t``: count model
+        add/remove flaps (the ModelWatcher twin) and, after recovery,
+        session-replay inventory resyncs as each worker re-registers."""
+        if self._outage_start is None:
+            return
+        live = [w for w in self.workers if not w.dead]
+        present = any(self._discovered(w, t) for w in live) if live else False
+        if present != self._model_present:
+            self.model_flaps += 1
+            self._model_present = present
+        if t >= self._outage_end:
+            for w in live:
+                if (
+                    w.id in self._outage_workers
+                    and w.id not in self._resynced
+                    and t >= self._outage_end + self._rereg_delay(w.id)
+                ):
+                    # The client's reconnect replay re-puts the lease-bound
+                    # registration AND triggers the KV-event anti-entropy
+                    # resync (publisher re-inventories to the fresh store).
+                    self._resynced.add(w.id)
+
     def _fresh_window(self) -> dict:
         return {
             "arrivals": 0,
@@ -458,16 +548,23 @@ class FleetHarness:
         cands = [
             w
             for w in self._live(routable=True)
-            if not exclude or w.id not in exclude
+            if (not exclude or w.id not in exclude)
+            and self._discovered(w, self.t)
         ]
+        in_blackout = self._store_dark and replay_base == 0
         if not cands:
-            # Whole fleet draining/dead: nothing routable. Count as a
-            # typed shed (the frontend would return a retryable 503).
+            # Whole fleet draining/dead/undiscovered: nothing routable.
+            # Count as a typed shed (the frontend would return a
+            # retryable 503).
             rec = self.recs[arr.rid]
             rec.shed = "no_workers"
             rec.done = True
             self._win["sheds"] += 1
+            if in_blackout:
+                self.blackout_shed += 1
             return
+        if in_blackout:
+            self.blackout_routed += 1
         by_id = {w.id: w for w in cands}
         prompt = arr.token_ids
         hashes = compute_seq_hashes(prompt, self.spec.block_size)
@@ -653,11 +750,21 @@ class FleetHarness:
             shed_delta=float(win["sheds"]),
             slo_attainment=att or None,
             live_workers={"backend": len(live)},
+            # Store blackout (ISSUE 15): the event-plane feed is dark, so
+            # the REAL controller's degraded_hold path freezes actuation —
+            # the harness drives the same production code the fleet runs.
+            control_plane_degraded=self._store_dark,
         )
         loop.run_until_complete(self.controller.cycle(obs))
         self._win = self._fresh_window()
 
     def _chaos(self, ev: ChaosEvent) -> None:
+        if ev.action == "store_outage":
+            self._outage_start = self.t
+            self._outage_end = self.t + ev.duration_s
+            self._outage_workers = {w.id for w in self.workers if not w.dead}
+            self._resynced.clear()
+            return
         if ev.action == "partition":
             wid = ev.worker
             self._partitioned[wid] = max(
@@ -796,6 +903,7 @@ class FleetHarness:
                 self._advance(te)
                 self._account(te)
                 self.t = te
+                self._track_control_plane(te)
                 if isinstance(ev, Arrival):
                     self._win["arrivals"] += 1
                     self._win["isl_sum"] += len(ev.token_ids)
@@ -823,6 +931,14 @@ class FleetHarness:
                 self._advance(horizon)
                 self._account(min(horizon, spec.duration_s))
                 self.t = horizon
+                self._track_control_plane(horizon)
+            # Recovery bookkeeping past the last event: a blackout near
+            # the end of the run still records its re-registrations.
+            if self._outage_start is not None:
+                tail = self._outage_end + spec.lease_ttl_s
+                if self.t < tail:
+                    self.t = tail
+                self._track_control_plane(self.t)
         finally:
             loop.close()
         return self._report(arrivals)
@@ -907,6 +1023,20 @@ class FleetHarness:
                 if spec.keep_streams
                 else None
             ),
+            model_flaps=self.model_flaps,
+            blackout_routed=self.blackout_routed,
+            blackout_shed=self.blackout_shed,
+            reregister_lag_s=round(
+                max(
+                    (
+                        self._rereg_delay(w)
+                        for w in self._resynced
+                    ),
+                    default=0.0,
+                ),
+                3,
+            ),
+            kv_resyncs=len(self._resynced),
         )
 
 
@@ -1002,6 +1132,55 @@ def run_fleet_ab(
         "static": static,
         "static_budget_replicas": budget,
     }
+
+
+def run_blackout_ab(
+    duration_s: float = 240.0,
+    blackout_at: float = 90.0,
+    blackout_s: float = 60.0,
+    seed: int = 0,
+    lease_ttl_s: float = 10.0,
+    stale_grace_s: float = 120.0,
+    scale: float = 0.5,
+) -> dict:
+    """The control-plane blackout A/B (ISSUE 15): one diurnal run with a
+    sustained store outage in the middle, three ways —
+
+    - ``no_fault``: the reference timeline (what every stream must match)
+    - ``degraded``: stale-grace quarantine on (the ISSUE 15 path) — the
+      blackout must be INVISIBLE to clients: streams bit-identical to
+      no_fault, new requests route on cached instances, zero model
+      flaps, and on recovery every worker re-registers within one lease
+      TTL with its KV inventory resynced
+    - ``strict``: grace = 0 (the pre-ISSUE-15 collapse) — lease expiry
+      one TTL into the blackout drops every instance and new requests
+      shed until recovery + re-registration, pinning that the degraded
+      path is load-bearing
+
+    The controller runs through its REAL degraded_hold path in the
+    blackout scenarios (the observation window carries
+    ``control_plane_degraded``)."""
+    tenants = default_tenants(scale=scale, deadline_ms=None)
+
+    def spec(chaos: list[ChaosEvent], grace: float) -> FleetSpec:
+        return FleetSpec(
+            tenants=tenants,
+            duration_s=duration_s,
+            seed=seed,
+            planner_on=True,
+            initial_replicas=4,
+            max_replicas=8,
+            lease_ttl_s=lease_ttl_s,
+            discovery_stale_grace_s=grace,
+            chaos=chaos,
+            keep_streams=True,
+        )
+
+    outage = [ChaosEvent(t=blackout_at, action="store_outage", duration_s=blackout_s)]
+    no_fault = FleetHarness(spec([], stale_grace_s)).run()
+    degraded = FleetHarness(spec(list(outage), stale_grace_s)).run()
+    strict = FleetHarness(spec(list(outage), 0.0)).run()
+    return {"no_fault": no_fault, "degraded": degraded, "strict": strict}
 
 
 def run_routing_ab(

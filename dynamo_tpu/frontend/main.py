@@ -44,6 +44,10 @@ async def run_frontend(
         # refuses new LLM requests with a retryable shed error.
         draining_fn=lambda: runtime.draining,
     )
+    # Control-plane outage visibility (ISSUE 15): /health shows degraded
+    # (200, still routable) while the store session is down; store_*
+    # gauges ride this frontend's /metrics.
+    service.bind_store(runtime.store)
     aggregators: dict = {}
     snap_pub = None
     if fleet_obs:

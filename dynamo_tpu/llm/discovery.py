@@ -22,7 +22,7 @@ from typing import Awaitable, Callable
 import msgpack
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
-from dynamo_tpu.runtime.component import Endpoint
+from dynamo_tpu.runtime.component import Endpoint, discovery_stale_grace
 from dynamo_tpu.runtime.store import StoreClient, Subscription
 
 log = logging.getLogger("dynamo_tpu.llm.discovery")
@@ -99,9 +99,22 @@ class ModelWatcher:
     A model is *added* on its first live instance and *removed* when its
     last instance disappears (frontends keep serving while any worker
     remains, parity watcher.rs prune semantics).
+
+    Degraded mode (ISSUE 15): when ``data_plane_live`` is wired (the
+    ModelManager points it at the model's EndpointClient instance cache)
+    and ``stale_grace_s > 0``, a last-instance LEASE-EXPIRY delete whose
+    data plane still answers defers the remove for the grace window — a
+    worker that merely lost its store session re-registers within a TTL
+    of the store's recovery and the frontend never flaps the model.
+    Explicit deregistrations (graceful drain) are never deferred.
     """
 
-    def __init__(self, store: StoreClient):
+    def __init__(
+        self,
+        store: StoreClient,
+        stale_grace_s: float | None = None,
+        data_plane_live: Callable[[str], bool] | None = None,
+    ):
         self._store = store
         self._instances: dict[str, ModelEntry] = {}  # key → entry
         self._counts: dict[str, int] = {}  # model name → live instances
@@ -111,16 +124,44 @@ class ModelWatcher:
         self.on_model_removed: list[Callable[[str], Awaitable[None]]] = []
         self._task: asyncio.Task | None = None
         self._watch: Subscription | None = None
+        # Deferred last-instance removals: model name -> monotonic
+        # deadline. Loop-affine (watch loop + sweep task, one event loop).
+        self.stale_grace_s = (
+            discovery_stale_grace() if stale_grace_s is None else stale_grace_s
+        )
+        self.data_plane_live = data_plane_live
+        self._deferred: dict[str, float] = {}
+        self._defer_task: asyncio.Task | None = None
+        self.deferred_removals_total = 0
+        self.flaps_avoided_total = 0
 
     async def start(self) -> None:
         self._watch = await self._store.kv_watch(MODEL_ROOT + "/")
         self._task = asyncio.create_task(self._loop())
+        self._store.on_reconnect.append(self._reconcile)
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-        if self._watch:
-            await self._watch.unsubscribe()
+        """Idempotent; awaits task cancellation so no watcher coroutine
+        outlives the stop (the pre-ISSUE-15 stop fired cancel and
+        returned, leaving the task to die during teardown)."""
+        try:
+            self._store.on_reconnect.remove(self._reconcile)
+        except ValueError:
+            pass
+        tasks = [t for t in (self._task, self._defer_task) if t is not None]
+        self._task = self._defer_task = None
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                log.exception("model watcher task failed during stop")
+        watch, self._watch = self._watch, None
+        if watch:
+            await watch.unsubscribe()
 
     async def _loop(self) -> None:
         assert self._watch is not None
@@ -128,21 +169,115 @@ class ModelWatcher:
             event = StoreClient.as_watch_event(ev)
             try:
                 if event.type == "put":
-                    entry = ModelEntry.from_wire(event.value)
-                    self._instances[event.key] = entry
-                    self._counts[entry.name] = self._counts.get(entry.name, 0) + 1
-                    if self._counts[entry.name] == 1:
-                        mdc = await ModelDeploymentCard.fetch(self._store, entry.mdc_checksum)
-                        for cb in self.on_model_added:
-                            await cb(entry, mdc)
+                    await self._on_put(event)
                 else:
-                    entry = self._instances.pop(event.key, None)
-                    if entry is None:
-                        continue
-                    self._counts[entry.name] -= 1
-                    if self._counts[entry.name] == 0:
-                        del self._counts[entry.name]
-                        for cb in self.on_model_removed:
-                            await cb(entry.name)
+                    await self._on_delete(event)
             except Exception:  # noqa: BLE001 — a bad entry must not kill the watcher
                 log.exception("model watcher event failed: %s", event.key)
+
+    async def _on_put(self, event) -> None:
+        entry = ModelEntry.from_wire(event.value)
+        known = event.key in self._instances
+        self._instances[event.key] = entry
+        if known:
+            # Session-rebuild replay (or an entry refresh) — counts must
+            # not double, add callbacks must not re-fire.
+            return
+        self._counts[entry.name] = self._counts.get(entry.name, 0) + 1
+        if self._deferred.pop(entry.name, None) is not None:
+            # Re-registered within the grace window: the remove never
+            # fired, so the manager never tore down — zero flap.
+            self.flaps_avoided_total += 1
+            log.info(
+                "model %r re-registered within grace; removal cancelled",
+                entry.name,
+            )
+            return
+        if self._counts[entry.name] == 1:
+            mdc = await ModelDeploymentCard.fetch(self._store, entry.mdc_checksum)
+            for cb in self.on_model_added:
+                await cb(entry, mdc)
+
+    async def _on_delete(self, event) -> None:
+        entry = self._instances.pop(event.key, None)
+        if entry is None:
+            return
+        count = self._counts.get(entry.name, 0)
+        if count <= 0:
+            # Duplicate/late delete racing a removal already processed:
+            # underflowing the count here would make the NEXT put of this
+            # model invisible (0 -> 1 transition never seen again).
+            log.warning(
+                "duplicate delete for model %r (count already %d); skipping",
+                entry.name, count,
+            )
+            self._counts.pop(entry.name, None)
+            return
+        self._counts[entry.name] = count - 1
+        if self._counts[entry.name] > 0:
+            return
+        del self._counts[entry.name]
+        if (
+            event.reason == "lease"
+            and self.stale_grace_s > 0
+            and self.data_plane_live is not None
+            and self.data_plane_live(entry.name)
+        ):
+            self._deferred[entry.name] = (
+                asyncio.get_running_loop().time() + self.stale_grace_s
+            )
+            self.deferred_removals_total += 1
+            log.warning(
+                "model %r lost its last lease but its data plane answers; "
+                "deferring removal %.1fs", entry.name, self.stale_grace_s,
+            )
+            self._ensure_defer_sweep()
+            return
+        await self._fire_removed(entry.name)
+
+    async def _fire_removed(self, name: str) -> None:
+        for cb in self.on_model_removed:
+            await cb(name)
+
+    def _ensure_defer_sweep(self) -> None:
+        if self._defer_task is None or self._defer_task.done():
+            self._defer_task = asyncio.create_task(self._sweep_deferred())
+
+    async def _sweep_deferred(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._deferred:
+            due = min(self._deferred.values())
+            await asyncio.sleep(max(0.05, due - loop.time()))
+            now = loop.time()
+            for name, deadline in list(self._deferred.items()):
+                if deadline > now:
+                    continue
+                self._deferred.pop(name, None)
+                if name in self._counts:
+                    continue  # an instance came back through a fresh key
+                if self.data_plane_live is not None and self.data_plane_live(name):
+                    # Still answering on the data plane: keep deferring —
+                    # during an outage the data plane IS the authority.
+                    self._deferred[name] = now + self.stale_grace_s
+                    continue
+                log.warning(
+                    "deferred removal of model %r firing (grace expired, "
+                    "data plane dark)", name,
+                )
+                try:
+                    await self._fire_removed(name)
+                except Exception:  # noqa: BLE001 — one bad callback must not kill the sweep
+                    log.exception("deferred model removal failed: %s", name)
+
+    async def _reconcile(self) -> None:
+        """Post-reconnect anti-entropy: keys that vanished during the
+        outage produced no delete event (the session replay only re-puts
+        current state) — synthesize lease-reason deletes for them so the
+        same degraded-mode judgment applies."""
+        listed = await self._store.kv_get_prefix(MODEL_ROOT + "/")
+        for key in [k for k in self._instances if k not in listed]:
+            await self._on_delete(
+                StoreClient.as_watch_event(
+                    {"t": "delete", "k": key, "v": b"", "rev": 0, "r": "lease"}
+                )
+            )

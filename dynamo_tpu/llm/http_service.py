@@ -49,6 +49,7 @@ from dynamo_tpu.runtime.component import NoInstancesError
 from dynamo_tpu.runtime.engine import DeadlineExceededError
 from dynamo_tpu.runtime.logging_setup import TRACEPARENT_HEADER, child_traceparent
 from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.status_server import _bind_store_gauges, control_plane_section
 
 log = logging.getLogger("dynamo_tpu.http")
 
@@ -112,6 +113,11 @@ class HttpService:
         # when an aggregator is attached (obs/service.attach_aggregator).
         self.before_metrics: list = []
         self.fleet_fn = None
+        # Control-plane connectivity (ISSUE 15): when a store client is
+        # bound (bind_store), /health reports degraded (200) while the
+        # store is dark — cached models keep serving — and the store_*
+        # gauges export on this frontend's /metrics.
+        self.store = None
         # Client-supplied request ids currently in flight (duplicates get
         # a fresh mint; see _request_id).
         self._inflight_rids: set[str] = set()
@@ -299,6 +305,12 @@ class HttpService:
 
     # -- handlers ----------------------------------------------------------
 
+    def bind_store(self, store) -> None:
+        """Wire the control-plane client into /health + /metrics (the
+        frontend twin of status_server.bind_store_gauges)."""
+        self.store = store
+        _bind_store_gauges(self.metrics, self.before_metrics, store)
+
     async def health(self, request: web.Request) -> web.Response:
         models = [s.entry.name for s in self.manager.list_models()]
         if self._draining_fn():
@@ -308,7 +320,19 @@ class HttpService:
             return web.json_response(
                 {"status": "draining", "models": models}, status=503
             )
-        return web.json_response({"status": "healthy" if models else "starting", "models": models})
+        payload: dict = {
+            "status": "healthy" if models else "starting", "models": models
+        }
+        if self.store is not None:
+            payload["control_plane"], connected = control_plane_section(
+                self.store
+            )
+            if models and not connected:
+                # Degraded-mode serving (ISSUE 15): discovery is a cached
+                # snapshot but requests still route — stay 200 so load
+                # balancers keep sending traffic a blackout can't break.
+                payload["status"] = "degraded"
+        return web.json_response(payload)
 
     async def live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})

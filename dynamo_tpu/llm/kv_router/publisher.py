@@ -373,6 +373,16 @@ class MetricsAggregator:
         self._sub = None
         self.on_update: list[Callable[[ForwardPassMetrics], None]] = []
 
+    @property
+    def degraded(self) -> bool:
+        """True while the control-plane session is down (ISSUE 15):
+        ``latest`` is a last-known-good snapshot, not a live feed —
+        busy-set and routing consumers keep it rather than treating
+        fleet-wide silence on the metrics subject as death."""
+        # getattr twice over: tests build partial aggregators via __new__.
+        store = getattr(self, "_store", None)
+        return not getattr(store, "connected", True)
+
     async def start(self) -> None:
         self._sub = await self._store.subscribe(self._subject)
         self._task = asyncio.create_task(self._loop())

@@ -69,10 +69,21 @@ class ModelManager:
         self.router_mode = router_mode
         self.router_config = router_config
         self.models: dict[str, ServedModel] = {}
-        self.watcher = ModelWatcher(runtime.store)
+        # Degraded-mode wiring (ISSUE 15): a last-instance lease expiry
+        # only defers the model teardown while the model's endpoint
+        # client still holds routable instances — quarantine keeps those
+        # cached exactly when the DATA plane answered, so "the router can
+        # still place requests" is the liveness judgment here.
+        self.watcher = ModelWatcher(
+            runtime.store, data_plane_live=self._data_plane_live
+        )
         self.watcher.on_model_added.append(self._on_added)
         self.watcher.on_model_removed.append(self._on_removed)
         self._model_event = asyncio.Event()
+
+    def _data_plane_live(self, name: str) -> bool:
+        served = self.models.get(name)
+        return bool(served is not None and served.client.instances)
 
     async def start(self) -> None:
         await self.watcher.start()

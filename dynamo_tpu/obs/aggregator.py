@@ -97,6 +97,13 @@ class FleetAggregator:
         # observation() diff state.
         self._prev_totals: dict[str, float] | None = None
         self._prev_t: float = 0.0
+        # Control-plane-dark bookkeeping (ISSUE 15): while the store
+        # session is down, NOBODY can publish — snapshot silence is an
+        # outage symptom, not worker death, so staleness retirement is
+        # suspended; after reconnection every publisher gets one fresh
+        # ``stale_after_s`` window to re-appear before retirement resumes.
+        self._was_dark = False
+        self._dark_grace_until = 0.0
         # Last-seen cumulative typed-shed counter per worker: sheds are
         # diffed per worker (retirement-aware), never on the fleet total.
         self._prev_sheds: dict[int, float] = {}
@@ -198,10 +205,30 @@ class FleetAggregator:
             for _key, (name, _doc) in table.items():
                 scoped.remove_gauge(name)
 
+    @property
+    def control_plane_dark(self) -> bool:
+        """True while this process's store session is down: the event
+        plane cannot deliver snapshots, so the fleet view is a frozen
+        last-known-good, and "publisher went quiet" means nothing."""
+        return not getattr(self._store, "connected", True)
+
     def sweep_stale(self, now: float | None = None) -> list[int]:
         """Retire workers that stopped publishing (the chaos-kill /
-        dead-process backstop when no watch event reached us)."""
+        dead-process backstop when no watch event reached us).
+
+        Suspended while the control plane is dark — a blackout silences
+        every publisher at once, and retiring the whole healthy fleet on
+        that is the flap ISSUE 15 quarantines. After reconnection the
+        fleet gets one fresh ``stale_after_s`` window to republish."""
         now = time.time() if now is None else now
+        if self.control_plane_dark:
+            self._was_dark = True
+            return []
+        if self._was_dark:
+            self._was_dark = False
+            self._dark_grace_until = now + self.stale_after_s
+        if now < self._dark_grace_until:
+            return []
         stale = [
             w
             for w, s in list(self.latest.items()) + list(self.frontends.items())
@@ -287,6 +314,12 @@ class FleetAggregator:
             "Workers whose series were retired (drain / lease loss / "
             "staleness) since start",
         ).set(float(self.workers_retired_total))
+        agg.gauge(
+            "obs_control_plane_dark",
+            "1 while the aggregator's store session is down (snapshot "
+            "silence is the outage, not worker death; staleness "
+            "retirement is suspended)",
+        ).set(1.0 if self.control_plane_dark else 0.0)
 
     def _sync_tenants(self) -> None:
         """Fleet per-tenant queue gauges, cardinality-capped: at most
@@ -400,6 +433,12 @@ class FleetAggregator:
         from dynamo_tpu.planner.planner_core import Observation
 
         self.sweep_stale()
+        # Blind window (ISSUE 15): assembled while the store session was
+        # down (or just after — the re-publish grace), so rates/queues in
+        # it are phantom zeros. The controller holds on this flag.
+        degraded = self.control_plane_dark or (
+            self._was_dark or time.time() < self._dark_grace_until
+        )
         now = time.monotonic()
         cur = self._totals()
         # Typed sheds: per-worker cumulative counters diffed per worker.
@@ -423,6 +462,7 @@ class FleetAggregator:
                 request_rate=0.0,
                 mean_isl=self._last_means[0],
                 mean_osl=self._last_means[1],
+                control_plane_degraded=degraded,
             )
         window = max(now - prev_t, 1e-6)
 
@@ -479,6 +519,7 @@ class FleetAggregator:
             shed_delta=shed_delta,
             slo_attainment=attainment or None,
             live_workers=live or None,
+            control_plane_degraded=degraded,
         )
 
     # -- /fleet payload ----------------------------------------------------

@@ -68,6 +68,10 @@ ACTIONS = (
     "hold",
     "cooldown_hold",
     "hysteresis_hold",
+    # Control plane dark (ISSUE 15): the observation window is blind, so
+    # the controller neither scales nor re-actuates — targets freeze at
+    # last-known-good until the bus returns.
+    "degraded_hold",
 )
 
 # How a pool maps onto the Plan's replica counts. "max" serves aggregated
@@ -170,6 +174,23 @@ class PlannerController:
         now = self.clock()
         self.cycles += 1
         self.last_observation = obs
+        if obs.control_plane_degraded:
+            # Hold EVERYTHING on a blind window: no plan math (the
+            # predictor must not ingest phantom-zero rates), no decision
+            # movement, no actuation (the connector likely can't reach
+            # its substrate mid-outage anyway; the standing targets are
+            # re-asserted on the first healthy cycle). Hysteresis streaks
+            # freeze too — an outage must not count toward a scale-down.
+            actions = {}
+            for pool in self.pools.values():
+                actions[pool.component] = self._note(
+                    pool, "degraded_hold", "control plane dark"
+                )
+                self.decisions["degraded_hold"] += 1
+            log.warning(
+                "planner cycle %d held: control plane dark", self.cycles
+            )
+            return actions
         with self._tracer.span(
             "planner_cycle",
             attrs={

@@ -88,6 +88,13 @@ class Observation:
     shed_delta: float = 0.0
     slo_attainment: dict[str, float] | None = None
     live_workers: dict[str, int] | None = None
+    # Control-plane outage flag (ISSUE 15): True when the observation was
+    # assembled while the store session was down (or the whole event
+    # plane went silent at once). The controller HOLDS actuation on such
+    # windows — a dark bus reads as "zero arrivals, empty queues", and
+    # scaling down a healthy serving fleet on that phantom trough is
+    # exactly the flap degraded mode exists to prevent.
+    control_plane_degraded: bool = False
 
 
 @dataclass

@@ -70,6 +70,7 @@ import json
 import logging
 import os
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
@@ -116,9 +117,16 @@ class ChaosRule:
     count: int | None = None
     delay_s: float = 0.05
     stall_s: float = 3600.0
+    # Sustained-fault window: when > 0 the rule only fires within
+    # ``window_s`` seconds of its FIRST eligible hit (measured on the
+    # plan's injectable clock) and goes permanently quiet after — the
+    # shape a control-plane blackout needs (sever everything for 60 s,
+    # then let recovery proceed). 0 = no window (count/p gate instead).
+    window_s: float = 0.0
     # Bookkeeping (not config).
     hits: int = 0
     fires: int = 0
+    first_hit_t: float | None = None
 
     def __post_init__(self) -> None:
         if self.point not in POINTS:
@@ -143,11 +151,16 @@ class ChaosPlan:
         rules: list[ChaosRule] | None = None,
         seed: int = 0,
         sleep: Callable[[float], Awaitable[None]] | None = None,
+        clock: Callable[[], float] | None = None,
     ):
         self.rules = list(rules or [])
         self.seed = seed
         self.rng = random.Random(seed)
         self.sleep = sleep or asyncio.sleep
+        # Window gating (``ChaosRule.window_s``) reads this clock;
+        # injectable so virtual-clock fleets scale sustained faults with
+        # the same knob as delays.
+        self.clock = clock or time.monotonic
         # (point, action, target) per fire, in order — the deterministic
         # record tests and operators compare runs with.
         self.fired: list[tuple[str, str, str]] = []
@@ -189,6 +202,37 @@ class ChaosPlan:
         return cls(rules=rules, seed=seed)
 
     @classmethod
+    def store_outage(
+        cls,
+        duration_s: float = 60.0,
+        after_frames: int = 0,
+        seed: int = 0,
+    ) -> "ChaosPlan":
+        """The canonical control-plane blackout (ISSUE 15): sever EVERY
+        store session sustainedly for ``duration_s``. The ``store.frame``
+        rule kills each live session the moment its next inbound frame
+        arrives (keepalive replies flow at ttl/3, so sessions die within
+        a beat); the ``store.connect`` rule keeps every redial failing
+        until the window passes — then reconnection and session replay
+        proceed untouched. ``after_frames`` lets traffic start cleanly
+        before the blackout lands. The window clocks from each rule's
+        first eligible hit, so arm the plan right before the blackout
+        should begin."""
+        return cls(
+            rules=[
+                ChaosRule(
+                    point="store.frame", action="sever",
+                    after=after_frames, window_s=duration_s,
+                ),
+                ChaosRule(
+                    point="store.connect", action="sever",
+                    window_s=duration_s,
+                ),
+            ],
+            seed=seed,
+        )
+
+    @classmethod
     def from_env(cls, env: str = CHAOS_PLAN_ENV) -> "ChaosPlan | None":
         """Build a plan from ``$DYN_CHAOS_PLAN`` (inline JSON, or
         ``@/path/to/plan.json``); None when unset/empty."""
@@ -213,6 +257,12 @@ class ChaosPlan:
             rule.hits += 1
             if rule.hits <= rule.after:
                 continue
+            if rule.window_s > 0.0:
+                now = self.clock()
+                if rule.first_hit_t is None:
+                    rule.first_hit_t = now
+                if now - rule.first_hit_t > rule.window_s:
+                    continue  # the sustained-fault window has passed
             if rule.count is not None and rule.fires >= rule.count:
                 continue
             if rule.p < 1.0 and self.rng.random() >= rule.p:

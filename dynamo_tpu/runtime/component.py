@@ -21,6 +21,7 @@ import asyncio
 import logging
 import os
 import random as _random
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable
 
@@ -35,6 +36,31 @@ log = logging.getLogger("dynamo_tpu.runtime")
 
 INSTANCE_ROOT = "/dynamo/instances"
 DEFAULT_STORE_ADDRESS = os.environ.get("DYN_STORE_ADDRESS", "127.0.0.1:6650")
+
+# Degraded-mode discovery (ISSUE 15): how long a consumer may keep
+# serving on a cached instance whose lease the control plane declared
+# dead, while the DATA PLANE says the instance is alive (breaker closed /
+# pooled conn / direct probe). 0 disables degraded mode: every
+# lease-expiry delete is honored immediately (the pre-ISSUE-15 behavior,
+# where a store blackout collapses routing a TTL later).
+DISCOVERY_STALE_GRACE_ENV = "DYN_DISCOVERY_STALE_GRACE_S"
+DEFAULT_DISCOVERY_STALE_GRACE_S = 30.0
+# One quarantine liveness probe's dial budget.
+DISCOVERY_PROBE_TIMEOUT_S = 1.0
+# First re-judgment delay for a lease-expiry delete the egress pool has
+# no opinion on: the instance stays provisionally routable for this long
+# and the quarantine sweep's off-loop probe decides — the watch loop
+# itself never dials, so a mass lease expiry cannot stall discovery
+# event processing behind serialized probe timeouts.
+DISCOVERY_PROBE_SOON_S = 0.2
+
+
+def discovery_stale_grace() -> float:
+    raw = os.environ.get(DISCOVERY_STALE_GRACE_ENV)
+    try:
+        return float(raw) if raw is not None else DEFAULT_DISCOVERY_STALE_GRACE_S
+    except ValueError:
+        return DEFAULT_DISCOVERY_STALE_GRACE_S
 
 
 @dataclass(frozen=True)
@@ -305,7 +331,7 @@ class EndpointClient:
     ``round_robin`` | ``random`` | ``direct(instance_id)``.
     """
 
-    def __init__(self, endpoint: Endpoint):
+    def __init__(self, endpoint: Endpoint, stale_grace_s: float | None = None):
         self.endpoint = endpoint
         self.runtime = endpoint.runtime
         self.instances: dict[int, Instance] = {}
@@ -315,16 +341,54 @@ class EndpointClient:
         self._instances_changed = asyncio.Event()
         self.on_instance_added: list[Callable[[Instance], None]] = []
         self.on_instance_removed: list[Callable[[int], None]] = []
+        # Degraded-mode state (ISSUE 15): lease-expiry deletes for
+        # instances the data plane still reaches are QUARANTINED (kept
+        # routable, probe-rechecked) instead of dropped — the instance
+        # snapshot above is last-known-good through a store blackout.
+        # Loop-affine: mutated only by the watch loop, the quarantine
+        # sweep, and the reconnect reconcile, all on one event loop.
+        self.stale_grace_s = (
+            discovery_stale_grace() if stale_grace_s is None else stale_grace_s
+        )
+        self.probe_timeout_s = DISCOVERY_PROBE_TIMEOUT_S
+        self._quarantine: dict[int, float] = {}  # id -> monotonic deadline
+        self._quarantine_task: asyncio.Task | None = None
+        self.quarantined_total = 0
+        self.quarantine_recovered_total = 0  # re-registered within grace
+        self.quarantine_expired_total = 0    # probe failed; delete applied
 
     async def start(self) -> None:
         self._watch = await self.runtime.store.kv_watch(self.endpoint.instance_prefix)
         self._watch_task = asyncio.create_task(self._watch_loop())
+        # After a store-session rebuild the watch replays current state
+        # as puts, but keys that vanished DURING the outage produce no
+        # delete — reconcile against the authoritative listing, routing
+        # the misses through the same quarantine judgment.
+        self.runtime.store.on_reconnect.append(self._reconcile)
 
     async def stop(self) -> None:
-        if self._watch_task:
-            self._watch_task.cancel()
-        if self._watch:
-            await self._watch.unsubscribe()
+        """Idempotent; awaits task cancellation (same contract as
+        ModelWatcher.stop) so no watcher/sweep coroutine outlives it."""
+        try:
+            self.runtime.store.on_reconnect.remove(self._reconcile)
+        except ValueError:
+            pass
+        tasks = [
+            t for t in (self._watch_task, self._quarantine_task) if t is not None
+        ]
+        self._watch_task = self._quarantine_task = None
+        for task in tasks:
+            task.cancel()
+        for task in tasks:
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                log.exception("endpoint client task failed during stop")
+        watch, self._watch = self._watch, None
+        if watch:
+            await watch.unsubscribe()
 
     async def _watch_loop(self) -> None:
         assert self._watch is not None
@@ -333,18 +397,175 @@ class EndpointClient:
             instance_id = int(event.key.rsplit("/", 1)[-1], 16)
             if event.type == "put":
                 inst = Instance.from_wire(event.value)
+                known = instance_id in self.instances
                 self.instances[instance_id] = inst
-                for cb in self.on_instance_added:
-                    cb(inst)
-            else:
-                if self.instances.pop(instance_id, None) is not None:
+                if self._quarantine.pop(instance_id, None) is not None:
+                    self.quarantine_recovered_total += 1
                     log.info(
-                        "instance %d removed from %s", instance_id, self.endpoint.path
+                        "instance %d re-registered within grace on %s",
+                        instance_id, self.endpoint.path,
                     )
-                for cb in self.on_instance_removed:
-                    cb(instance_id)
+                if not known:
+                    # Replay puts for already-known instances (session
+                    # rebuild) must not re-fire add callbacks.
+                    for cb in self.on_instance_added:
+                        cb(inst)
+            else:
+                inst = self.instances.get(instance_id)
+                if inst is None:
+                    continue  # duplicate delete — nothing to retire
+                if event.reason == "lease" and self.stale_grace_s > 0:
+                    # Synchronous judgment only — the watch loop must
+                    # never dial (a mass lease expiry would serialize
+                    # probe timeouts ahead of replacement-worker puts).
+                    judged = self._judge_sync(inst)
+                    if judged is not False:
+                        # Known-alive: full grace. Unknown: provisional
+                        # quarantine; the sweep's off-loop probe decides
+                        # within DISCOVERY_PROBE_SOON_S.
+                        delay = (
+                            self.stale_grace_s
+                            if judged
+                            else DISCOVERY_PROBE_SOON_S
+                        )
+                        self._quarantine[instance_id] = time.monotonic() + delay
+                        self.quarantined_total += 1
+                        log.warning(
+                            "instance %d lease-expired on %s; quarantining "
+                            "(%s) instead of dropping",
+                            instance_id, self.endpoint.path,
+                            "data plane alive" if judged else "probe pending",
+                        )
+                        self._ensure_quarantine_sweep()
+                        continue
+                self._remove_instance(instance_id)
             self._instances_changed.set()
             self._instances_changed = asyncio.Event()
+
+    def _remove_instance(self, instance_id: int) -> None:
+        if self.instances.pop(instance_id, None) is not None:
+            log.info(
+                "instance %d removed from %s", instance_id, self.endpoint.path
+            )
+            for cb in self.on_instance_removed:
+                cb(instance_id)
+        self._quarantine.pop(instance_id, None)
+        self._instances_changed.set()
+        self._instances_changed = asyncio.Event()
+
+    def _judge_sync(self, inst: Instance) -> bool | None:
+        """The egress pool's opinion of an address, without dialing:
+        open breaker → False (dead), pooled live connection → True
+        (alive), no opinion → None (a probe must decide)."""
+        st = self.runtime.egress.stats().get(inst.address)
+        if st is not None:
+            if st["state"] == "open":
+                return False
+            if st["connected"]:
+                return True
+        return None
+
+    async def _should_quarantine(self, inst: Instance) -> bool:
+        """Degraded-mode judgment: the control plane said this lease
+        died, but lease expiry during a store outage (or a worker↔store
+        partition) says nothing about the WORKER. Believe the data plane:
+        open breaker → dead; pooled live connection → alive; otherwise
+        one cheap direct dial decides."""
+        if self.stale_grace_s <= 0:
+            return False
+        judged = self._judge_sync(inst)
+        if judged is not None:
+            return judged
+        return await self._probe(inst.address)
+
+    async def _probe(self, address: str) -> bool:
+        host, _, port = address.rpartition(":")
+        try:
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(host or "127.0.0.1", int(port)),
+                self.probe_timeout_s,
+            )
+        except (OSError, asyncio.TimeoutError, ValueError):
+            return False
+        w.close()
+        return True
+
+    def _ensure_quarantine_sweep(self) -> None:
+        if self._quarantine_task is None or self._quarantine_task.done():
+            self._quarantine_task = asyncio.create_task(self._sweep_quarantine())
+
+    async def _sweep_quarantine(self) -> None:
+        """Re-judge quarantined instances at their grace deadlines: a
+        data plane that still answers extends the quarantine (liveness is
+        the data plane's call during an outage); one that stopped
+        answering applies the original delete."""
+        while self._quarantine:
+            now = time.monotonic()
+            due = min(self._quarantine.values())
+            # Sleep in DISCOVERY_PROBE_SOON_S-bounded slices: a
+            # provisional (probe-pending) entry added mid-sleep must be
+            # judged at ITS deadline, not after the earliest pre-existing
+            # grace deadline — an uncapped sleep would keep a dead
+            # address routable for up to a full grace window.
+            await asyncio.sleep(
+                max(0.05, min(due - now, DISCOVERY_PROBE_SOON_S))
+            )
+            now = time.monotonic()
+            for iid, deadline in list(self._quarantine.items()):
+                if deadline > now:
+                    continue
+                inst = self.instances.get(iid)
+                if inst is None:
+                    self._quarantine.pop(iid, None)
+                    continue
+                # Full judgment, breaker state included: a hung worker
+                # whose socket still accepts dials has an OPEN breaker —
+                # the raw probe alone would re-quarantine it forever.
+                if await self._should_quarantine(inst):
+                    self._quarantine[iid] = now + self.stale_grace_s
+                else:
+                    self.quarantine_expired_total += 1
+                    log.warning(
+                        "quarantined instance %d on %s stopped answering; "
+                        "applying the deferred delete",
+                        iid, self.endpoint.path,
+                    )
+                    self._remove_instance(iid)
+
+    async def _reconcile(self) -> None:
+        listed = await self.runtime.store.kv_get_prefix(
+            self.endpoint.instance_prefix
+        )
+        live_ids = {
+            int(k.rsplit("/", 1)[-1], 16) for k in listed
+        }
+        for iid in [i for i in self.instances if i not in live_ids]:
+            # .get: a concurrent quarantine-sweep removal between the
+            # awaits here must skip the id, not KeyError out of the
+            # whole reconcile (stale keys would then stay routable
+            # forever — no real delete event is ever coming for them).
+            inst = self.instances.get(iid)
+            if inst is None:
+                continue
+            if await self._should_quarantine(inst):
+                if iid not in self._quarantine:
+                    self._quarantine[iid] = time.monotonic() + self.stale_grace_s
+                    self.quarantined_total += 1
+                self._ensure_quarantine_sweep()
+            else:
+                self._remove_instance(iid)
+
+    def degraded_stats(self) -> dict:
+        """Quarantine counters + store connectivity for /metrics and
+        /health export."""
+        return {
+            "cached_instances": len(self.instances),
+            "quarantined": len(self._quarantine),
+            "quarantined_total": self.quarantined_total,
+            "quarantine_recovered_total": self.quarantine_recovered_total,
+            "quarantine_expired_total": self.quarantine_expired_total,
+            "store_connected": getattr(self.runtime.store, "connected", True),
+        }
 
     def instance_ids(self) -> list[int]:
         return sorted(self.instances)

@@ -470,6 +470,78 @@ def bind_egress_gauges(status: "SystemStatusServer | None", egress) -> None:
     status.before_render.append(sync)
 
 
+# Control-plane connectivity gauges (ISSUE 15): the store client's
+# connection-state surface, exported on every process's /metrics (both
+# backends via their mains, the frontend via _bind_store_gauges on its
+# own registry). Keys match StoreClient.stats().
+STORE_GAUGES: dict[str, tuple[str, str]] = {
+    "connected": (
+        "store_connected",
+        "1 while a live control-plane store session exists; 0 means this "
+        "process is serving in degraded mode on cached discovery state",
+    ),
+    "outage_seconds": (
+        "store_outage_seconds",
+        "Cumulative seconds without a store session since start, the "
+        "current outage included",
+    ),
+    "disconnected_for_s": (
+        "store_disconnected_seconds",
+        "Seconds since the current outage began (0 while connected)",
+    ),
+    "keepalive_failures": (
+        "store_keepalive_failures_total",
+        "Lease-keepalive beats that failed transiently (the loop "
+        "survives them and re-attaches expired leases; a rising counter "
+        "with store_connected=1 means keepalives are being lost)",
+    ),
+    "reconnects": (
+        "store_session_rebuilds_total",
+        "Store sessions rebuilt after an outage (leases re-granted, "
+        "lease-bound KV replayed, watches and subscriptions resumed)",
+    ),
+}
+
+
+def _bind_store_gauges(metrics: MetricsRegistry, hooks: list, store) -> None:
+    """Registry-level binder (the HTTP frontend reuses it on its own
+    metrics registry + before_metrics hooks)."""
+    scoped = metrics.scoped(service="store")
+
+    def sync() -> None:
+        st = store.stats()
+        for key, (name, doc) in STORE_GAUGES.items():
+            scoped.gauge(name, doc).set(float(st.get(key, 0) or 0))
+
+    hooks.append(sync)
+
+
+def control_plane_section(store) -> tuple[dict, bool]:
+    """The /health ``control_plane`` payload + connected flag, shared by
+    the worker status server and the HTTP frontend so the two health
+    surfaces can never diverge."""
+    st = store.stats()
+    connected = bool(st.get("connected"))
+    return (
+        {
+            "connected": connected,
+            "outage_seconds": round(float(st.get("outage_seconds", 0.0)), 3),
+            "session_rebuilds": int(st.get("reconnects", 0)),
+        },
+        connected,
+    )
+
+
+def bind_store_gauges(status: "SystemStatusServer | None", store) -> None:
+    """Export the process's control-plane connection state on /metrics
+    and surface it in /health's ``control_plane`` section. No-op when the
+    status server is disabled."""
+    if status is None:
+        return
+    status.store = store
+    _bind_store_gauges(status.metrics, status.before_render, store)
+
+
 class SystemStatusServer:
     def __init__(
         self,
@@ -488,6 +560,9 @@ class SystemStatusServer:
         self.before_render: list[Callable[[], None]] = []
         # endpoint path -> "ready" | "notready"
         self.endpoint_health: dict[str, str] = {}
+        # Store client whose connectivity /health reports (wired by
+        # bind_store_gauges); None = no control-plane section.
+        self.store = None
         self.app = web.Application()
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
@@ -521,13 +596,24 @@ class SystemStatusServer:
     async def health(self, request: web.Request) -> web.Response:
         ready = all(s == "ready" for s in self.endpoint_health.values())
         status = "healthy" if ready and self.endpoint_health else "starting"
+        payload = {
+            "status": status,
+            "uptime_s": round(self.uptime_s, 3),
+            "endpoints": dict(self.endpoint_health),
+        }
+        if self.store is not None:
+            payload["control_plane"], connected = control_plane_section(
+                self.store
+            )
+            if status == "healthy" and not connected:
+                # Degraded, NOT unhealthy: the data plane still serves
+                # (that is the whole point of ISSUE 15) — stay 200 so
+                # orchestrators don't kill a working worker over a store
+                # blackout, but make the state visible.
+                payload["status"] = status = "degraded"
         return web.json_response(
-            {
-                "status": status,
-                "uptime_s": round(self.uptime_s, 3),
-                "endpoints": dict(self.endpoint_health),
-            },
-            status=200 if status == "healthy" else 503,
+            payload,
+            status=200 if status in ("healthy", "degraded") else 503,
         )
 
     async def live(self, request: web.Request) -> web.Response:

@@ -12,6 +12,7 @@ import asyncio
 import itertools
 import logging
 import random
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
@@ -50,6 +51,10 @@ class WatchEvent:
     key: str
     value: bytes
     revision: int
+    # Delete provenance: "del" (explicit retraction) | "lease" (expiry /
+    # conn-death revoke — the liveness judgment degraded-mode consumers
+    # may second-guess against the data plane). "" on puts.
+    reason: str = ""
 
 
 @dataclass(frozen=True)
@@ -118,6 +123,12 @@ class StoreClient:
         self._ephemeral_leases: dict[int, float] = {}
         self.on_reconnect: list = []  # async callbacks, fired after replay
         self._reconnect_task: asyncio.Task | None = None
+        # Connection-state surface (ISSUE 15): consumers judge degraded
+        # mode off `connected`, operators off the exported counters.
+        self._disconnected_since: float | None = None
+        self.outage_seconds_total = 0.0
+        self.keepalive_failures_total = 0
+        self.reconnects_total = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -135,6 +146,41 @@ class StoreClient:
     @classmethod
     async def open(cls, address: str) -> "StoreClient":
         return await cls(address).connect()
+
+    @property
+    def connected(self) -> bool:
+        """True while a live session to the store exists. False means the
+        control plane is dark for this process: consumers should treat
+        discovery state as a last-known-good snapshot, not authority."""
+        return self._writer is not None and not self._closed
+
+    @property
+    def disconnected_since(self) -> float | None:
+        """``time.monotonic()`` of the current outage's start, or None."""
+        return self._disconnected_since
+
+    def outage_seconds(self) -> float:
+        """Cumulative seconds without a store session, current outage
+        included (the `store_outage_seconds` gauge)."""
+        total = self.outage_seconds_total
+        if self._disconnected_since is not None:
+            total += time.monotonic() - self._disconnected_since
+        return total
+
+    def stats(self) -> dict:
+        """Connection-state payload for /metrics + /health export."""
+        now = time.monotonic()
+        return {
+            "connected": self.connected,
+            "outage_seconds": self.outage_seconds(),
+            "disconnected_for_s": (
+                now - self._disconnected_since
+                if self._disconnected_since is not None
+                else 0.0
+            ),
+            "keepalive_failures": self.keepalive_failures_total,
+            "reconnects": self.reconnects_total,
+        }
 
     async def close(self) -> None:
         if self._closed:
@@ -201,6 +247,8 @@ class StoreClient:
                 # Subscriptions stay open; their queues resume after the
                 # session is rebuilt.
                 self._writer = None
+                if self._disconnected_since is None:
+                    self._disconnected_since = time.monotonic()
                 self._reconnect_task = asyncio.create_task(self._reconnect_loop())
 
     async def _reconnect_loop(self) -> None:
@@ -209,9 +257,6 @@ class StoreClient:
         registrations, re-establish subscriptions and watches (the old
         Subscription objects keep their queues — consumers just see a
         gap), then fire ``on_reconnect`` callbacks."""
-        import logging
-
-        log = logging.getLogger("dynamo_tpu.store.client")
         if self._writer is not None:
             return  # session already live (duplicate schedule)
         attempt = 0
@@ -272,6 +317,12 @@ class StoreClient:
                     # entry instead of refailing the whole rebuild forever.
                     log.warning("dropping leased key %r (lease %d gone)", key, lease)
                     self._leased_kv.pop(key, None)
+            self.reconnects_total += 1
+            if self._disconnected_since is not None:
+                self.outage_seconds_total += (
+                    time.monotonic() - self._disconnected_since
+                )
+                self._disconnected_since = None
             log.info(
                 "store session rebuilt (%d leases, %d registrations, %d subs)",
                 len(self._lease_meta), len(self._leased_kv), len(self._sub_meta),
@@ -341,7 +392,10 @@ class StoreClient:
 
     @staticmethod
     def as_watch_event(ev: dict) -> WatchEvent:
-        return WatchEvent(type=ev["t"], key=ev["k"], value=ev["v"], revision=ev["rev"])
+        return WatchEvent(
+            type=ev["t"], key=ev["k"], value=ev["v"], revision=ev["rev"],
+            reason=ev.get("r", "del" if ev["t"] == "delete" else ""),
+        )
 
     # -- leases ------------------------------------------------------------
 
@@ -358,9 +412,7 @@ class StoreClient:
                 self._keepalive_loop(lease_id, ttl)
             )
         else:
-            import time as _time
-
-            now = _time.monotonic()
+            now = time.monotonic()
             self._ephemeral_leases = {
                 lid: exp for lid, exp in self._ephemeral_leases.items() if exp > now
             }
@@ -368,11 +420,44 @@ class StoreClient:
         return lease_id
 
     async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        """Keep one lease alive at ttl/3. This loop MUST NOT die on a
+        transient failure (the pre-ISSUE-15 bug: the first blip killed it
+        silently and the lease expired a TTL later with the process still
+        healthy). ConnectionError waits out the outage — the reconnect
+        replay re-grants the lease and restarts this task; StoreError
+        means the lease vanished server-side while the session stayed up
+        (keepalive delayed past TTL, or a restarted store that kept the
+        connection), so re-attach it under the same id and re-put its
+        keys right here."""
         try:
-            while True:
+            while not self._closed and lease_id in self._lease_meta:
                 await asyncio.sleep(ttl / 3.0)
-                await self._request("lease_keepalive", lease=lease_id)
-        except (asyncio.CancelledError, ConnectionError, StoreError):
+                try:
+                    await self._request("lease_keepalive", lease=lease_id)
+                except ConnectionError:
+                    self.keepalive_failures_total += 1
+                    # Session down: the reconnect loop owns recovery (it
+                    # cancels this task and starts a fresh one after the
+                    # lease is re-granted). Keep looping — if the session
+                    # comes back under us first, the next beat succeeds.
+                except StoreError:
+                    self.keepalive_failures_total += 1
+                    try:
+                        await self._request(
+                            "lease_grant", ttl=ttl, want=lease_id
+                        )
+                        for key, (value, lease) in list(self._leased_kv.items()):
+                            if lease == lease_id:
+                                await self._request(
+                                    "kv_put", k=key, v=value, lease=lease
+                                )
+                        log.warning(
+                            "lease %d re-attached after server-side expiry",
+                            lease_id,
+                        )
+                    except (ConnectionError, StoreError):
+                        pass  # retry at the next keepalive beat
+        except asyncio.CancelledError:
             pass
 
     async def lease_revoke(self, lease_id: int) -> bool:

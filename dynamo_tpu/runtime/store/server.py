@@ -204,12 +204,19 @@ class StoreServer:
 
     # -- KV ----------------------------------------------------------------
 
-    def _notify_kv(self, event: str, key: str, value: bytes, rev: int) -> None:
+    def _notify_kv(
+        self, event: str, key: str, value: bytes, rev: int, reason: str = ""
+    ) -> None:
         for sub in self._subs.values():
             if sub.kind == "watch" and key.startswith(sub.pattern):
-                sub.conn.push(
-                    {"s": sub.sub_id, "ev": {"t": event, "k": key, "v": value, "rev": rev}}
-                )
+                ev = {"t": event, "k": key, "v": value, "rev": rev}
+                if reason:
+                    # Delete provenance: "lease" (expiry / conn-death
+                    # revoke — a liveness *judgment* degraded-mode
+                    # consumers may second-guess against the data plane)
+                    # vs "del" (an explicit retraction, always honored).
+                    ev["r"] = reason
+                sub.conn.push({"s": sub.sub_id, "ev": ev})
 
     async def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
         key, value = msg["k"], msg["v"]
@@ -241,14 +248,14 @@ class StoreServer:
     async def _op_kv_del(self, conn: _Conn, msg: dict) -> int:
         return self._delete_key(msg["k"])
 
-    def _delete_key(self, key: str) -> int:
+    def _delete_key(self, key: str, reason: str = "del") -> int:
         entry = self._kv.pop(key, None)
         if entry is None:
             return 0
         if entry.lease_id and entry.lease_id in self._leases:
             self._leases[entry.lease_id].keys.discard(key)
         self._rev += 1
-        self._notify_kv("delete", key, b"", self._rev)
+        self._notify_kv("delete", key, b"", self._rev, reason=reason)
         return 1
 
     async def _op_kv_get_prefix(self, conn: _Conn, msg: dict) -> list:
@@ -322,7 +329,7 @@ class StoreServer:
         if lease is None:
             return False
         for key in list(lease.keys):
-            self._delete_key(key)
+            self._delete_key(key, reason="lease")
         return True
 
     async def _sweep_loop(self) -> None:

@@ -53,6 +53,13 @@ class WorkerMonitor:
     def metrics(self) -> dict[int, ForwardPassMetrics]:
         return self.aggregator.latest
 
+    @property
+    def degraded(self) -> bool:
+        """True while the control plane is dark (ISSUE 15): the busy set
+        and metrics view freeze at last-known-good — silence on the
+        metrics subject is an outage symptom, not a fleet-wide idle."""
+        return self.aggregator.degraded
+
     async def start(self) -> None:
         await self.aggregator.start()
 

@@ -267,6 +267,7 @@ def test_controller_status_and_stats_shapes():
     assert st["cycles"] == 1
     assert set(st["decisions"]) == {
         "scale_up", "scale_down", "hold", "cooldown_hold", "hysteresis_hold",
+        "degraded_hold",
     }
     assert st["decisions"]["scale_up"] >= 1
     pay = ctl.status_payload()

@@ -1,0 +1,610 @@
+"""Control-plane outage tolerance (ISSUE 15): degraded-mode serving
+through store blackouts.
+
+The store is a liveness HINT, not a liveness AUTHORITY: session
+resurrection replays leases/KV/watches after a store restart, the
+keepalive loop survives transient failures, discovery consumers keep a
+last-known-good instance snapshot with data-plane-judged quarantine for
+lease-expiry deletes, the planner holds actuation on blind windows, and
+the fleet harness proves a 60 s blackout is invisible to clients with
+degraded mode on — and demonstrably sheds with it off.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+pytestmark = [pytest.mark.integration, pytest.mark.pre_merge]
+
+
+# -- store client session resurrection ---------------------------------------
+
+
+async def test_keepalive_survives_transient_store_error():
+    """The pre-ISSUE-15 bug: the first StoreError killed the keepalive
+    loop silently and the lease expired a TTL later. Now a server-side
+    lease loss re-attaches the lease under the same id and re-puts its
+    keys, from inside the keepalive loop itself."""
+    async with StoreServer() as server:
+        async with await StoreClient.open(server.address) as c:
+            lease = await c.lease_grant(ttl=0.9)
+            await c.kv_put("/reg/w1", b"payload", lease=lease)
+            # Simulate server-side expiry while the session stays up.
+            server._revoke_lease(lease)
+            assert await c.kv_get("/reg/w1") is None
+            # Within ~2 keepalive beats the loop must notice the
+            # StoreError, re-grant, and replay the lease-bound key.
+            for _ in range(100):
+                if await c.kv_get("/reg/w1") == b"payload":
+                    break
+                await asyncio.sleep(0.05)
+            assert await c.kv_get("/reg/w1") == b"payload"
+            assert c.keepalive_failures_total >= 1
+            # And the replayed lease is a real lease: revoke deletes.
+            await c.lease_revoke(lease)
+            assert await c.kv_get("/reg/w1") is None
+
+
+async def test_ephemeral_lease_not_replayed_after_restart():
+    """keepalive=False leases are one-shot (reply keys): replaying them
+    after a store restart would resurrect keys consumers already burned.
+    Kept-alive leases replay; ephemeral ones must not."""
+    server = StoreServer()
+    await server.start()
+    port = server.port
+    client = await StoreClient.open(server.address)
+    try:
+        durable = await client.lease_grant(ttl=30.0)
+        await client.kv_put("/reg/durable", b"d", lease=durable)
+        ephemeral = await client.lease_grant(ttl=30.0, keepalive=False)
+        await client.kv_put("/oneshot/reply", b"e", lease=ephemeral)
+        await server.stop()
+        await asyncio.sleep(0.2)
+        server2 = StoreServer(port=port)
+        await server2.start()
+        try:
+            for _ in range(100):
+                if await _quiet_get(client, "/reg/durable") == b"d":
+                    break
+                await asyncio.sleep(0.1)
+            assert await client.kv_get("/reg/durable") == b"d"
+            assert await client.kv_get("/oneshot/reply") is None
+            assert client.reconnects_total == 1
+        finally:
+            await server2.stop()
+    finally:
+        await client.close()
+
+
+async def _quiet_get(client, key):
+    try:
+        return await client.kv_get(key)
+    except ConnectionError:
+        return None
+
+
+async def test_subscription_resumes_without_duplicate_events():
+    """A resumed pub/sub subscription delivers each post-restart publish
+    exactly once — the replay must not double-deliver or inject phantom
+    initial events into a plain subject subscription."""
+    server = StoreServer()
+    await server.start()
+    port = server.port
+    client = await StoreClient.open(server.address)
+    try:
+        sub = await client.subscribe("events")
+        await server.stop()
+        await asyncio.sleep(0.2)
+        server2 = StoreServer(port=port)
+        await server2.start()
+        try:
+            for _ in range(100):
+                if client.connected and await _quiet_ping(client):
+                    break
+                await asyncio.sleep(0.1)
+            pub = await StoreClient.open(server2.address)
+            try:
+                await pub.publish("events", b"once")
+                msg = await sub.get(timeout=5)
+                assert msg["p"] == b"once"
+                with pytest.raises(asyncio.TimeoutError):
+                    await sub.get(timeout=0.3)
+            finally:
+                await pub.close()
+        finally:
+            await server2.stop()
+    finally:
+        await client.close()
+
+
+async def _quiet_ping(client) -> bool:
+    try:
+        return await client.ping() == "pong"
+    except ConnectionError:
+        return False
+
+
+async def test_store_client_outage_stats():
+    """connected / outage_seconds / reconnects surface the session state
+    for the /metrics + /health exports."""
+    server = StoreServer()
+    await server.start()
+    port = server.port
+    client = await StoreClient.open(server.address)
+    try:
+        assert client.connected
+        assert client.stats()["connected"] is True
+        await server.stop()
+        for _ in range(100):
+            if not client.connected:
+                break
+            await asyncio.sleep(0.05)
+        assert not client.connected
+        await asyncio.sleep(0.15)
+        st = client.stats()
+        assert st["connected"] is False
+        assert st["disconnected_for_s"] > 0.0
+        server2 = StoreServer(port=port)
+        await server2.start()
+        try:
+            for _ in range(100):
+                if client.connected and await _quiet_ping(client):
+                    break
+                await asyncio.sleep(0.1)
+            st = client.stats()
+            assert st["connected"] is True
+            assert st["reconnects"] == 1
+            assert st["outage_seconds"] > 0.0
+            assert st["disconnected_for_s"] == 0.0
+        finally:
+            await server2.stop()
+    finally:
+        await client.close()
+
+
+# -- chaos: the sustained blackout plan --------------------------------------
+
+
+async def test_store_outage_plan_severs_within_window_only():
+    from dynamo_tpu.runtime import chaos
+
+    plan = chaos.ChaosPlan.store_outage(duration_s=60.0)
+    now = [1000.0]
+    plan.clock = lambda: now[0]
+    with pytest.raises(ConnectionError):
+        await plan.fire("store.frame", "127.0.0.1:1")
+    now[0] += 30.0
+    with pytest.raises(ConnectionError):
+        await plan.fire("store.connect", "127.0.0.1:1")
+    now[0] += 91.0  # past both windows (each clocks from its first hit)
+    assert await plan.fire("store.frame", "127.0.0.1:1") is True
+    assert await plan.fire("store.connect", "127.0.0.1:1") is True
+    assert ("store.frame", "sever", "127.0.0.1:1") in plan.fired
+
+
+async def test_store_outage_plan_blacks_out_live_session_then_recovers():
+    """End to end through a real client: the armed plan severs the live
+    session (next inbound frame) and keeps every redial failing until
+    the window passes; then the session replays and lease-bound state
+    survives."""
+    from dynamo_tpu.runtime import chaos
+
+    async with StoreServer() as server:
+        client = await StoreClient.open(server.address)
+        try:
+            lease = await client.lease_grant(ttl=30.0)
+            await client.kv_put("/reg/w", b"v", lease=lease)
+            plan = chaos.ChaosPlan.store_outage(duration_s=0.8)
+            chaos.install(plan)
+            try:
+                # Any request's response frame trips the sever.
+                with pytest.raises(ConnectionError):
+                    await client.ping()
+                for _ in range(100):
+                    if not client.connected:
+                        break
+                    await asyncio.sleep(0.02)
+                assert not client.connected
+                # Recovery: once the window passes, redials succeed and
+                # the session replays under the same lease id.
+                for _ in range(200):
+                    if client.connected and await _quiet_ping(client):
+                        break
+                    await asyncio.sleep(0.05)
+                assert await client.kv_get("/reg/w") == b"v"
+                assert client.reconnects_total >= 1
+            finally:
+                chaos.uninstall()
+        finally:
+            await client.close()
+
+
+# -- degraded-mode discovery consumers ---------------------------------------
+
+
+async def test_endpoint_client_quarantines_lease_expiry_when_dataplane_alive():
+    """A worker that loses only its STORE session must stay routable:
+    the lease-expiry delete is quarantined while the worker's ingress
+    answers a probe, and applied only once the data plane goes dark."""
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            ep_w = worker.namespace("ns").component("be").endpoint("gen")
+
+            async def handler(req, ctx):
+                yield {"ok": True}
+
+            inst = await ep_w.serve(handler)
+            ep_f = frontend.namespace("ns").component("be").endpoint("gen")
+            client = await ep_f.client()
+            client.stale_grace_s = 0.6
+            await client.wait_for_instances(1, timeout=5)
+
+            # Sever ONLY the worker's control-plane session (no
+            # reconnect): conn-death revokes its lease → delete(lease).
+            worker.store.auto_reconnect = False
+            await worker.store.close()
+            for _ in range(100):
+                if client.quarantined_total >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert client.quarantined_total == 1
+            # Still cached, still routable — the degraded-mode contract.
+            assert inst.instance_id in client.instances
+            stream = await client.direct(inst.instance_id, {"q": 1})
+            got = [item async for item in stream]
+            assert got == [{"ok": True}]
+
+            # Now the data plane dies too: the deferred delete applies
+            # within one grace sweep.
+            await worker.ingress.stop()
+            for _ in range(100):
+                if inst.instance_id not in client.instances:
+                    break
+                await asyncio.sleep(0.1)
+            assert inst.instance_id not in client.instances
+            assert client.quarantine_expired_total == 1
+            await client.stop()
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+
+
+async def test_endpoint_client_honors_explicit_deregister():
+    """Graceful drain retractions (explicit kv_del) are never
+    quarantined, even with the data plane alive and grace on."""
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async with StoreServer() as server:
+        worker = await DistributedRuntime.create(server.address)
+        frontend = await DistributedRuntime.create(server.address)
+        try:
+            ep_w = worker.namespace("ns").component("be").endpoint("gen")
+
+            async def handler(req, ctx):
+                yield {}
+
+            inst = await ep_w.serve(handler)
+            ep_f = frontend.namespace("ns").component("be").endpoint("gen")
+            client = await ep_f.client()
+            client.stale_grace_s = 60.0
+            await client.wait_for_instances(1, timeout=5)
+            await ep_w.deregister(inst.instance_id)
+            for _ in range(100):
+                if inst.instance_id not in client.instances:
+                    break
+                await asyncio.sleep(0.05)
+            assert inst.instance_id not in client.instances
+            assert client.quarantined_total == 0
+            await client.stop()
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+
+
+async def test_model_watcher_defers_lease_removal_and_cancels_on_reregister():
+    """A last-instance lease expiry with a live data plane defers the
+    model removal; re-registration within grace cancels it — zero flap
+    reaches the ModelManager."""
+    from dynamo_tpu.llm.discovery import ModelWatcher, register_llm
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async with StoreServer() as server:
+        front = await DistributedRuntime.create(server.address)
+        worker = await DistributedRuntime.create(server.address)
+        removed: list = []
+        watcher = ModelWatcher(
+            front.store, stale_grace_s=1.0, data_plane_live=lambda name: True
+        )
+
+        async def on_rm(name):
+            removed.append(name)
+
+        watcher.on_model_removed.append(on_rm)
+        await watcher.start()
+        try:
+            ep = worker.namespace("ns").component("be").endpoint("gen")
+
+            async def handler(req, ctx):
+                yield {}
+
+            await ep.serve(handler)
+            await register_llm(ep, ModelDeploymentCard(name="tiny", context_length=128))
+            for _ in range(100):
+                if watcher._counts.get("tiny"):
+                    break
+                await asyncio.sleep(0.02)
+
+            # Lease loss (store session severed), data plane "alive".
+            worker.store.auto_reconnect = False
+            await worker.store.close()
+            for _ in range(100):
+                if watcher.deferred_removals_total:
+                    break
+                await asyncio.sleep(0.02)
+            assert watcher.deferred_removals_total == 1
+            assert removed == []
+
+            # Re-register within grace from a fresh runtime: the pending
+            # removal cancels — the model never flapped.
+            worker2 = await DistributedRuntime.create(server.address)
+            try:
+                ep2 = worker2.namespace("ns").component("be").endpoint("gen")
+                await ep2.serve(handler)
+                await register_llm(
+                    ep2, ModelDeploymentCard(name="tiny", context_length=128)
+                )
+                for _ in range(100):
+                    if watcher.flaps_avoided_total:
+                        break
+                    await asyncio.sleep(0.02)
+                assert watcher.flaps_avoided_total == 1
+                await asyncio.sleep(1.2)  # past the original grace
+                assert removed == []
+            finally:
+                await worker2.shutdown()
+        finally:
+            await watcher.stop()
+            await front.shutdown()
+            await worker.shutdown()
+
+
+async def test_model_watcher_duplicate_delete_underflow_guard():
+    """A duplicate/late delete must not underflow the instance count
+    (which would make the next 0→1 transition invisible forever)."""
+    from dynamo_tpu.llm.discovery import ModelEntry, ModelWatcher
+
+    watcher = ModelWatcher(store=None, stale_grace_s=0.0)
+    entry = ModelEntry(
+        name="m", namespace="ns", component="be", endpoint="gen",
+        instance_id=1, mdc_checksum="x",
+    )
+    watcher._instances["/dynamo/models/m/1"] = entry
+    watcher._instances["/dynamo/models/m/2"] = entry
+    watcher._counts["m"] = 1  # desynced: two keys, count 1
+    fired: list = []
+
+    async def on_rm(name):
+        fired.append(name)
+
+    watcher.on_model_removed.append(on_rm)
+
+    ev1 = StoreClient.as_watch_event(
+        {"t": "delete", "k": "/dynamo/models/m/1", "v": b"", "rev": 1}
+    )
+    ev2 = StoreClient.as_watch_event(
+        {"t": "delete", "k": "/dynamo/models/m/2", "v": b"", "rev": 2}
+    )
+    await watcher._on_delete(ev1)
+    assert fired == ["m"]
+    await watcher._on_delete(ev2)  # would underflow pre-fix
+    assert fired == ["m"]
+    assert watcher._counts.get("m", 0) == 0
+
+
+async def test_model_watcher_stop_awaits_and_is_idempotent():
+    async with StoreServer() as server:
+        from dynamo_tpu.llm.discovery import ModelWatcher
+
+        client = await StoreClient.open(server.address)
+        try:
+            watcher = ModelWatcher(client, stale_grace_s=0.0)
+            await watcher.start()
+            task = watcher._task
+            await watcher.stop()
+            assert task.done()
+            assert watcher._task is None
+            await watcher.stop()  # second stop is a no-op, not an error
+        finally:
+            await client.close()
+
+
+# -- planner + obs degraded behavior -----------------------------------------
+
+
+def test_controller_holds_on_degraded_observation():
+    from dynamo_tpu.planner.controller import ControllerConfig, PlannerController
+    from dynamo_tpu.planner.planner_core import (
+        Observation,
+        Planner,
+        PlannerConfig,
+        SlaTargets,
+    )
+    from dynamo_tpu.planner.perf_interpolation import from_profile
+    from dynamo_tpu.fleet.harness import mocker_profile
+
+    class Connector:
+        def __init__(self):
+            self.calls = []
+
+        async def set_replicas(self, component, replicas):
+            self.calls.append((component, replicas))
+
+        def current(self, component):
+            return 1
+
+    prefill_i, decode_i = from_profile(mocker_profile(20_000.0, 100.0, 5_000.0, 4))
+    conn = Connector()
+    planner = Planner(
+        prefill_i, decode_i, conn,
+        sla=SlaTargets(ttft_s=0.35, itl_s=0.08),
+        config=PlannerConfig(min_replicas=1, max_replicas=8),
+    )
+    t = [0.0]
+    ctl = PlannerController(
+        planner, conn, pools={"backend": "max"},
+        config=ControllerConfig(min_replicas=1, max_replicas=8),
+        clock=lambda: t[0],
+    )
+
+    async def run():
+        t[0] = 100.0
+        dark = Observation(
+            request_rate=0.0, mean_isl=64.0, mean_osl=8.0,
+            control_plane_degraded=True,
+        )
+        actions = await ctl.cycle(dark)
+        assert set(actions.values()) == {"degraded_hold"}
+        assert conn.calls == []  # no actuation on a blind window
+        # Hysteresis must not have advanced: a healthy cycle afterwards
+        # decides from real signal.
+        assert ctl.pools["backend"].below_streak == 0
+        t[0] = 200.0
+        live = Observation(request_rate=30.0, mean_isl=64.0, mean_osl=8.0)
+        actions = await ctl.cycle(live)
+        assert actions["backend"] in ("scale_up", "hold")
+        assert conn.calls  # actuation resumed
+
+    asyncio.run(run())
+    assert ctl.decisions["degraded_hold"] == 1
+
+
+def test_fleet_aggregator_dark_is_not_dead():
+    """While the store session is down, snapshot silence retires NOTHING
+    (publisher dead vs control plane dark); after reconnection every
+    publisher gets one fresh stale window before retirement resumes."""
+    from dynamo_tpu.obs.aggregator import FleetAggregator
+    from dynamo_tpu.obs.snapshot import MetricSnapshot
+
+    class FakeStore:
+        connected = True
+
+    store = FakeStore()
+    agg = FleetAggregator(store, stale_after_s=1.0)
+    snap = MetricSnapshot(worker_id=7, role="worker", component="backend")
+    agg.ingest(snap)
+    snap.received_at = time.time() - 100.0  # long silent
+    store.connected = False
+    assert agg.control_plane_dark
+    assert agg.sweep_stale() == []           # dark: not dead
+    assert 7 in agg.latest
+    store.connected = True
+    assert agg.sweep_stale() == []           # re-publish grace window
+    assert agg.sweep_stale(now=time.time() + 2.0) == [7]  # grace over
+    assert 7 not in agg.latest
+
+
+def test_worker_monitor_degraded_tracks_store_connectivity():
+    """The busy-set view freezes at last-known-good while the control
+    plane is dark; ``degraded`` is the consumer-facing flag for it."""
+    from dynamo_tpu.llm.kv_router.publisher import MetricsAggregator
+    from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+    class FakeStore:
+        connected = True
+
+    store = FakeStore()
+    monitor = WorkerMonitor(store, "ns", "be")
+    assert monitor.degraded is False
+    store.connected = False
+    assert monitor.degraded is True
+    assert monitor.aggregator.degraded is True
+    # __new__-built partial aggregators (the established test pattern)
+    # must not blow up on the property.
+    partial = MetricsAggregator.__new__(MetricsAggregator)
+    assert partial.degraded is False
+
+
+def test_fleet_aggregator_observation_flags_degraded():
+    from dynamo_tpu.obs.aggregator import FleetAggregator
+
+    class FakeStore:
+        connected = False
+
+    agg = FleetAggregator(FakeStore(), stale_after_s=1.0)
+    obs = agg.observation()
+    assert obs.control_plane_degraded is True
+
+
+# -- surfaces ----------------------------------------------------------------
+
+
+async def test_store_gauges_and_health_on_status_server():
+    from dynamo_tpu.runtime.status_server import SystemStatusServer, bind_store_gauges
+
+    async with StoreServer() as server:
+        client = await StoreClient.open(server.address)
+        try:
+            status = SystemStatusServer()
+            bind_store_gauges(status, client)
+            for hook in status.before_render:
+                hook()
+            text = status.metrics.render().decode()
+            for name in (
+                "dynamo_store_connected",
+                "dynamo_store_outage_seconds",
+                "dynamo_store_keepalive_failures_total",
+                "dynamo_store_session_rebuilds_total",
+            ):
+                assert name in text, name
+            assert 'dynamo_store_connected{service="store"} 1.0' in text
+            assert status.store is client
+        finally:
+            await client.close()
+
+
+# -- the fleet-harness blackout scenario (the acceptance criterion) ----------
+
+
+def test_fleet_blackout_degraded_serves_strict_sheds():
+    """60 s store blackout mid-diurnal-run (ISSUE 15 acceptance):
+
+    degraded mode — every stream bit-identical to the no-fault run, new
+    requests during the blackout route on cached instances, zero model
+    flaps, the controller holds (degraded_hold), and on recovery every
+    worker re-registers within one lease TTL with inventories resynced;
+
+    strict mode (grace = 0) — the SAME scenario demonstrably sheds once
+    leases expire, pinning that the degraded path is load-bearing."""
+    from dynamo_tpu.fleet.harness import run_blackout_ab
+
+    r = run_blackout_ab(
+        duration_s=240.0, blackout_at=90.0, blackout_s=60.0,
+        seed=3, lease_ttl_s=10.0, stale_grace_s=120.0,
+    )
+    no_fault, degraded, strict = r["no_fault"], r["degraded"], r["strict"]
+
+    # Degraded mode: the blackout is invisible to clients.
+    assert degraded.broken_streams == 0
+    assert degraded.streams == no_fault.streams  # bit-identical fleet-wide
+    assert degraded.blackout_routed >= 1
+    assert degraded.blackout_shed == 0
+    assert degraded.model_flaps == 0
+    assert degraded.decisions.get("degraded_hold", 0) >= 1
+    # Recovery: every blacked-out worker re-registered within one lease
+    # TTL and resynced its KV inventory on session replay.
+    assert degraded.kv_resyncs >= 1
+    assert 0.0 < degraded.reregister_lag_s <= 10.0
+
+    # Strict mode (grace = 0): lease expiry collapses routing — the same
+    # scenario sheds new requests and flaps the model add/remove.
+    assert strict.blackout_shed >= 1
+    assert strict.model_flaps >= 2  # removed at expiry, re-added on recovery
+    assert strict.shed >= strict.blackout_shed

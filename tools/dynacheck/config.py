@@ -135,6 +135,13 @@ CURSOR_ATTRS = {
     # ordered like the KV event buffer; an out-of-band write could
     # reorder or unbound the fleet view's feed.
     "_snapbuf": "bounded snapshot-publisher buffer",
+    # Degraded-mode discovery state (ISSUE 15): the quarantine buffer
+    # (runtime/component.py) and the deferred-removal map
+    # (llm/discovery.py) decide what keeps serving through a store
+    # blackout — an out-of-band write could drop a live instance mid-
+    # outage or resurrect a dead one after it.
+    "_quarantine": "lease-expiry delete quarantine",
+    "_deferred": "deferred model-removal map",
 }
 
 # {file suffix -> set of audited writer qualnames}. Nested defs are dotted
@@ -218,6 +225,24 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
     "dynamo_tpu/obs/snapshot.py": {
         "SnapshotPublisher.publish_nowait",
         "SnapshotPublisher._drain",
+    },
+    # Degraded-mode discovery (ISSUE 15): the endpoint client owns its
+    # quarantine buffer (watch loop + sweep + reconnect reconcile, all
+    # loop-affine); the rule guards OTHER files reaching into
+    # `client._quarantine`.
+    "dynamo_tpu/runtime/component.py": {
+        "EndpointClient.__init__",
+        "EndpointClient._watch_loop",
+        "EndpointClient._remove_instance",
+        "EndpointClient._sweep_quarantine",
+        "EndpointClient._reconcile",
+    },
+    # Same ownership shape for the model watcher's deferred-removal map.
+    "dynamo_tpu/llm/discovery.py": {
+        "ModelWatcher.__init__",
+        "ModelWatcher._on_put",
+        "ModelWatcher._on_delete",
+        "ModelWatcher._sweep_deferred",
     },
     # The global index owns its tier ledger wholesale (single event-task
     # writer); the rule guards OTHER files reaching into `idx._tiers`.

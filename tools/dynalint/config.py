@@ -125,6 +125,18 @@ GUARDED_BY = {
         # task pops, both on one event loop.
         ("SnapshotPublisher", "_snapbuf"): EXTERNAL,
     },
+    "dynamo_tpu/runtime/component.py": {
+        # Degraded-mode quarantine buffer (ISSUE 15): lease-expiry
+        # deletes held while the data plane answers. Loop-affine — the
+        # watch loop, the quarantine sweep, and the reconnect reconcile
+        # all run on the client's one event loop.
+        ("EndpointClient", "_quarantine"): EXTERNAL,
+    },
+    "dynamo_tpu/llm/discovery.py": {
+        # Deferred last-instance model removals (ISSUE 15): same
+        # loop-affinity as the quarantine buffer (watch loop + sweep).
+        ("ModelWatcher", "_deferred"): EXTERNAL,
+    },
 }
 
 # Mutating method names: `x.<name>(...)` counts as a mutation of `x`.

@@ -1,0 +1,279 @@
+"""Outage smoke: black out the control-plane store mid-stream and assert
+serving is unaffected, then restart it and assert clean reconvergence.
+
+The end-to-end degraded-mode contract (ISSUE 15): a mocker-backed
+frontend with two workers streams a greedy request; the store server is
+STOPPED after the first few tokens (every session in the deployment goes
+dark at once — the etcd/NATS-blackout twin); the in-flight stream must
+complete byte-identical to a no-fault run, a NEW request issued during
+the blackout must succeed on cached discovery state, and the frontend's
+/health must report ``degraded`` (still 200 — load balancers keep
+routing). After the store restarts on the same port, both workers'
+session replays re-register their instances within one lease TTL,
+/health returns to ``healthy``, and the frontend's /metrics shows
+``store_connected 1`` with ``store_session_rebuilds_total >= 1``.
+
+CI usage (`.github/workflows/ci.yml` outage-smoke step) and local:
+
+    python tools/outage_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def stream_text(session, url: str, body: dict, on_chunk=None) -> str:
+    """POST a streaming chat completion; return the concatenated content,
+    calling ``on_chunk(parts)`` after every content delta."""
+    import json
+
+    parts: list[str] = []
+    async with session.post(url, json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:") or "[DONE]" in line:
+                continue
+            chunk = json.loads(line[len("data:"):])
+            for choice in chunk.get("choices", []):
+                piece = (choice.get("delta") or {}).get("content") or ""
+                if piece:
+                    parts.append(piece)
+                    if on_chunk is not None:
+                        await on_chunk(parts)
+    return "".join(parts)
+
+
+def chat_body(content: str, max_tokens: int) -> dict:
+    return {
+        "model": "mock",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "temperature": 0,
+        "stream": True,
+    }
+
+
+async def boot_worker(store_address: str, args) -> tuple:
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create(store_address, lease_ttl=5.0)
+    served = asyncio.Event()
+    task = asyncio.create_task(
+        run_mocker(rt, model_name="mock", engine_args=args, served_event=served)
+    )
+    await asyncio.wait_for(served.wait(), 30)
+    return rt, task
+
+
+async def wait_health(session, base: str, want: str, budget_s: float = 30.0) -> dict:
+    deadline = asyncio.get_running_loop().time() + budget_s
+    last: dict = {}
+    while asyncio.get_running_loop().time() < deadline:
+        try:
+            async with session.get(f"{base}/health") as r:
+                last = await r.json()
+                if last.get("status") == want:
+                    return last
+        except OSError:
+            pass
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"/health never reached {want!r}; last: {last}")
+
+
+async def run_blackout(baseline: str) -> None:
+    import aiohttp
+
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    # ~20ms per decode iteration so the blackout lands mid-stream.
+    args = MockEngineArgs(
+        num_kv_blocks=2048, block_size=8, decode_us_per_seq=20000.0
+    )
+    store = StoreServer()
+    await store.start()
+    port = store.port
+    workers = [await boot_worker(store.address, args) for _ in range(2)]
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+
+    blacked_out = asyncio.Event()
+
+    async def maybe_black_out(parts: list[str]) -> None:
+        if not blacked_out.is_set() and len(parts) >= 3:
+            blacked_out.set()
+            await store.stop()  # every session in the deployment goes dark
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+
+        # 1. In-flight stream survives the blackout byte-identically.
+        text = await stream_text(
+            s, f"{base}/v1/chat/completions",
+            chat_body("outage smoke test", 16),
+            on_chunk=maybe_black_out,
+        )
+        assert blacked_out.is_set(), "stream finished before the blackout"
+        assert text == baseline, (
+            "stream through the store blackout diverged from the "
+            f"no-fault run:\n  fault : {text!r}\n  clean : {baseline!r}"
+        )
+
+        # 2. The frontend reports degraded (200, still routable).
+        health = await wait_health(s, base, "degraded")
+        assert health["control_plane"]["connected"] is False, health
+
+        # 3. A NEW request during the blackout succeeds on cached routes.
+        during = await stream_text(
+            s, f"{base}/v1/chat/completions",
+            chat_body("routed on cached instances", 8),
+        )
+        assert during, "new request during the blackout streamed nothing"
+
+        # 4. Store restart: sessions replay, workers re-register within a
+        #    lease TTL, /health leaves degraded.
+        store2 = StoreServer(port=port)
+        await store2.start()
+        try:
+            probe = await StoreClient.open(store2.address)
+            try:
+                want = {w[0].primary_lease_id for w in workers}
+                for _ in range(200):
+                    regs = await probe.kv_get_prefix("/dynamo/instances/")
+                    seen = {
+                        int(k.rsplit("/", 1)[-1], 16) for k in regs
+                    }
+                    if want <= seen:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError(
+                        f"workers never re-registered; saw {seen}, want {want}"
+                    )
+            finally:
+                await probe.close()
+
+            health = await wait_health(s, base, "healthy")
+            assert health["control_plane"]["connected"] is True, health
+            assert health["control_plane"]["session_rebuilds"] >= 1, health
+
+            async with s.get(f"{base}/metrics") as r:
+                metrics = await r.text()
+            assert 'dynamo_store_connected{service="store"} 1.0' in metrics
+            assert "dynamo_store_session_rebuilds_total" in metrics
+            assert "dynamo_store_outage_seconds" in metrics
+
+            # 5. And the recovered deployment still serves.
+            after = await stream_text(
+                s, f"{base}/v1/chat/completions",
+                chat_body("outage smoke test", 16),
+            )
+            assert after == baseline, "post-recovery stream diverged"
+        finally:
+            frontend.cancel()
+            for rt, task in workers:
+                task.cancel()
+                try:
+                    await rt.shutdown()
+                except (ConnectionError, OSError):
+                    pass
+            try:
+                await front_rt.shutdown()
+            except (ConnectionError, OSError):
+                pass
+            await store2.stop()
+
+    print(
+        "outage-smoke OK: stream bit-identical through a store blackout, "
+        "new request served on cached routes, /health degraded->healthy, "
+        "both workers re-registered after restart", flush=True,
+    )
+
+
+async def run_baseline() -> str:
+    """No-fault single run of the same deployment shape: the byte-exact
+    reference stream."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    args = MockEngineArgs(
+        num_kv_blocks=2048, block_size=8, decode_us_per_seq=20000.0
+    )
+    store = StoreServer()
+    await store.start()
+    workers = [await boot_worker(store.address, args) for _ in range(2)]
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = asyncio.Event()
+    services: list = []
+    frontend = asyncio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(200):
+                async with s.get(f"{base}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        break
+                await asyncio.sleep(0.05)
+            return await stream_text(
+                s, f"{base}/v1/chat/completions",
+                chat_body("outage smoke test", 16),
+            )
+    finally:
+        frontend.cancel()
+        for rt, task in workers:
+            task.cancel()
+            await rt.shutdown()
+        await front_rt.shutdown()
+        await store.stop()
+
+
+async def run() -> None:
+    baseline = await run_baseline()
+    assert baseline, "baseline deployment streamed nothing"
+    await run_blackout(baseline)
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+
+
