@@ -37,6 +37,8 @@ import uuid
 import msgpack
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import wire
+
 from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
 from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_pool import PeerKvClient
@@ -65,21 +67,21 @@ async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None
     async def kv_fetch_handler(request: Any, context: Context) -> AsyncIterator[Any]:
         import numpy as np
 
-        hashes = list(request.get("hashes") or [])
-        chunk = int(request.get("chunk_blocks", 32))
+        hashes = list(request.get(wire.KV_HASHES) or [])
+        chunk = int(request.get(wire.KV_CHUNK_BLOCKS, 32))
         # Page geometry first (the kv_transfer descriptor pattern): the
         # consumer must parse our bytes with OUR layout, not assume its
         # own (cross-precision fleets).
         yield {
-            "version": 2,
-            "shape": [
+            wire.KV_VERSION: 2,
+            wire.KV_SHAPE: [
                 core.cfg.num_layers, core.engine.block_size,
                 2 * core.cfg.num_kv_heads, core.cfg.head_dim,
             ],
             # "int8" pages ship as the canonical packed buffer (int8 kv
             # bytes + f32 scales, engine/kv_quant.py); a mixed-dtype
             # consumer fails fast at import_blocks.
-            "dtype": core.kv_wire_dtype,
+            wire.KV_DTYPE: core.kv_wire_dtype,
         }
         sent = 0
         for s in range(0, len(hashes), chunk):
@@ -87,11 +89,12 @@ async def _serve_kv_fetch(runtime, namespace: str, component: str, core) -> None
                 core.read_cached_pages, hashes[s : s + chunk]
             )
             if pages:
-                yield {"version": 2, "start": sent, "kv": pages}
+                yield {wire.KV_VERSION: 2, wire.KV_START: sent,
+                       wire.KV_PAGES: pages}
                 sent += len(pages)
             if len(pages) < min(chunk, len(hashes) - s):
                 break  # hash chains are prefixes: first miss ends it
-        yield {"version": 2, "done": sent}
+        yield {wire.KV_VERSION: 2, wire.KV_DONE: sent}
 
     ep = runtime.namespace(namespace).component(component).endpoint("kv_fetch")
     await ep.serve(kv_fetch_handler)
@@ -593,26 +596,27 @@ async def run_jax_worker(
             # data in chunks — the engine keeps prefilling while pages
             # stage out (reference nixl_connect descriptor flow,
             # disagg_serving.md:88-96).
-            rid = request["request_id"]
+            rid = request[wire.KV_REQUEST_ID]
             # 32-block chunks balance device-invocation count (each chunk
             # is one gather at a fixed dispatch cost) against streaming
             # overlap with the consumer's imports.
-            chunk = int(request.get("chunk_blocks", 32))
+            chunk = int(request.get(wire.KV_CHUNK_BLOCKS, 32))
             try:
                 descs = core.export_descriptors(rid)
             except KeyError:
-                yield {"error": f"no held blocks for {rid}"}
+                yield {wire.KV_ERROR: f"no held blocks for {rid}"}
                 return
-            yield {"version": core.KV_WIRE_VERSION, "blocks": descs}
+            yield {wire.KV_VERSION: core.KV_WIRE_VERSION,
+                   wire.KV_BLOCKS: descs}
             try:
                 for s in range(0, len(descs), chunk):
                     pages = await asyncio.to_thread(
                         core.read_held_pages, rid, s, chunk
                     )
                     yield {
-                        "version": core.KV_WIRE_VERSION,
-                        "start": s,
-                        "kv": pages,
+                        wire.KV_VERSION: core.KV_WIRE_VERSION,
+                        wire.KV_START: s,
+                        wire.KV_PAGES: pages,
                     }
             finally:
                 core.release_held(rid)
@@ -1064,25 +1068,30 @@ async def _remote_prefill_then_decode(
             # Disagg block pull: a severed pull surfaces as ConnectionError,
             # which the decode handler degrades to local recompute + replay.
             await chaos.inject("kv_transfer.pull", str(prefill_worker))
-        bstream = await transfer_client.direct(prefill_worker, {"request_id": rid})
+        bstream = await transfer_client.direct(
+            prefill_worker, {wire.KV_REQUEST_ID: rid}
+        )
         async for frame in bstream:
-            if "error" in frame:
-                log.warning("kv transfer aborted for %s: %s", rid, frame["error"])
+            if wire.KV_ERROR in frame:
+                log.warning(
+                    "kv transfer aborted for %s: %s", rid, frame[wire.KV_ERROR]
+                )
                 break
-            ver = frame.get("version")
+            ver = frame.get(wire.KV_VERSION)
             if ver != 2:
                 raise ConnectionError(
                     f"unsupported KV transfer wire version {ver!r} "
                     "(mixed-version prefill/decode pair?)"
                 )
-            if "blocks" in frame:
-                descs = frame["blocks"]
+            if wire.KV_BLOCKS in frame:
+                descs = frame[wire.KV_BLOCKS]
                 continue
             if descs is None:
                 raise ConnectionError("KV transfer data frame before descriptors")
-            s = frame["start"]
+            s = frame[wire.KV_START]
             batch = [
-                dict(descs[s + j], kv=kv) for j, kv in enumerate(frame["kv"])
+                {**descs[s + j], wire.IMP_KV: kv}
+                for j, kv in enumerate(frame[wire.KV_PAGES])
             ]
             total += len(batch)
             # Import chunk-by-chunk, concurrent with the engine's own

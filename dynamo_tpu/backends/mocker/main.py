@@ -22,7 +22,8 @@ from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
-from dynamo_tpu.runtime import Context, DistributedRuntime, chaos
+from dynamo_tpu import knobs
+from dynamo_tpu.runtime import Context, DistributedRuntime, chaos, wire
 from dynamo_tpu.runtime.worker import dynamo_worker
 from dynamo_tpu.tokens import compute_seq_hashes
 
@@ -37,8 +38,6 @@ async def _pull_peer_prefix_mock(
     deadlines, and chaos all apply), register them as locally cached, and
     price the transfer on the clock. Every failure degrades to local
     recompute — the stream is bit-identical either way."""
-    from dynamo_tpu.llm.kv_pool.peer_client import _env_float
-
     from dynamo_tpu.runtime.dataplane import BreakerOpenError
 
     st = engine.peer_stats
@@ -50,21 +49,23 @@ async def _pull_peer_prefix_mock(
         return 0
     st.pulls_attempted += 1
     t0 = time.monotonic()
-    frame_timeout = _env_float("DYN_KV_POOL_FRAME_TIMEOUT_S", 10.0)
+    frame_timeout = knobs.get_float("DYN_KV_POOL_FRAME_TIMEOUT_S")
     imported = 0
     cost_s = 0.0
     ok = False
     try:
         if chaos.active():
             await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
-        stream = await fetch_client.direct(hint["worker_id"], {"hashes": want})
+        stream = await fetch_client.direct(
+            hint["worker_id"], {wire.KV_HASHES: want}
+        )
         held: list[int] = []
         while True:
             try:
                 frame = await asyncio.wait_for(stream.__anext__(), frame_timeout)
             except StopAsyncIteration:
                 break
-            dtype = frame.get("dtype")
+            dtype = frame.get(wire.KV_DTYPE)
             if dtype is not None and (
                 (dtype == "int8") != (engine.args.kv_dtype == "int8")
             ):
@@ -75,7 +76,7 @@ async def _pull_peer_prefix_mock(
                     f"KV dtype mismatch: peer pages are {dtype!r}, local "
                     f"cache is {engine.args.kv_dtype!r}"
                 )
-            held.extend(frame.get("held") or [])
+            held.extend(frame.get(wire.KV_HELD) or [])
         offset = len(have)
         parents = [
             hashes[offset + i - 1] if offset + i > 0 else None
@@ -219,9 +220,11 @@ async def run_mocker(
     # which prefix of the requested hash chain this worker holds, behind
     # a geometry-ish frame carrying the kv dtype for the fail-fast check.
     async def kv_fetch_handler(request: Any, context: Context) -> AsyncIterator[Any]:
-        hashes = list(request.get("hashes") or [])
-        yield {"version": 2, "dtype": args.kv_dtype, "mock": True}
-        yield {"version": 2, "held": engine.kv.held_prefix(hashes)}
+        hashes = list(request.get(wire.KV_HASHES) or [])
+        # The dead "mock" marker key is gone (nothing ever consumed it —
+        # the wire-contract rule's produced-but-never-consumed finding).
+        yield {wire.KV_VERSION: 2, wire.KV_DTYPE: args.kv_dtype}
+        yield {wire.KV_VERSION: 2, wire.KV_HELD: engine.kv.held_prefix(hashes)}
 
     fetch_ep = runtime.namespace(namespace).component(component).endpoint("kv_fetch")
     await fetch_ep.serve(kv_fetch_handler)

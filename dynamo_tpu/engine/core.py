@@ -43,6 +43,7 @@ from dynamo_tpu.engine.block_allocator import DeviceBlockAllocator, OutOfBlocksE
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.fair_queue import FairQueue
 from dynamo_tpu.runtime.engine import EngineOverloadedError
+from dynamo_tpu.runtime import wire
 from dynamo_tpu.engine.model import (
     decode_tokens,
     embed_forward,
@@ -3839,8 +3840,9 @@ class EngineCore:
                 h = seq.pinned_hashes[i]
                 descs.append(
                     {
-                        "hash": h, "parent": parent, "shape": shape,
-                        "dtype": dtype, "layout": layout,
+                        wire.IMP_HASH: h, wire.IMP_PARENT: parent,
+                        wire.IMP_SHAPE: shape, wire.IMP_DTYPE: dtype,
+                        wire.IMP_LAYOUT: layout,
                     }
                 )
                 parent = h
@@ -3989,9 +3991,11 @@ class EngineCore:
         local_dtype = np.dtype(self.cfg.jax_dtype)
         staged: list[tuple[int, int | None, Any]] = []
         for blk in blocks:
-            shape = tuple(blk["shape"])
+            shape = tuple(blk[wire.IMP_SHAPE])
             if shape != expected:
-                kind = (blk.get("layout") or {}).get("kind", "combined_kv_page")
+                kind = (blk.get(wire.IMP_LAYOUT) or {}).get(
+                    "kind", "combined_kv_page"
+                )
                 if kind != "combined_kv_page":
                     raise ValueError(
                         f"unknown producer KV layout {kind!r}; cannot relayout"
@@ -4009,7 +4013,7 @@ class EngineCore:
                     f"incompatible KV page geometry {shape} vs local "
                     f"{expected} (different model config?)"
                 )
-            wire_dtype = str(blk["dtype"])
+            wire_dtype = str(blk[wire.IMP_DTYPE])
             if (wire_dtype == "int8") != self.engine.kv_quantized:
                 raise ValueError(
                     f"KV dtype mismatch: producer pages are {wire_dtype!r} "
@@ -4020,18 +4024,18 @@ class EngineCore:
                 )
             if self.engine.kv_quantized:
                 page = self._stage_page(
-                    np.frombuffer(blk["kv"], np.uint8)
+                    np.frombuffer(blk[wire.IMP_KV], np.uint8)
                 )  # validates the packed size against local geometry
             else:
                 dtype = np.dtype(wire_dtype)
-                page = np.frombuffer(blk["kv"], dtype=dtype).reshape(shape)
+                page = np.frombuffer(blk[wire.IMP_KV], dtype=dtype).reshape(shape)
                 if dtype != local_dtype:
                     # Cross-precision fleet (e.g. bf16 prefill feeding an
                     # fp32 debug decode): cast on host rather than letting
                     # the scatter silently promote the whole cache.
                     page = page.astype(local_dtype)
                 page = page[None]
-            staged.append((blk["hash"], blk["parent"], page))
+            staged.append((blk[wire.IMP_HASH], blk[wire.IMP_PARENT], page))
 
         with self._step_lock:
             ids: list[int] = []
@@ -4118,7 +4122,7 @@ class EngineCore:
             pending: list[tuple[int, int, int | None]] = []
             skipped = 0
             for row, d in enumerate(descs):
-                if self.allocator.is_cached(d["hash"]):
+                if self.allocator.is_cached(d[wire.IMP_HASH]):
                     skipped += 1
                     continue
                 try:
@@ -4127,7 +4131,7 @@ class EngineCore:
                     break
                 ids.append(bid)
                 src_ids.append(all_src_ids[row])
-                pending.append((bid, d["hash"], d["parent"]))
+                pending.append((bid, d[wire.IMP_HASH], d[wire.IMP_PARENT]))
             if ids:
                 self.cache = self._copy_pages_from(
                     src.cache,

@@ -22,6 +22,7 @@ from typing import Awaitable, Callable
 import msgpack
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import wire
 from dynamo_tpu.runtime.component import Endpoint, discovery_stale_grace
 from dynamo_tpu.runtime.store import StoreClient, Subscription
 
@@ -168,7 +169,7 @@ class ModelWatcher:
         async for ev in self._watch:
             event = StoreClient.as_watch_event(ev)
             try:
-                if event.type == "put":
+                if event.type == wire.EV_PUT:
                     await self._on_put(event)
                 else:
                     await self._on_delete(event)

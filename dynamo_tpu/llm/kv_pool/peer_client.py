@@ -29,23 +29,15 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import time
 from dataclasses import dataclass, field
 
-from dynamo_tpu.runtime import chaos
+from dynamo_tpu import knobs
+from dynamo_tpu.runtime import chaos, wire
 from dynamo_tpu.runtime.dataplane import BreakerOpenError
 from dynamo_tpu.tokens import compute_seq_hashes
 
 log = logging.getLogger("dynamo_tpu.kv_pool.peer")
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    try:
-        return float(raw) if raw is not None else default
-    except ValueError:
-        return default
 
 
 # EWMA weight for per-peer cost samples: heavy enough that a peer
@@ -134,12 +126,12 @@ class PeerKvClient:
         self.frame_timeout_s = (
             frame_timeout_s
             if frame_timeout_s is not None
-            else _env_float("DYN_KV_POOL_FRAME_TIMEOUT_S", 10.0)
+            else knobs.get_float("DYN_KV_POOL_FRAME_TIMEOUT_S")
         )
         self.total_timeout_s = (
             total_timeout_s
             if total_timeout_s is not None
-            else _env_float("DYN_KV_POOL_PULL_TIMEOUT_S", 30.0)
+            else knobs.get_float("DYN_KV_POOL_PULL_TIMEOUT_S")
         )
         self.chunk_blocks = chunk_blocks
         self.stats = PeerPullStats()
@@ -178,7 +170,8 @@ class PeerKvClient:
             if chaos.active():
                 await chaos.inject("kv_transfer.pull", str(hint.get("worker_id")))
             stream = await self.fetch_client.direct(
-                hint["worker_id"], {"hashes": want, "chunk_blocks": self.chunk_blocks}
+                hint["worker_id"],
+                {wire.KV_HASHES: want, wire.KV_CHUNK_BLOCKS: self.chunk_blocks},
             )
             while True:
                 remaining = deadline - time.monotonic()
@@ -193,21 +186,23 @@ class PeerKvClient:
                     )
                 except StopAsyncIteration:
                     break
-                if "shape" in frame:
-                    shape = list(frame["shape"])
-                    dtype = frame["dtype"]
-                if "kv" not in frame:
+                if wire.KV_SHAPE in frame:
+                    shape = list(frame[wire.KV_SHAPE])
+                    dtype = frame[wire.KV_DTYPE]
+                if wire.KV_DONE in frame:
+                    break  # trailer: the peer sent everything it holds
+                if wire.KV_PAGES not in frame:
                     continue
-                s = frame["start"]
+                s = frame[wire.KV_START]
                 blocks = []
-                for j, kv in enumerate(frame["kv"]):
+                for j, kv in enumerate(frame[wire.KV_PAGES]):
                     gi = start + s + j
                     blocks.append({
-                        "hash": hashes[gi],
-                        "parent": hashes[gi - 1] if gi > 0 else None,
-                        "shape": shape,
-                        "dtype": dtype,
-                        "kv": kv,
+                        wire.IMP_HASH: hashes[gi],
+                        wire.IMP_PARENT: hashes[gi - 1] if gi > 0 else None,
+                        wire.IMP_SHAPE: shape,
+                        wire.IMP_DTYPE: dtype,
+                        wire.IMP_KV: kv,
                     })
                     st.bytes_pulled += len(kv)
                 res = await asyncio.to_thread(core.import_blocks, blocks)

@@ -163,15 +163,14 @@ class KvIndexer:
     ``cleared`` + full-inventory re-publish."""
 
     def __init__(self, store, subject: str, resync_subject: str | None = None):
-        import os
-
+        from dynamo_tpu import knobs
         from dynamo_tpu.llm.kv_pool.global_index import GlobalKvIndex
 
         self._store = store
         self._subject = subject
         self._resync_subject = resync_subject
         inner: RadixTree
-        if os.environ.get("DYNAMO_TPU_NO_NATIVE"):
+        if knobs.raw("DYNAMO_TPU_NO_NATIVE"):
             inner = RadixTree()
         else:
             try:

@@ -45,6 +45,8 @@ import weakref
 from collections import deque
 from typing import Any
 
+from dynamo_tpu import knobs
+
 log = logging.getLogger("dynamo_tpu.obs.flight")
 
 # Keys stripped recursively from every dumped record: the artifact must
@@ -65,14 +67,11 @@ _DUMP_COOLDOWN_S = 1.0
 
 
 def _env_capacity() -> int:
-    try:
-        return max(0, int(os.environ.get("DYN_FLIGHT_STEPS", "256")))
-    except ValueError:
-        return 256
+    return max(0, knobs.get_int("DYN_FLIGHT_STEPS"))
 
 
 def artifact_dir() -> str:
-    return os.environ.get("DYN_FLIGHT_DIR") or os.path.join(
+    return knobs.get_str("DYN_FLIGHT_DIR") or os.path.join(
         tempfile.gettempdir(), "dynamo_flight"
     )
 

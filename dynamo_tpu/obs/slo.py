@@ -26,10 +26,11 @@ spray cannot grow the aggregator's /metrics without bound.
 from __future__ import annotations
 
 import logging
-import os
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
+
+from dynamo_tpu import knobs
 
 log = logging.getLogger("dynamo_tpu.obs.slo")
 
@@ -60,27 +61,19 @@ SLO_TPOT_BUCKETS = (
 )
 
 
-def _env_ms(name: str, default_s: float) -> float:
-    try:
-        raw = os.environ.get(name)
-        return float(raw) / 1e3 if raw else default_s
-    except ValueError:
-        return default_s
-
-
 @dataclass(frozen=True)
 class SloTargets:
     """Attainment targets (defaults mirror the planner's SlaTargets;
     override via DYN_SLO_TTFT_MS / DYN_SLO_TPOT_MS)."""
 
-    ttft_s: float = 0.2
-    tpot_s: float = 0.05
+    ttft_s: float = knobs.default("DYN_SLO_TTFT_MS") / 1e3
+    tpot_s: float = knobs.default("DYN_SLO_TPOT_MS") / 1e3
 
     @classmethod
     def from_env(cls) -> "SloTargets":
         return cls(
-            ttft_s=_env_ms("DYN_SLO_TTFT_MS", cls.ttft_s),
-            tpot_s=_env_ms("DYN_SLO_TPOT_MS", cls.tpot_s),
+            ttft_s=knobs.get_float("DYN_SLO_TTFT_MS") / 1e3,
+            tpot_s=knobs.get_float("DYN_SLO_TPOT_MS") / 1e3,
         )
 
 

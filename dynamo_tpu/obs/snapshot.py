@@ -28,6 +28,8 @@ from typing import Any, Callable
 
 import msgpack
 
+from dynamo_tpu.runtime import wire
+
 log = logging.getLogger("dynamo_tpu.obs.snapshot")
 
 
@@ -75,36 +77,36 @@ class MetricSnapshot:
 
     def to_wire(self) -> bytes:
         d: dict[str, Any] = {
-            "w": self.worker_id,
-            "r": self.role,
-            "c": self.component,
-            "s": self.seq,
-            "t": self.t,
-            "e": self.epoch,
-            "f": self.families,
-            "tn": self.tenants,
-            "ph": {k: [c, s] for k, (c, s) in self.phases.items()},
-            "rq": self.requests,
+            wire.SNAP_WORKER: self.worker_id,
+            wire.SNAP_ROLE: self.role,
+            wire.SNAP_COMPONENT: self.component,
+            wire.SNAP_SEQ: self.seq,
+            wire.SNAP_TIME: self.t,
+            wire.SNAP_EPOCH: self.epoch,
+            wire.SNAP_FAMILIES: self.families,
+            wire.SNAP_TENANTS: self.tenants,
+            wire.SNAP_PHASES: {k: [c, s] for k, (c, s) in self.phases.items()},
+            wire.SNAP_REQUESTS: self.requests,
         }
         if self.retired:
-            d["x"] = 1
+            d[wire.SNAP_RETIRED] = 1
         return msgpack.packb(d, use_bin_type=True)
 
     @classmethod
     def from_wire(cls, raw: bytes) -> "MetricSnapshot":
         d = msgpack.unpackb(raw, raw=False)
         return cls(
-            worker_id=d["w"],
-            role=d.get("r", "worker"),
-            component=d.get("c", ""),
-            seq=d.get("s", 0),
-            t=d.get("t", 0.0),
-            epoch=d.get("e", 0.0),
-            retired=bool(d.get("x", 0)),
-            families=d.get("f", {}),
-            tenants=d.get("tn", {}),
-            phases={k: (v[0], v[1]) for k, v in (d.get("ph") or {}).items()},
-            requests=list(d.get("rq") or []),
+            worker_id=d[wire.SNAP_WORKER],
+            role=d.get(wire.SNAP_ROLE, "worker"),
+            component=d.get(wire.SNAP_COMPONENT, ""),
+            seq=d.get(wire.SNAP_SEQ, 0),
+            t=d.get(wire.SNAP_TIME, 0.0),
+            epoch=d.get(wire.SNAP_EPOCH, 0.0),
+            retired=bool(d.get(wire.SNAP_RETIRED, 0)),
+            families=d.get(wire.SNAP_FAMILIES, {}),
+            tenants=d.get(wire.SNAP_TENANTS, {}),
+            phases={k: (v[0], v[1]) for k, v in (d.get(wire.SNAP_PHASES) or {}).items()},
+            requests=list(d.get(wire.SNAP_REQUESTS) or []),
         )
 
 

@@ -21,12 +21,13 @@ constrains the last two dims), and tensor parallelism shards axis 0.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from dynamo_tpu import knobs
 
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -335,7 +336,7 @@ def paged_attention(
     the XLA path fuses the dequant into its gather."""
     if (
         jax.default_backend() == "tpu"
-        and os.environ.get("DYNAMO_TPU_PAGED_ATTN", "xla") == "pallas"
+        and knobs.get_str("DYNAMO_TPU_PAGED_ATTN") == "pallas"
         and pallas_supported(q.shape[-1], block_size, k_cache.dtype)
     ):
         return paged_attention_pallas(

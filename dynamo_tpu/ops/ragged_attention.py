@@ -44,19 +44,15 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 # kernel's own defaults; tools/profile_decode.py + PERF.md). Long-context
 # calls use the kernel's tuned table instead. Env-overridable for on-chip
 # tuning sweeps; 0 = always use the kernel's defaults.
-import os as _os
+from dynamo_tpu import knobs as _knobs
 
-_DECODE_KV_PAGES_PER_BLOCK = int(
-    _os.environ.get("DYNAMO_TPU_ATTN_PAGES_PER_BLOCK", 8)
-)
-_DECODE_QUERIES_PER_BLOCK = int(
-    _os.environ.get("DYNAMO_TPU_ATTN_QUERIES_PER_BLOCK", 8)
-)
+_DECODE_KV_PAGES_PER_BLOCK = _knobs.get_int("DYNAMO_TPU_ATTN_PAGES_PER_BLOCK")
+_DECODE_QUERIES_PER_BLOCK = _knobs.get_int("DYNAMO_TPU_ATTN_QUERIES_PER_BLOCK")
 # Prefill-shaped calls: bound the query block explicitly — the kernel's
 # own tuned table can pick whole-wave q blocks that blow the scoped-VMEM
 # limit (16 MB on v5e under the axon runtime) at T >= 2048.
-_PREFILL_QUERIES_PER_BLOCK = int(
-    _os.environ.get("DYNAMO_TPU_ATTN_PREFILL_QUERIES_PER_BLOCK", 128)
+_PREFILL_QUERIES_PER_BLOCK = _knobs.get_int(
+    "DYNAMO_TPU_ATTN_PREFILL_QUERIES_PER_BLOCK"
 )
 
 

@@ -26,15 +26,9 @@ import sys
 import time
 from typing import Sequence
 
+from dynamo_tpu import knobs
+
 log = logging.getLogger("dynamo_tpu.planner.connector")
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    try:
-        return float(raw) if raw is not None else default
-    except ValueError:
-        return default
 
 
 class LocalProcessConnector:
@@ -58,7 +52,7 @@ class LocalProcessConnector:
         self.worker_argv = {k: list(v) for k, v in worker_argv.items()}
         self.env = env or {}
         if drain_timeout_s is None:
-            drain_timeout_s = _env_float("DYN_WORKER_DRAIN_TIMEOUT_S", 30.0) + 5.0
+            drain_timeout_s = knobs.get_float("DYN_WORKER_DRAIN_TIMEOUT_S") + 5.0
         self.drain_timeout_s = drain_timeout_s
         self._procs: dict[str, list[subprocess.Popen]] = {}
         # Scaled-down children pending exit: (proc, SIGKILL-escalation

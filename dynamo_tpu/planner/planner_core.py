@@ -15,6 +15,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Protocol
 
+from dynamo_tpu import knobs
 from dynamo_tpu.planner.load_predictor import PREDICTORS, BasePredictor
 from dynamo_tpu.planner.perf_interpolation import DecodeInterpolator, PrefillInterpolator
 
@@ -23,8 +24,8 @@ log = logging.getLogger("dynamo_tpu.planner")
 
 @dataclass
 class SlaTargets:
-    ttft_s: float = 0.2
-    itl_s: float = 0.05
+    ttft_s: float = knobs.default("DYN_SLO_TTFT_MS") / 1e3
+    itl_s: float = knobs.default("DYN_SLO_TPOT_MS") / 1e3
 
     @classmethod
     def from_env(cls) -> "SlaTargets":
@@ -33,18 +34,9 @@ class SlaTargets:
         targets across attribution and autoscaling, so ``/fleet``
         attainment and the controller's scaling pressure can never judge
         against different budgets."""
-        import os
-
-        def ms(name: str, default_s: float) -> float:
-            raw = os.environ.get(name)
-            try:
-                return float(raw) / 1e3 if raw is not None else default_s
-            except ValueError:
-                return default_s
-
         return cls(
-            ttft_s=ms("DYN_SLO_TTFT_MS", cls.ttft_s),
-            itl_s=ms("DYN_SLO_TPOT_MS", cls.itl_s),
+            ttft_s=knobs.get_float("DYN_SLO_TTFT_MS") / 1e3,
+            itl_s=knobs.get_float("DYN_SLO_TPOT_MS") / 1e3,
         )
 
 

@@ -68,11 +68,12 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import os
 import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
+
+from dynamo_tpu import knobs
 
 log = logging.getLogger("dynamo_tpu.chaos")
 
@@ -236,7 +237,7 @@ class ChaosPlan:
     def from_env(cls, env: str = CHAOS_PLAN_ENV) -> "ChaosPlan | None":
         """Build a plan from ``$DYN_CHAOS_PLAN`` (inline JSON, or
         ``@/path/to/plan.json``); None when unset/empty."""
-        raw = os.environ.get(env, "").strip()
+        raw = (knobs.raw(env) or "").strip()
         if not raw:
             return None
         if raw.startswith("@"):

@@ -27,6 +27,8 @@ from typing import Any, AsyncIterator, Callable
 
 import msgpack
 
+from dynamo_tpu import knobs
+from dynamo_tpu.runtime import wire
 from dynamo_tpu.runtime.dataplane import EgressClient, Handler, IngressServer, ResponseStream
 from dynamo_tpu.runtime.store import StoreClient, Subscription
 from dynamo_tpu.runtime.store.client import StoreError
@@ -35,7 +37,7 @@ from dynamo_tpu.runtime.tasks import spawn_logged
 log = logging.getLogger("dynamo_tpu.runtime")
 
 INSTANCE_ROOT = "/dynamo/instances"
-DEFAULT_STORE_ADDRESS = os.environ.get("DYN_STORE_ADDRESS", "127.0.0.1:6650")
+DEFAULT_STORE_ADDRESS = knobs.get_str("DYN_STORE_ADDRESS")
 
 # Degraded-mode discovery (ISSUE 15): how long a consumer may keep
 # serving on a cached instance whose lease the control plane declared
@@ -44,7 +46,6 @@ DEFAULT_STORE_ADDRESS = os.environ.get("DYN_STORE_ADDRESS", "127.0.0.1:6650")
 # lease-expiry delete is honored immediately (the pre-ISSUE-15 behavior,
 # where a store blackout collapses routing a TTL later).
 DISCOVERY_STALE_GRACE_ENV = "DYN_DISCOVERY_STALE_GRACE_S"
-DEFAULT_DISCOVERY_STALE_GRACE_S = 30.0
 # One quarantine liveness probe's dial budget.
 DISCOVERY_PROBE_TIMEOUT_S = 1.0
 # First re-judgment delay for a lease-expiry delete the egress pool has
@@ -56,11 +57,7 @@ DISCOVERY_PROBE_SOON_S = 0.2
 
 
 def discovery_stale_grace() -> float:
-    raw = os.environ.get(DISCOVERY_STALE_GRACE_ENV)
-    try:
-        return float(raw) if raw is not None else DEFAULT_DISCOVERY_STALE_GRACE_S
-    except ValueError:
-        return DEFAULT_DISCOVERY_STALE_GRACE_S
+    return knobs.get_float(DISCOVERY_STALE_GRACE_ENV)
 
 
 @dataclass(frozen=True)
@@ -79,12 +76,12 @@ class Instance:
     def to_wire(self) -> bytes:
         return msgpack.packb(
             {
-                "ns": self.namespace,
-                "comp": self.component,
-                "ep": self.endpoint,
-                "id": self.instance_id,
-                "addr": self.address,
-                "meta": self.metadata,
+                wire.INST_NS: self.namespace,
+                wire.INST_COMPONENT: self.component,
+                wire.INST_ENDPOINT: self.endpoint,
+                wire.INST_ID: self.instance_id,
+                wire.INST_ADDR: self.address,
+                wire.INST_META: self.metadata,
             }
         )
 
@@ -92,12 +89,12 @@ class Instance:
     def from_wire(cls, raw: bytes) -> "Instance":
         d = msgpack.unpackb(raw, raw=False)
         return cls(
-            namespace=d["ns"],
-            component=d["comp"],
-            endpoint=d["ep"],
-            instance_id=d["id"],
-            address=d["addr"],
-            metadata=d.get("meta"),
+            namespace=d[wire.INST_NS],
+            component=d[wire.INST_COMPONENT],
+            endpoint=d[wire.INST_ENDPOINT],
+            instance_id=d[wire.INST_ID],
+            address=d[wire.INST_ADDR],
+            metadata=d.get(wire.INST_META),
         )
 
 
@@ -395,7 +392,7 @@ class EndpointClient:
         async for ev in self._watch:
             event = StoreClient.as_watch_event(ev)
             instance_id = int(event.key.rsplit("/", 1)[-1], 16)
-            if event.type == "put":
+            if event.type == wire.EV_PUT:
                 inst = Instance.from_wire(event.value)
                 known = instance_id in self.instances
                 self.instances[instance_id] = inst
@@ -414,7 +411,7 @@ class EndpointClient:
                 inst = self.instances.get(instance_id)
                 if inst is None:
                     continue  # duplicate delete — nothing to retire
-                if event.reason == "lease" and self.stale_grace_s > 0:
+                if event.reason == wire.EV_R_LEASE and self.stale_grace_s > 0:
                     # Synchronous judgment only — the watch loop must
                     # never dial (a mass lease expiry would serialize
                     # probe timeouts ahead of replacement-worker puts).

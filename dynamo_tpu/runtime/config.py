@@ -12,8 +12,13 @@ import json
 import os
 from dataclasses import dataclass, field, fields
 
+from dynamo_tpu import knobs
+
 
 def _env(name: str, default, cast=None):
+    # Fallbacks here are non-literal (file-overlaid RuntimeConfig field
+    # values), which is exactly why this wrapper survives next to
+    # dynamo_tpu.knobs: env beats file beats registry default.
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -25,29 +30,29 @@ def _env(name: str, default, cast=None):
 
 @dataclass
 class RuntimeConfig:
-    store_address: str = "127.0.0.1:6650"
-    lease_ttl_s: float = 10.0
-    ingress_host: str = "127.0.0.1"
-    namespace: str = "dynamo"
+    store_address: str = knobs.default("DYN_STORE_ADDRESS")
+    lease_ttl_s: float = knobs.default("DYN_RUNTIME_LEASE_TTL_S")
+    ingress_host: str = knobs.default("DYN_RUNTIME_INGRESS_HOST")
+    namespace: str = knobs.default("DYN_NAMESPACE")
     # System status server (health/metrics), 0 port = ephemeral, None = off
-    system_enabled: bool = True
-    system_port: int = 0
+    system_enabled: bool = knobs.default("DYN_SYSTEM_ENABLED")
+    system_port: int = knobs.default("DYN_SYSTEM_PORT")
     # Logging
-    logging_jsonl: bool = False
-    log_level: str = "INFO"
+    logging_jsonl: bool = knobs.default("DYN_LOGGING_JSONL")
+    log_level: str = knobs.default("DYN_LOG_LEVEL")
     # Request tracing (dynamo_tpu/tracing): DYN_TRACE_* prefix
-    trace_enabled: bool = True
-    trace_sample: float = 1.0
-    trace_buffer: int = 4096
+    trace_enabled: bool = knobs.default("DYN_TRACE_ENABLED")
+    trace_sample: float = knobs.default("DYN_TRACE_SAMPLE")
+    trace_buffer: int = knobs.default("DYN_TRACE_BUFFER")
     # Graceful drain budget on SIGTERM: how long in-flight streams get
     # to finish after the worker deregisters from discovery. Stragglers
     # past the budget are killed (peers migrate them by token replay).
-    drain_timeout_s: float = 30.0
+    drain_timeout_s: float = knobs.default("DYN_WORKER_DRAIN_TIMEOUT_S")
 
     @classmethod
     def from_env(cls, config_file: str | None = None) -> "RuntimeConfig":
         base: dict = {}
-        path = config_file or os.environ.get("DYN_RUNTIME_CONFIG")
+        path = config_file or knobs.raw("DYN_RUNTIME_CONFIG")
         if path and os.path.exists(path):
             with open(path) as f:
                 base = json.load(f)

@@ -9,7 +9,8 @@ request frame carrying a control header + payload (the two-part codec,
 One fewer network hop and no broker on the hot path — on TPU pods the
 request plane is latency-critical for disaggregation handoffs.
 
-Frames (framing.py codec):
+Frames (framing.py codec; key constants in runtime/wire.py, schema
+``dataplane`` — checked by dynacheck's wire-contract rule):
   client→server:  {"t":"req","i":id,"m":"ns/comp/ep","h":{...},"p":payload}
                   {"t":"stop","i":id}            (graceful cancel)
                   {"t":"kill","i":id}            (hard cancel)
@@ -68,12 +69,12 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-import os
 import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable
 
-from dynamo_tpu.runtime import chaos, framing
+from dynamo_tpu import knobs
+from dynamo_tpu.runtime import chaos, framing, wire
 from dynamo_tpu.runtime import engine as _engine_errors
 from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
 from dynamo_tpu.runtime.tasks import spawn_logged
@@ -125,44 +126,30 @@ SHED_WIRE = _engine_errors.SHED_WIRE
 DEADLINE_WIRE = _engine_errors.DEADLINE_WIRE
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    try:
-        return float(raw) if raw is not None else default
-    except ValueError:
-        return default
-
-
 @dataclass
 class EgressPolicy:
-    """Client-side containment knobs (env-overridable per process)."""
+    """Client-side containment knobs (env-overridable per process).
+    Defaults live in the central knob registry (dynamo_tpu/knobs.py)."""
 
     # Dial deadline for one egress connect.
-    connect_s: float = 5.0
+    connect_s: float = knobs.default("DYN_DATAPLANE_CONNECT_TIMEOUT_S")
     # Per-frame stall deadline on a response stream: maximum time a
     # consumer waits for the NEXT frame before the stream is declared
     # stalled and synthesized into a ConnectionError. <= 0 disables.
-    stall_s: float | None = 60.0
+    stall_s: float | None = knobs.default("DYN_DATAPLANE_STALL_TIMEOUT_S")
     # Circuit breaker: consecutive failures to open; cooldown before the
     # half-open probe.
-    breaker_threshold: int = 5
-    breaker_reset_s: float = 2.0
+    breaker_threshold: int = knobs.default("DYN_DATAPLANE_BREAKER_THRESHOLD")
+    breaker_reset_s: float = knobs.default("DYN_DATAPLANE_BREAKER_RESET_S")
 
     @classmethod
     def from_env(cls) -> "EgressPolicy":
-        d = cls()  # fallbacks come from the field defaults above
-        stall = _env_float(
-            "DYN_DATAPLANE_STALL_TIMEOUT_S", d.stall_s if d.stall_s else 0.0
-        )
+        stall = knobs.get_float("DYN_DATAPLANE_STALL_TIMEOUT_S")
         return cls(
-            connect_s=_env_float("DYN_DATAPLANE_CONNECT_TIMEOUT_S", d.connect_s),
+            connect_s=knobs.get_float("DYN_DATAPLANE_CONNECT_TIMEOUT_S"),
             stall_s=None if stall <= 0 else stall,
-            breaker_threshold=int(
-                _env_float("DYN_DATAPLANE_BREAKER_THRESHOLD", d.breaker_threshold)
-            ),
-            breaker_reset_s=_env_float(
-                "DYN_DATAPLANE_BREAKER_RESET_S", d.breaker_reset_s
-            ),
+            breaker_threshold=knobs.get_int("DYN_DATAPLANE_BREAKER_THRESHOLD"),
+            breaker_reset_s=knobs.get_float("DYN_DATAPLANE_BREAKER_RESET_S"),
         )
 
 
@@ -334,30 +321,31 @@ class IngressServer:
                 # death surfaces as EOF.
                 # dynalint: unbounded-ok — server read loop idles between frames
                 msg = await framing.read_frame(reader)
-                kind = msg.get("t")
-                if kind == "req":
+                kind = msg.get(wire.DP_TYPE)
+                if kind == wire.DP_T_REQ:
                     if self.draining:
                         async with write_lock:
                             await framing.send_frame(
                                 writer,
-                                {"t": "err", "i": msg["i"], "err": DRAINING_ERR},
+                                {wire.DP_TYPE: wire.DP_T_ERR, wire.DP_ID: msg[wire.DP_ID],
+                                 wire.DP_ERR: DRAINING_ERR},
                             )
                         continue
-                    key = (conn_id, msg["i"])
+                    key = (conn_id, msg[wire.DP_ID])
                     ctx = Context(
-                        request_id=msg.get("h", {}).get("x-request-id"),
-                        headers=msg.get("h", {}),
+                        request_id=msg.get(wire.DP_HEADERS, {}).get("x-request-id"),
+                        headers=msg.get(wire.DP_HEADERS, {}),
                     )
                     task = asyncio.create_task(
                         self._serve_one(writer, write_lock, key, msg, ctx)
                     )
                     self._inflight[key] = (task, ctx)
                     self._idle.clear()
-                elif kind in ("stop", "kill"):
-                    entry = self._inflight.get((conn_id, msg["i"]))
+                elif kind in (wire.DP_T_STOP, wire.DP_T_KILL):
+                    entry = self._inflight.get((conn_id, msg[wire.DP_ID]))
                     if entry is not None:
                         task, ctx = entry
-                        if kind == "kill":
+                        if kind == wire.DP_T_KILL:
                             ctx.kill()
                             task.cancel()
                         else:
@@ -383,20 +371,22 @@ class IngressServer:
         msg: dict,
         ctx: Context,
     ) -> None:
-        req_id = msg["i"]
+        req_id = msg[wire.DP_ID]
 
         async def send(frame: dict) -> None:
             async with write_lock:
                 await framing.send_frame(writer, frame)
 
         try:
-            handler = self._routes.get(msg["m"])
+            handler = self._routes.get(msg[wire.DP_ROUTE])
             if handler is None:
-                await send({"t": "err", "i": req_id, "err": f"no route {msg['m']!r}"})
+                await send({wire.DP_TYPE: wire.DP_T_ERR, wire.DP_ID: req_id,
+                            wire.DP_ERR: f"no route {msg[wire.DP_ROUTE]!r}"})
                 return
-            async for item in handler(msg.get("p"), ctx):
-                await send({"t": "rsp", "i": req_id, "p": item})
-            await send({"t": "end", "i": req_id})
+            async for item in handler(msg.get(wire.DP_PAYLOAD), ctx):
+                await send({wire.DP_TYPE: wire.DP_T_RSP, wire.DP_ID: req_id,
+                            wire.DP_PAYLOAD: item})
+            await send({wire.DP_TYPE: wire.DP_T_END, wire.DP_ID: req_id})
         except asyncio.CancelledError:
             raise
         except ConnectionError:
@@ -406,15 +396,16 @@ class IngressServer:
             # DeadlineExceededError) serialize their canonical wire
             # marker so the client maps them back; they are expected
             # load-shedding behavior, logged at info, not exception.
-            wire = getattr(e, "wire", None)
-            if wire:
-                log.info("handler %s shed request: %s", msg.get("m"), e)
-                payload = f"{wire}: {e}"
+            wire_code = getattr(e, "wire", None)
+            if wire_code:
+                log.info("handler %s shed request: %s", msg.get(wire.DP_ROUTE), e)
+                payload = f"{wire_code}: {e}"
             else:
-                log.exception("handler %s failed", msg.get("m"))
+                log.exception("handler %s failed", msg.get(wire.DP_ROUTE))
                 payload = f"{type(e).__name__}: {e}"
             try:
-                await send({"t": "err", "i": req_id, "err": payload})
+                await send({wire.DP_TYPE: wire.DP_T_ERR, wire.DP_ID: req_id,
+                            wire.DP_ERR: payload})
             except ConnectionError:
                 pass
         finally:
@@ -481,14 +472,14 @@ class ResponseStream:
 
     async def stop(self) -> None:
         """Graceful cancel: worker finishes current state and ends stream."""
-        await self._conn.send({"t": "stop", "i": self._req_id})
+        await self._conn.send({wire.DP_TYPE: wire.DP_T_STOP, wire.DP_ID: self._req_id})
 
     async def kill(self) -> None:
         # Deregister first: a killed server task sends no end frame, so
         # leaving the entry would leak one registry slot per kill (and a
         # late frame racing the kill must be discarded, not delivered).
         self._conn._streams.pop(self._req_id, None)
-        await self._conn.send({"t": "kill", "i": self._req_id})
+        await self._conn.send({wire.DP_TYPE: wire.DP_T_KILL, wire.DP_ID: self._req_id})
         self._push(self._END)
 
     async def kill_quietly(self) -> None:
@@ -552,7 +543,11 @@ class _EgressConn:
         # against the send must already carry the instance id.
         stream.worker_id = worker_id
         self._streams[req_id] = stream
-        await self.send({"t": "req", "i": req_id, "m": route, "h": headers, "p": payload})
+        await self.send({
+            wire.DP_TYPE: wire.DP_T_REQ, wire.DP_ID: req_id,
+            wire.DP_ROUTE: route, wire.DP_HEADERS: headers,
+            wire.DP_PAYLOAD: payload,
+        })
         return stream
 
     def abandon(self, req_id: int) -> None:
@@ -571,7 +566,7 @@ class _EgressConn:
 
     async def _kill_quietly(self, req_id: int) -> None:
         try:
-            await self.send({"t": "kill", "i": req_id})
+            await self.send({wire.DP_TYPE: wire.DP_T_KILL, wire.DP_ID: req_id})
         except (ConnectionError, OSError):
             pass  # the conn died under us; the server reaps on EOF
 
@@ -587,24 +582,24 @@ class _EgressConn:
                     "dataplane.recv", self.address
                 ):
                     continue  # frame dropped by the active chaos plan
-                stream = self._streams.get(msg["i"])
+                stream = self._streams.get(msg[wire.DP_ID])
                 if stream is None:
                     continue
-                kind = msg["t"]
-                if kind == "rsp":
-                    stream._push(msg["p"])
-                elif kind == "end":
+                kind = msg[wire.DP_TYPE]
+                if kind == wire.DP_T_RSP:
+                    stream._push(msg[wire.DP_PAYLOAD])
+                elif kind == wire.DP_T_END:
                     stream._push(ResponseStream._END)
-                    self._streams.pop(msg["i"], None)
-                elif kind == "err":
-                    if msg["err"] == DRAINING_ERR:
+                    self._streams.pop(msg[wire.DP_ID], None)
+                elif kind == wire.DP_T_ERR:
+                    if msg[wire.DP_ERR] == DRAINING_ERR:
                         # Graceful drain refusal: retryable, not a
                         # request failure — migration replays elsewhere.
                         err: Exception = ConnectionError(
                             f"worker at {self.address} is draining"
                         )
                         err.worker_id = stream.worker_id  # type: ignore[attr-defined]
-                    elif msg["err"].startswith(SHED_WIRE):
+                    elif msg[wire.DP_ERR].startswith(SHED_WIRE):
                         # Overload shed: same retryable shape as the
                         # drain refusal — migration retries the request
                         # on a less-loaded instance.
@@ -613,14 +608,14 @@ class _EgressConn:
                             f"{msg['err']}"
                         )
                         err.worker_id = stream.worker_id  # type: ignore[attr-defined]
-                    elif msg["err"].startswith(DEADLINE_WIRE):
+                    elif msg[wire.DP_ERR].startswith(DEADLINE_WIRE):
                         # Deadline expiry is typed but NOT retryable via
                         # migration — the budget is already spent.
-                        err = DeadlineExceededError(msg["err"])
+                        err = DeadlineExceededError(msg[wire.DP_ERR])
                     else:
-                        err = EngineStreamError(msg["err"])
+                        err = EngineStreamError(msg[wire.DP_ERR])
                     stream._push(err)
-                    self._streams.pop(msg["i"], None)
+                    self._streams.pop(msg[wire.DP_ID], None)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:

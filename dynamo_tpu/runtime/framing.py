@@ -2,8 +2,8 @@
 
 Every control-plane and data-plane connection speaks the same codec:
 a 4-byte big-endian length prefix followed by one msgpack-encoded message.
-Messages are dicts with short keys (see store/server.py and dataplane.py for
-the schemas).
+Messages are dicts with short keys; the per-plane key constants and
+schemas live in :mod:`dynamo_tpu.runtime.wire`.
 
 Capability parity: reference `lib/runtime/src/pipeline/network/codec/
 two_part.rs` (TwoPartMessage: control header + payload in one frame). We get

@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
-from dynamo_tpu.runtime import chaos, framing
+from dynamo_tpu.runtime import chaos, framing, wire
 
 log = logging.getLogger("dynamo_tpu.store.client")
 
@@ -219,18 +219,18 @@ class StoreClient:
                     "store.frame", self.address
                 ):
                     continue  # frame dropped by the active chaos plan
-                if "s" in msg:  # server push
-                    sub = self._subs.get(msg["s"])
+                if wire.ST_PUSH_SUB in msg:  # server push
+                    sub = self._subs.get(msg[wire.ST_PUSH_SUB])
                     if sub is not None:
-                        sub.queue.put_nowait(msg["ev"])
+                        sub.queue.put_nowait(msg[wire.ST_EVENT])
                     continue
-                fut = self._pending.pop(msg["i"], None)
+                fut = self._pending.pop(msg[wire.ST_ID], None)
                 if fut is None or fut.done():
                     continue
-                if msg["ok"]:
-                    fut.set_result(msg["r"])
+                if msg[wire.ST_OK]:
+                    fut.set_result(msg[wire.ST_RESULT])
                 else:
-                    fut.set_exception(StoreError(msg["err"]))
+                    fut.set_exception(StoreError(msg[wire.ST_ERR]))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         except OSError:
@@ -292,10 +292,10 @@ class StoreClient:
             while pending:
                 sub, (op, params) = pending[0]
                 r = await self._request(op, **params)
-                sub.sub_id = r["sub"]
-                self._subs[r["sub"]] = sub
-                self._sub_meta[r["sub"]] = (op, params)
-                for ev in r.get("initial") or []:
+                sub.sub_id = r[wire.ST_SUB]
+                self._subs[r[wire.ST_SUB]] = sub
+                self._sub_meta[r[wire.ST_SUB]] = (op, params)
+                for ev in r.get(wire.ST_INITIAL) or []:
                     sub.queue.put_nowait(ev)
                 pending.pop(0)
             # Leases next: replayed KV entries reference them.
@@ -348,7 +348,10 @@ class StoreClient:
         fut: asyncio.Future[Any] = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         async with self._send_lock:
-            await framing.send_frame(self._writer, {"i": req_id, "op": op, **params})
+            await framing.send_frame(
+                self._writer,
+                {wire.ST_ID: req_id, wire.ST_OP: op, **params},
+            )
         return await fut
 
     # -- KV ----------------------------------------------------------------
@@ -365,11 +368,11 @@ class StoreClient:
             # A permanent overwrite supersedes any earlier lease-bound
             # value; replaying the stale entry would resurrect it.
             self._leased_kv.pop(key, None)
-        return r["rev"]
+        return r[wire.ST_REV]
 
     async def kv_get(self, key: str) -> bytes | None:
         r = await self._request("kv_get", k=key)
-        return None if r is None else r["v"]
+        return None if r is None else r[wire.ST_VALUE]
 
     async def kv_del(self, key: str) -> int:
         self._leased_kv.pop(key, None)
@@ -377,24 +380,28 @@ class StoreClient:
 
     async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]:
         r = await self._request("kv_get_prefix", k=prefix)
-        return {e["k"]: e["v"] for e in r}
+        return {e[wire.ST_KEY]: e[wire.ST_VALUE] for e in r}
 
     async def kv_watch(self, prefix: str, with_initial: bool = True) -> Subscription:
         r = await self._request("kv_watch", k=prefix, with_initial=with_initial)
-        sub = Subscription(self, r["sub"])
-        self._subs[r["sub"]] = sub
-        self._sub_meta[r["sub"]] = (
-            "kv_watch", {"k": prefix, "with_initial": with_initial}
+        sub = Subscription(self, r[wire.ST_SUB])
+        self._subs[r[wire.ST_SUB]] = sub
+        self._sub_meta[r[wire.ST_SUB]] = (
+            "kv_watch", {wire.ST_KEY: prefix, wire.ST_WITH_INITIAL: with_initial}
         )
-        for ev in r["initial"]:
+        for ev in r[wire.ST_INITIAL]:
             sub.queue.put_nowait(ev)
         return sub
 
     @staticmethod
     def as_watch_event(ev: dict) -> WatchEvent:
         return WatchEvent(
-            type=ev["t"], key=ev["k"], value=ev["v"], revision=ev["rev"],
-            reason=ev.get("r", "del" if ev["t"] == "delete" else ""),
+            type=ev[wire.EV_TYPE], key=ev[wire.EV_KEY],
+            value=ev[wire.EV_VALUE], revision=ev[wire.EV_REV],
+            reason=ev.get(
+                wire.EV_REASON,
+                wire.EV_R_DEL if ev[wire.EV_TYPE] == wire.EV_DELETE else "",
+            ),
         )
 
     # -- leases ------------------------------------------------------------
@@ -404,8 +411,10 @@ class StoreClient:
         ``ttl`` (deleting its keys) and is deliberately NOT replayed on
         store reconnect — the one-shot reply-key pattern, where replay
         would resurrect a key the consumer already deleted."""
-        r = await self._request("lease_grant", ttl=ttl)
-        lease_id = r["lease"]
+        # conn_bound is the server default, sent explicitly so the wire
+        # contract has a producer for the key (dynacheck wire-contract).
+        r = await self._request("lease_grant", ttl=ttl, conn_bound=True)
+        lease_id = r[wire.ST_LEASE]
         if keepalive:
             self._lease_meta[lease_id] = (ttl, keepalive)
             self._keepalive_tasks[lease_id] = asyncio.create_task(
@@ -474,9 +483,9 @@ class StoreClient:
 
     async def subscribe(self, subject: str) -> Subscription:
         r = await self._request("sub", subject=subject)
-        sub = Subscription(self, r["sub"])
-        self._subs[r["sub"]] = sub
-        self._sub_meta[r["sub"]] = ("sub", {"subject": subject})
+        sub = Subscription(self, r[wire.ST_SUB])
+        self._subs[r[wire.ST_SUB]] = sub
+        self._sub_meta[r[wire.ST_SUB]] = ("sub", {wire.ST_SUBJECT: subject})
         return sub
 
     async def publish(self, subject: str, payload: bytes) -> int:
@@ -493,7 +502,7 @@ class StoreClient:
 
     @staticmethod
     def as_message(ev: dict) -> Message:
-        return Message(subject=ev["subject"], payload=ev["p"])
+        return Message(subject=ev[wire.EV_SUBJECT], payload=ev[wire.EV_PAYLOAD])
 
     # -- work queues -------------------------------------------------------
 

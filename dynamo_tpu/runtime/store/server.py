@@ -22,8 +22,10 @@ when the owning connection drops — the latter gives sub-second worker-death
 detection (faster than etcd's TTL-only model) and is what request migration
 keys off.
 
-Wire protocol (framing.py): requests ``{"i": id, "op": str, ...}`` →
-responses ``{"i": id, "ok": bool, "r"/"err": ...}``; server-push events
+Wire protocol (framing.py; key constants in runtime/wire.py, schemas
+``store`` + ``store.event`` — checked by dynacheck's wire-contract
+rule): requests ``{"i": id, "op": str, ...}`` → responses
+``{"i": id, "ok": bool, "r"/"err": ...}``; server-push events
 ``{"s": sub_id, "ev": {...}}``.
 """
 
@@ -36,7 +38,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any
 
-from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime import framing, wire
 
 log = logging.getLogger("dynamo_tpu.store")
 
@@ -162,9 +164,11 @@ class StoreServer:
                 msg = await framing.read_frame(reader)
                 try:
                     result = await self._dispatch(conn, msg)
-                    conn.push({"i": msg["i"], "ok": True, "r": result})
+                    conn.push({wire.ST_ID: msg[wire.ST_ID], wire.ST_OK: True,
+                               wire.ST_RESULT: result})
                 except Exception as e:  # noqa: BLE001 — report op errors to client
-                    conn.push({"i": msg["i"], "ok": False, "err": str(e)})
+                    conn.push({wire.ST_ID: msg[wire.ST_ID], wire.ST_OK: False,
+                               wire.ST_ERR: str(e)})
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             pass
         finally:
@@ -196,7 +200,7 @@ class StoreServer:
     # -- op dispatch -------------------------------------------------------
 
     async def _dispatch(self, conn: _Conn, msg: dict) -> Any:
-        op = msg["op"]
+        op = msg[wire.ST_OP]
         handler = getattr(self, f"_op_{op}", None)
         if handler is None:
             raise ValueError(f"unknown op {op!r}")
@@ -209,20 +213,21 @@ class StoreServer:
     ) -> None:
         for sub in self._subs.values():
             if sub.kind == "watch" and key.startswith(sub.pattern):
-                ev = {"t": event, "k": key, "v": value, "rev": rev}
+                ev = {wire.EV_TYPE: event, wire.EV_KEY: key,
+                      wire.EV_VALUE: value, wire.EV_REV: rev}
                 if reason:
                     # Delete provenance: "lease" (expiry / conn-death
                     # revoke — a liveness *judgment* degraded-mode
                     # consumers may second-guess against the data plane)
                     # vs "del" (an explicit retraction, always honored).
-                    ev["r"] = reason
-                sub.conn.push({"s": sub.sub_id, "ev": ev})
+                    ev[wire.EV_REASON] = reason
+                sub.conn.push({wire.ST_PUSH_SUB: sub.sub_id, wire.ST_EVENT: ev})
 
     async def _op_kv_put(self, conn: _Conn, msg: dict) -> dict:
-        key, value = msg["k"], msg["v"]
-        lease_id = msg.get("lease", 0)
+        key, value = msg[wire.ST_KEY], msg[wire.ST_VALUE]
+        lease_id = msg.get(wire.ST_LEASE, 0)
         existing = self._kv.get(key)
-        if msg.get("create_only") and existing is not None:
+        if msg.get(wire.ST_CREATE_ONLY) and existing is not None:
             raise ValueError(f"key exists: {key}")
         if lease_id:
             lease = self._leases.get(lease_id)
@@ -236,50 +241,52 @@ class StoreServer:
             create_rev=existing.create_rev if existing else self._rev,
             mod_rev=self._rev,
         )
-        self._notify_kv("put", key, value, self._rev)
-        return {"rev": self._rev}
+        self._notify_kv(wire.EV_PUT, key, value, self._rev)
+        return {wire.ST_REV: self._rev}
 
     async def _op_kv_get(self, conn: _Conn, msg: dict) -> dict | None:
-        entry = self._kv.get(msg["k"])
+        entry = self._kv.get(msg[wire.ST_KEY])
         if entry is None:
             return None
-        return {"v": entry.value, "rev": entry.mod_rev, "lease": entry.lease_id}
+        return {wire.ST_VALUE: entry.value, wire.ST_REV: entry.mod_rev,
+                wire.ST_LEASE: entry.lease_id}
 
     async def _op_kv_del(self, conn: _Conn, msg: dict) -> int:
-        return self._delete_key(msg["k"])
+        return self._delete_key(msg[wire.ST_KEY])
 
-    def _delete_key(self, key: str, reason: str = "del") -> int:
+    def _delete_key(self, key: str, reason: str = wire.EV_R_DEL) -> int:
         entry = self._kv.pop(key, None)
         if entry is None:
             return 0
         if entry.lease_id and entry.lease_id in self._leases:
             self._leases[entry.lease_id].keys.discard(key)
         self._rev += 1
-        self._notify_kv("delete", key, b"", self._rev, reason=reason)
+        self._notify_kv(wire.EV_DELETE, key, b"", self._rev, reason=reason)
         return 1
 
     async def _op_kv_get_prefix(self, conn: _Conn, msg: dict) -> list:
-        prefix = msg["k"]
+        prefix = msg[wire.ST_KEY]
         return [
-            {"k": k, "v": e.value, "rev": e.mod_rev}
+            {wire.ST_KEY: k, wire.ST_VALUE: e.value, wire.ST_REV: e.mod_rev}
             for k, e in sorted(self._kv.items())
             if k.startswith(prefix)
         ]
 
     async def _op_kv_watch(self, conn: _Conn, msg: dict) -> dict:
         sub_id = self._new_id()
-        self._subs[sub_id] = _Sub(sub_id, conn, "watch", msg["k"])
+        self._subs[sub_id] = _Sub(sub_id, conn, "watch", msg[wire.ST_KEY])
         initial = []
-        if msg.get("with_initial", True):
+        if msg.get(wire.ST_WITH_INITIAL, True):
             initial = [
-                {"t": "put", "k": k, "v": e.value, "rev": e.mod_rev}
+                {wire.EV_TYPE: wire.EV_PUT, wire.EV_KEY: k,
+                 wire.EV_VALUE: e.value, wire.EV_REV: e.mod_rev}
                 for k, e in sorted(self._kv.items())
-                if k.startswith(msg["k"])
+                if k.startswith(msg[wire.ST_KEY])
             ]
-        return {"sub": sub_id, "initial": initial}
+        return {wire.ST_SUB: sub_id, wire.ST_INITIAL: initial}
 
     async def _op_unsub(self, conn: _Conn, msg: dict) -> bool:
-        return self._subs.pop(msg["sub"], None) is not None
+        return self._subs.pop(msg[wire.ST_SUB], None) is not None
 
     # -- leases ------------------------------------------------------------
 
@@ -289,9 +296,9 @@ class StoreServer:
         return i
 
     async def _op_lease_grant(self, conn: _Conn, msg: dict) -> dict:
-        ttl = float(msg.get("ttl", 10.0))
-        conn_bound = bool(msg.get("conn_bound", True))
-        want = msg.get("want")
+        ttl = float(msg.get(wire.ST_TTL, 10.0))
+        conn_bound = bool(msg.get(wire.ST_CONN_BOUND, True))
+        want = msg.get(wire.ST_WANT)
         if want:
             # Reconnect re-attach: adopt an existing lease (connection
             # blip) or recreate it under the same id (server restart) —
@@ -303,7 +310,7 @@ class StoreServer:
             if existing is not None:
                 existing.conn_id = conn.conn_id if conn_bound else 0
                 existing.deadline = time.monotonic() + existing.ttl_s
-                return {"lease": lease_id, "ttl": existing.ttl_s}
+                return {wire.ST_LEASE: lease_id, wire.ST_TTL: existing.ttl_s}
         else:
             lease_id = self._new_id()
         self._leases[lease_id] = _Lease(
@@ -312,24 +319,24 @@ class StoreServer:
             deadline=time.monotonic() + ttl,
             conn_id=conn.conn_id if conn_bound else 0,
         )
-        return {"lease": lease_id, "ttl": ttl}
+        return {wire.ST_LEASE: lease_id, wire.ST_TTL: ttl}
 
     async def _op_lease_keepalive(self, conn: _Conn, msg: dict) -> dict:
-        lease = self._leases.get(msg["lease"])
+        lease = self._leases.get(msg[wire.ST_LEASE])
         if lease is None:
-            raise ValueError(f"no such lease {msg['lease']}")
+            raise ValueError(f"no such lease {msg[wire.ST_LEASE]}")
         lease.deadline = time.monotonic() + lease.ttl_s
-        return {"ttl": lease.ttl_s}
+        return {wire.ST_TTL: lease.ttl_s}
 
     async def _op_lease_revoke(self, conn: _Conn, msg: dict) -> bool:
-        return self._revoke_lease(msg["lease"])
+        return self._revoke_lease(msg[wire.ST_LEASE])
 
     def _revoke_lease(self, lease_id: int) -> bool:
         lease = self._leases.pop(lease_id, None)
         if lease is None:
             return False
         for key in list(lease.keys):
-            self._delete_key(key, reason="lease")
+            self._delete_key(key, reason=wire.EV_R_LEASE)
         return True
 
     async def _sweep_loop(self) -> None:
@@ -344,22 +351,26 @@ class StoreServer:
 
     async def _op_sub(self, conn: _Conn, msg: dict) -> dict:
         sub_id = self._new_id()
-        self._subs[sub_id] = _Sub(sub_id, conn, "sub", msg["subject"])
-        return {"sub": sub_id}
+        self._subs[sub_id] = _Sub(sub_id, conn, "sub", msg[wire.ST_SUBJECT])
+        return {wire.ST_SUB: sub_id}
 
     async def _op_pub(self, conn: _Conn, msg: dict) -> int:
-        subject, payload = msg["subject"], msg["p"]
+        subject, payload = msg[wire.ST_SUBJECT], msg[wire.ST_PAYLOAD]
         n = 0
         for sub in self._subs.values():
             if sub.kind == "sub" and subject_matches(sub.pattern, subject):
-                sub.conn.push({"s": sub.sub_id, "ev": {"subject": subject, "p": payload}})
+                sub.conn.push({
+                    wire.ST_PUSH_SUB: sub.sub_id,
+                    wire.ST_EVENT: {wire.EV_SUBJECT: subject,
+                                    wire.EV_PAYLOAD: payload},
+                })
                 n += 1
         return n
 
     # -- work queues -------------------------------------------------------
 
     async def _op_q_push(self, conn: _Conn, msg: dict) -> int:
-        name, payload = msg["q"], msg["p"]
+        name, payload = msg[wire.ST_QUEUE], msg[wire.ST_PAYLOAD]
         waiters = self._queue_waiters[name]
         while waiters:
             fut = waiters.popleft()
@@ -370,8 +381,8 @@ class StoreServer:
         return len(self._queues[name])
 
     async def _op_q_pop(self, conn: _Conn, msg: dict) -> bytes | None:
-        name = msg["q"]
-        timeout = msg.get("timeout", 0.0)
+        name = msg[wire.ST_QUEUE]
+        timeout = msg.get(wire.ST_TIMEOUT, 0.0)
         queue = self._queues[name]
         if queue:
             return queue.popleft()
@@ -385,22 +396,22 @@ class StoreServer:
             return None
 
     async def _op_q_len(self, conn: _Conn, msg: dict) -> int:
-        return len(self._queues[msg["q"]])
+        return len(self._queues[msg[wire.ST_QUEUE]])
 
     # -- object store ------------------------------------------------------
 
     async def _op_obj_put(self, conn: _Conn, msg: dict) -> bool:
-        self._objects[msg["b"]][msg["name"]] = msg["p"]
+        self._objects[msg[wire.ST_BUCKET]][msg[wire.ST_NAME]] = msg[wire.ST_PAYLOAD]
         return True
 
     async def _op_obj_get(self, conn: _Conn, msg: dict) -> bytes | None:
-        return self._objects.get(msg["b"], {}).get(msg["name"])
+        return self._objects.get(msg[wire.ST_BUCKET], {}).get(msg[wire.ST_NAME])
 
     async def _op_obj_del(self, conn: _Conn, msg: dict) -> bool:
-        return self._objects.get(msg["b"], {}).pop(msg["name"], None) is not None
+        return self._objects.get(msg[wire.ST_BUCKET], {}).pop(msg[wire.ST_NAME], None) is not None
 
     async def _op_obj_list(self, conn: _Conn, msg: dict) -> list[str]:
-        return sorted(self._objects.get(msg["b"], {}).keys())
+        return sorted(self._objects.get(msg[wire.ST_BUCKET], {}).keys())
 
     async def _op_ping(self, conn: _Conn, msg: dict) -> str:
         return "pong"

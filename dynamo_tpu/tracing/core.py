@@ -32,7 +32,6 @@ mirrored in runtime/config.py RuntimeConfig):
 
 from __future__ import annotations
 
-import os
 import secrets
 import threading
 import time
@@ -41,6 +40,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterable
 
+from dynamo_tpu import knobs
 from dynamo_tpu.runtime.logging_setup import TRACEPARENT_HEADER, parse_traceparent
 
 __all__ = [
@@ -473,27 +473,11 @@ class _State:
     __slots__ = ("enabled", "sample", "collector")
 
     def __init__(self) -> None:
-        self.enabled = os.environ.get("DYN_TRACE_ENABLED", "1").lower() not in (
-            "0", "false", "no", "off",
-        )
-        self.sample = _env_float("DYN_TRACE_SAMPLE", 1.0)
+        self.enabled = knobs.get_bool("DYN_TRACE_ENABLED")
+        self.sample = knobs.get_float("DYN_TRACE_SAMPLE")
         self.collector = TraceCollector(
-            capacity=_env_int("DYN_TRACE_BUFFER", 4096)
+            capacity=max(1, knobs.get_int("DYN_TRACE_BUFFER"))
         )
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, default)))
-    except ValueError:
-        return default
 
 
 _STATE = _State()

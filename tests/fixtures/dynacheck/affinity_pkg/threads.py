@@ -1,0 +1,24 @@
+"""Fixture for loop-affinity: a loop-owned ring buffer touched from a
+``to_thread`` context two call-graph hops down, next to a healthy
+on-loop write of the same attribute."""
+
+import asyncio
+
+
+class Publisher:
+    def __init__(self):
+        self._ringbuf = []
+
+    async def start(self):
+        await asyncio.to_thread(self._drain_blocking)
+
+    def _drain_blocking(self):
+        self._flush()
+
+    def _flush(self):
+        # Reached from the thread spawned in start(): the violation.
+        self._ringbuf.append("drained")
+
+    def publish(self, item):
+        # On-loop write of the same buffer: must stay quiet.
+        self._ringbuf.append(item)

@@ -1,0 +1,31 @@
+"""Fixture knob registry: four knobs, one never read, one undocumented."""
+
+import os
+
+PREFIXES = ("FIX_",)
+
+
+class Knob:
+    def __init__(self, name, default, kind, section, doc):
+        self.name = name
+        self.default = default
+        self.kind = kind
+        self.section = section
+        self.doc = doc
+
+
+def _freeze(*knobs):
+    return {k.name: k for k in knobs}
+
+
+KNOBS = _freeze(
+    Knob("FIX_ALPHA", "a", "str", "s", "alpha knob"),
+    Knob("FIX_BETA", 1, "int", "s", "beta knob"),
+    Knob("FIX_DEAD", 0, "int", "s", "registered but read nowhere"),
+    Knob("FIX_SECRET", "", "str", "s", "registered but undocumented"),
+)
+
+
+def get(name):
+    knob = KNOBS[name]
+    return os.environ.get(knob.name, knob.default)

@@ -1,0 +1,26 @@
+"""Fixture knob reads: clean accessor reads next to every violation
+shape the config-knob rule exists for."""
+
+import os
+
+from tests.fixtures.dynacheck.knob_pkg import knobs
+
+BETA = "FIX_BETA"
+
+
+def _env(name, fallback):
+    # Registry-backed wrapper: call sites carry the knob names.
+    v = os.environ.get(name)
+    return v if v is not None else fallback
+
+
+def load(cfg):
+    a = knobs.get("FIX_ALPHA")                  # clean
+    b = knobs.get(BETA)                         # clean, via module constant
+    s = knobs.get("FIX_SECRET")                 # clean read; doc is missing
+    g = knobs.get("FIX_GHOST")                  # unregistered
+    direct = os.environ.get("FIX_DIRECT", "7")  # bypass + unregistered
+    dup = _env("FIX_ALPHA", "dup-default")      # literal duplicate default
+    dyn = os.environ.get("FIX_" + cfg.suffix)   # unresolvable, no pragma
+    ok = os.environ.get(cfg.plugin_env)  # dynacheck: knob-dynamic(plugin-chosen name)
+    return a, b, s, g, direct, dup, dyn, ok

@@ -1,0 +1,17 @@
+"""Fixture plane file: produces an orphan key, consumes a ghost key, and
+backslides into a raw string literal at a send site."""
+
+from tests.fixtures.dynacheck.wire_pkg import wire
+
+
+async def emit(sock):
+    frame = {wire.A_TYPE: "req", wire.A_BODY: b"x", wire.A_ORPHAN: 1}
+    await sock.send(frame)
+    # Raw literal "b" where wire.A_BODY belongs — the backslide shape.
+    yield {wire.A_TYPE: "rsp", "b": b"raw"}
+
+
+def parse(frame):
+    if wire.A_GHOST in frame:
+        return frame[wire.A_BODY]
+    return frame.get(wire.A_TYPE)

@@ -1,0 +1,26 @@
+"""Fixture wire registry: two planes sharing one parse context, with a
+deliberately conflicting key meaning and an unused key."""
+
+A_TYPE = "t"
+A_BODY = "b"
+A_ORPHAN = "o"
+A_GHOST = "g"
+B_TYPE = "t"      # same key string, same context, different meaning
+B_UNUSED = "u"
+
+SCHEMAS = {
+    "alpha": {
+        "A_TYPE": "frame discriminator",
+        "A_BODY": "payload bytes",
+        "A_ORPHAN": "produced but never consumed",
+        "A_GHOST": "consumed but never produced",
+    },
+    "beta": {
+        "B_TYPE": "retry budget",
+        "B_UNUSED": "registered but never referenced",
+    },
+}
+
+CONTEXTS = {"alpha": "shared-envelope", "beta": "shared-envelope"}
+
+VALUES = {}

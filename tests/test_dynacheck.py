@@ -27,6 +27,10 @@ from tools.dynacheck.interproc import run_all                   # noqa: E402
 from tools.dynacheck.models.allocator import AllocatorModel     # noqa: E402
 from tools.dynacheck.models.breaker import BreakerModel         # noqa: E402
 from tools.dynacheck.models.cursor import CursorModel           # noqa: E402
+from tools.dynacheck.models.keepalive import KeepaliveModel     # noqa: E402
+from tools.dynacheck.models.planner import PlannerModel         # noqa: E402
+from tools.dynacheck.models.quarantine import QuarantineModel   # noqa: E402
+from dynamo_tpu.planner.controller import PlannerController     # noqa: E402
 
 FIXTURES = REPO / "tests" / "fixtures" / "dynacheck"
 
@@ -85,16 +89,27 @@ def test_pragma_inventory_is_pinned():
     )
 
 
+# Per-model floor on the explored state count: exhaustion with a
+# suspiciously small space usually means the action set silently shrank.
+# keepalive is a compact boolean protocol — its whole space IS small.
+MODEL_FLOORS = {
+    "allocator": 100, "cursor": 100, "breaker": 100,
+    "quarantine": 100, "keepalive": 5, "planner": 100,
+}
+
+
 def test_models_exhaust_their_state_spaces():
     # The bounded exploration genuinely covers everything reachable: the
-    # frontier empties before the depth bound for all three models, so
+    # frontier empties before the depth bound for all six models, so
     # "no violation" means no violation anywhere, not "none within an
     # arbitrary horizon".
     rep = tree_report()
-    assert {m.name for m in rep.models} == {"allocator", "cursor", "breaker"}
+    assert {m.name for m in rep.models} == set(MODEL_FLOORS)
     for m in rep.models:
         assert m.exhausted, f"{m.name}: depth bound hit before exhaustion"
-        assert m.states > 100, f"{m.name}: suspiciously small state space"
+        assert m.states > MODEL_FLOORS[m.name], (
+            f"{m.name}: suspiciously small state space ({m.states})"
+        )
 
 
 def test_call_graph_covers_the_engine():
@@ -194,6 +209,66 @@ def test_real_guarded_by_registry_has_no_drift():
     # CI if an entry rots — this asserts today's registry is sound.
     rep = tree_report()
     assert not [f for f in rep.findings if f.rule == C.RULE_REGISTRY_DRIFT]
+
+
+def test_wire_contract_detected(monkeypatch):
+    monkeypatch.setattr(
+        C, "WIRE_SCHEMA_FILE", "fixtures/dynacheck/wire_pkg/wire.py"
+    )
+    monkeypatch.setattr(
+        C, "WIRE_PLANE_FILES",
+        {"fixtures/dynacheck/wire_pkg/frames.py": ("alpha", "beta")},
+    )
+    findings = fixture_findings(["wire_pkg/wire.py", "wire_pkg/frames.py"])
+    wirefs = [f for f in findings if f.rule == C.RULE_WIRE_CONTRACT]
+    msgs = " | ".join(f.message for f in wirefs)
+    assert "A_ORPHAN" in msgs and "produced here but consumed nowhere" in msgs
+    assert "A_GHOST" in msgs and "consumed here but produced nowhere" in msgs
+    assert "raw string literal 'b'" in msgs        # send-site backslide
+    assert "conflicting meaning" in msgs           # cross-plane 't' collision
+    assert "B_UNUSED" in msgs                      # registered, unreferenced
+    # The healthy produced+consumed pair stays quiet.
+    assert "A_BODY is" not in msgs and "A_TYPE is" not in msgs
+
+
+def test_loop_affinity_detected(monkeypatch):
+    monkeypatch.setattr(
+        C, "LOOP_AFFINE",
+        {"fixtures/dynacheck/affinity_pkg/threads.py": {
+            ("Publisher", "_ringbuf"): "fixture ring buffer",
+        }},
+    )
+    findings = fixture_findings(["affinity_pkg/threads.py"])
+    aff = [f for f in findings if f.rule == C.RULE_LOOP_AFFINITY]
+    assert len(aff) == 1, [str(f) for f in findings]
+    msg = aff[0].message
+    assert "_flush" in msg and "_drain_blocking" in msg
+    # The on-loop write in publish() must stay quiet.
+    assert "publish" not in msg
+
+
+def test_config_knobs_detected(monkeypatch):
+    monkeypatch.setattr(
+        C, "KNOB_REGISTRY_FILE", "fixtures/dynacheck/knob_pkg/knobs.py"
+    )
+    monkeypatch.setattr(
+        C, "KNOB_DOC_FILE", "tests/fixtures/dynacheck/knob_pkg/README.md"
+    )
+    findings = fixture_findings(["knob_pkg/knobs.py", "knob_pkg/reader.py"])
+    knob = [f for f in findings if f.rule == C.RULE_CONFIG_KNOB]
+    msgs = " | ".join(f.message for f in knob)
+    assert "'FIX_GHOST' is read here but not registered" in msgs
+    assert "'FIX_DIRECT' bypasses the registry" in msgs
+    assert "literal default for 'FIX_ALPHA'" in msgs
+    assert "dynamically-built name" in msgs
+    assert "FIX_DEAD is registered but read nowhere" in msgs
+    assert "FIX_SECRET is registered but undocumented" in msgs
+    assert "documents FIX_ROT" in msgs
+    # Exactly one unresolvable-name finding: the pragma'd read next to it
+    # is suppressed.
+    assert sum("dynamically-built" in f.message for f in knob) == 1
+    # Clean reads (literal, module-constant) stay quiet.
+    assert "FIX_BETA" not in msgs
 
 
 # ---------------------------------------------------------------------------
@@ -353,6 +428,83 @@ def test_breaker_model_catches_cancelled_probe_wedge():
     assert any("wedged" in str(v) for v in res.violations)
 
 
+class _RearmForeverQuarantineModel(QuarantineModel):
+    """A due sweep that re-arms even when the probe says dead: the
+    quarantine-forever bug the expiry path exists to prevent."""
+
+    name = "quarantine-rearm-forever"
+    sweep_rearms_dead = True
+
+
+def test_quarantine_model_catches_rearm_forever():
+    m = _RearmForeverQuarantineModel()
+    m.max_depth = 10
+    res = explore(m)
+    assert res.violations, "re-arm-forever survived the quarantine invariants"
+    assert any("quarantined forever" in str(v) for v in res.violations)
+
+
+class _NoCancelKeepaliveModel(KeepaliveModel):
+    """A reconnect that starts a fresh keepalive task without cancelling
+    the old one: the double-beat bug."""
+
+    name = "keepalive-no-cancel"
+    cancel_before_restart = False
+
+
+def test_keepalive_model_catches_double_task():
+    res = explore(_NoCancelKeepaliveModel())
+    assert res.violations, "double keepalive survived the invariants"
+    assert any("tasks=2" in str(v) or "keepalive tasks" in str(v)
+               for v in res.violations)
+
+
+class _FreshIdKeepaliveModel(KeepaliveModel):
+    """A resurrection that re-grants WITHOUT ``want=old id``: the server
+    hands out a fresh id, orphaning the client's meta and leased-kv
+    records."""
+
+    name = "keepalive-fresh-id"
+    regrant_with_want = False
+
+
+def test_keepalive_model_catches_fresh_id_regrant():
+    res = explore(_FreshIdKeepaliveModel())
+    assert res.violations, "fresh-id re-grant survived the invariants"
+    assert any("same_id=False" in str(v) or "different id" in str(v)
+               for v in res.violations)
+
+
+class _NoGuardController(PlannerController):
+    """PlannerController._decide with every guard rail deleted: no
+    cooldowns, no hysteresis streak."""
+
+    def _decide(self, pool, desired, now, reason):
+        if desired > pool.target:
+            pool.target = min(desired, pool.target + self.config.max_step_up)
+            pool.last_scale_up_t = now
+            return self._note(pool, "scale_up", reason)
+        if desired < pool.target:
+            pool.target = max(desired, pool.target - self.config.max_step_down)
+            pool.last_scale_down_t = now
+            return self._note(pool, "scale_down", reason)
+        return self._note(pool, "hold", reason)
+
+
+class _NoGuardPlannerModel(PlannerModel):
+    name = "planner-no-guards"
+    controller_cls = _NoGuardController
+
+
+def test_planner_model_catches_missing_guard_rails():
+    m = _NoGuardPlannerModel()
+    m.max_depth = 6
+    res = explore(m)
+    assert res.violations, "guard-rail removal survived the planner invariants"
+    msgs = " | ".join(str(v) for v in res.violations)
+    assert "cooldown" in msgs or "below-target cycle" in msgs
+
+
 # ---------------------------------------------------------------------------
 # Determinism + runtime budget + cache + CLI.
 # ---------------------------------------------------------------------------
@@ -403,6 +555,42 @@ def test_cli_rejects_unknown_rule():
 
 def test_cli_rejects_missing_path():
     assert main([str(REPO / "no_such_dir_xyz")]) == 2
+
+
+def test_cache_key_tracks_readme(tmp_path):
+    # The config-knob rule reads the README, so a doc edit must miss.
+    f1 = tmp_path / "a.py"
+    f1.write_text("x = 1\n")
+    (tmp_path / "README.md").write_text("docs v1\n")
+    k1 = CA.tree_key([f1], tmp_path)
+    (tmp_path / "README.md").write_text("docs v2\n")
+    k2 = CA.tree_key([f1], tmp_path)
+    assert k1 != k2
+
+
+def test_knobs_md_matches_readme_block():
+    # The README's generated block IS the emitter's output (the CI
+    # knob-drift gate, exercised in-process).
+    from tools.dynacheck.__main__ import KNOBS_BEGIN, KNOBS_END, knobs_markdown
+
+    want = knobs_markdown()
+    text = (REPO / "README.md").read_text(encoding="utf-8")
+    begin, end = text.find(KNOBS_BEGIN), text.find(KNOBS_END)
+    assert begin >= 0 and end > begin, "README lacks the knobs markers"
+    assert text[begin:end + len(KNOBS_END)] + "\n" == want
+
+
+def test_knob_table_covers_every_registered_knob():
+    from dynamo_tpu import knobs
+    from tools.dynacheck.__main__ import knobs_markdown
+
+    table = knobs_markdown()
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table, f"{name} missing from the knob table"
+
+
+def test_cli_knob_drift_exits_clean():
+    assert main(["--knob-drift"]) == 0
 
 
 def test_malformed_pragma_is_a_finding(tmp_path):

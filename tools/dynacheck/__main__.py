@@ -8,6 +8,11 @@ invariant violations), 1 on findings/violations, 2 on usage errors.
 a comma-separated subset; ``--pragmas`` prints the in-source suppression
 inventory (what tests/test_dynacheck.py pins); ``--no-cache`` bypasses
 the source-hash keyed Engine A cache.
+
+``--knobs-md`` emits the generated README knob table (the block between
+the ``<!-- knobs:begin -->`` / ``<!-- knobs:end -->`` markers);
+``--knob-drift`` exits 1 if the README block differs from what
+``--knobs-md`` would emit — the CI drift gate.
 """
 
 from __future__ import annotations
@@ -63,6 +68,68 @@ def run(
     return report
 
 
+KNOBS_BEGIN = "<!-- knobs:begin -->"
+KNOBS_END = "<!-- knobs:end -->"
+
+
+def knobs_markdown() -> str:
+    """The generated knob table, markers included.
+
+    This is the one place the checker imports product code — the table
+    documents runtime behavior, so it renders from the live registry
+    (stdlib-only module, import is side-effect free). The static
+    config-knob rule never does this.
+    """
+    from dynamo_tpu import knobs
+
+    lines = [
+        KNOBS_BEGIN,
+        "<!-- generated: python -m tools.dynacheck --knobs-md; "
+        "CI fails on drift (--knob-drift) -->",
+        "| Knob | Default | Type | What it does |",
+        "|---|---|---|---|",
+    ]
+    section = None
+    for k in sorted(knobs.KNOBS.values(), key=lambda k: (k.section, k.name)):
+        if k.section != section:
+            section = k.section
+            lines.append(f"| **{section}** | | | |")
+        default = f"`{k.default}`" if k.default != "" else "*(empty)*"
+        lines.append(f"| `{k.name}` | {default} | {k.kind} | {k.doc} |")
+    lines.append(KNOBS_END)
+    return "\n".join(lines) + "\n"
+
+
+def knob_drift(repo_root: Path) -> int:
+    want = knobs_markdown()
+    readme = repo_root / "README.md"
+    try:
+        text = readme.read_text(encoding="utf-8")
+    except OSError:
+        print("knob-drift: README.md not found", file=sys.stderr)
+        return 1
+    begin = text.find(KNOBS_BEGIN)
+    end = text.find(KNOBS_END)
+    if begin < 0 or end < 0:
+        print(
+            f"knob-drift: README.md lacks the {KNOBS_BEGIN} / {KNOBS_END} "
+            "markers — paste the --knobs-md output between them",
+            file=sys.stderr,
+        )
+        return 1
+    have = text[begin : end + len(KNOBS_END)] + "\n"
+    if have != want:
+        print(
+            "knob-drift: README.md knob table is stale — regenerate with "
+            "`python -m tools.dynacheck --knobs-md` and paste it between "
+            "the markers",
+            file=sys.stderr,
+        )
+        return 1
+    print("knob-drift: README.md knob table matches the registry")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.dynacheck",
@@ -82,7 +149,21 @@ def main(argv: list[str] | None = None) -> int:
         help="also list every dynacheck suppression pragma in the tree",
     )
     ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument(
+        "--knobs-md", action="store_true",
+        help="print the generated README knob table and exit",
+    )
+    ap.add_argument(
+        "--knob-drift", action="store_true",
+        help="exit 1 if the README knob table differs from --knobs-md",
+    )
     args = ap.parse_args(argv)
+
+    if args.knobs_md:
+        sys.stdout.write(knobs_markdown())
+        return 0
+    if args.knob_drift:
+        return knob_drift(Path(__file__).resolve().parents[2])
 
     rules = None
     if args.rules:

@@ -23,7 +23,7 @@ from tools.dynacheck.callgraph import Pragma
 from tools.dynacheck.interproc import Finding
 
 CACHE_DIR = ".dynacheck_cache"
-_VERSION = 1
+_VERSION = 2
 
 
 def tree_key(files: list[Path], repo_root: Path) -> str:
@@ -31,6 +31,11 @@ def tree_key(files: list[Path], repo_root: Path) -> str:
     tool_dir = Path(__file__).resolve().parent
     tool_files = sorted(tool_dir.rglob("*.py"))
     tool_files += sorted((tool_dir.parent / "dynalint").rglob("*.py"))
+    # The config-knob rule reads the README (doc-coverage check), so a
+    # doc edit must miss the cache too.
+    readme = repo_root / "README.md"
+    if readme.is_file():
+        tool_files.append(readme)
     for f in tool_files + sorted(files):
         try:
             rel = f.resolve().relative_to(repo_root.resolve()).as_posix()

@@ -87,6 +87,10 @@ class FuncInfo:
     is_generator: bool = False
     holds_pragmas: frozenset[str] = frozenset()
     calls: list[CallSite] = field(default_factory=list)
+    # Callables handed to thread contexts (to_thread / run_in_executor /
+    # submit / Thread(target=...)): resolved like calls; loop-affinity
+    # BFS roots.
+    spawn_sites: list[CallSite] = field(default_factory=list)
     lock_acquires: list[LockAcquire] = field(default_factory=list)
     writes: list[AttrWrite] = field(default_factory=list)
     # Direct blocking sites inside THIS function's own body (line, what).
@@ -113,6 +117,11 @@ class Project:
     locks: dict[LockId, tuple[str, int]] = field(default_factory=dict)
     # callers index (filled by resolve): func key -> [(caller key, CallSite)]
     callers: dict[str, list[tuple[str, CallSite]]] = field(default_factory=dict)
+    # parsed module per file (wire/knob rules re-walk these; NOT cached
+    # — the cache stores findings only)
+    trees: dict[str, ast.Module] = field(default_factory=dict)
+    # per-file import map: local name -> dotted target (module or obj)
+    imports_by_file: dict[str, dict[str, str]] = field(default_factory=dict)
     # pragma inventory: (path, rule) -> [(line, reason)]
     pragmas: list = field(default_factory=list)
     # pragma errors (malformed) as (path, line, message)
@@ -490,6 +499,7 @@ class _FileScanner(ast.NodeVisitor):
         sync = _is_sync_site(node)
         if sync is not None:
             cur.sync_sites.append((node.lineno, sync))
+        self._record_spawn(node, cur)
         # Mutator-method writes (x.attr.append(...) mutates x.attr).
         if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATOR_METHODS:
             base = node.func.value
@@ -517,6 +527,36 @@ class _FileScanner(ast.NodeVisitor):
                                   f"mutate:{node.func.attr}", "<global>", held)
                     )
         self.generic_visit(node)
+
+    def _record_spawn(self, node: ast.Call, cur: FuncInfo) -> None:
+        """Callable handed to a thread context becomes a spawn site."""
+        name = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else None
+        )
+        if name not in C.THREAD_SPAWNERS:
+            return
+        target: ast.expr | None = None
+        if name == "to_thread" and node.args:
+            target = node.args[0]
+        elif name == "run_in_executor" and len(node.args) >= 2:
+            target = node.args[1]
+        elif name == "submit" and node.args:
+            target = node.args[0]
+        elif name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        if target is None:
+            return
+        raw = dotted_name(target)
+        if raw is None and isinstance(target, ast.Attribute):
+            raw = f"<expr>.{target.attr}"
+        if raw is None:
+            return  # lambda / partial: unresolvable, under-approximate
+        cur.spawn_sites.append(CallSite(
+            line=node.lineno, col=node.col_offset, raw=raw,
+        ))
 
     def _usage_of(self, node: ast.Call) -> str:
         parent = self._parents.get(node)
@@ -603,6 +643,11 @@ def resolve_calls(scanners: list[_FileScanner], project: Project) -> None:
                 )
                 for t in cs.targets:
                     project.callers.setdefault(t, []).append((info.key, cs))
+            for cs in info.spawn_sites:
+                cs.targets = _resolve_one(
+                    cs.raw, sc, info, enclosing_class, project, attr_types,
+                    method_index, methods_by_name, module_funcs, funcs_by_name,
+                )
 
 
 def _resolve_one(
@@ -688,6 +733,7 @@ def _unique(keys: list[str]) -> list[str]:
 import re
 
 _ALLOW_RE = re.compile(r"dynacheck:\s*allow-([a-z][a-z0-9-]*)\s*\(\s*([^)]*?)\s*\)")
+_KNOB_DYNAMIC_RE = re.compile(r"dynacheck:\s*knob-dynamic\s*\(\s*([^)]*?)\s*\)")
 _ANY_PRAGMA_RE = re.compile(r"^#+\s*dynacheck:")
 _DYNALINT_HOLDS_RE = re.compile(r"dynalint:\s*holds-lock\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\)")
 _DYNALINT_SYNC_OK_RE = re.compile(r"dynalint:\s*sync-ok\b")
@@ -717,6 +763,20 @@ def extract_pragmas(path: str, source: str, tree: ast.Module, project: Project) 
         if not _ANY_PRAGMA_RE.search(text):
             continue
         matched = False
+        for m in _KNOB_DYNAMIC_RE.finditer(text):
+            # A declared dynamic env-name escape: suppresses config-knob
+            # on the statement, recorded in the pragma inventory under
+            # its own rule name.
+            reason = m.group(1).strip()
+            matched = True
+            if not reason:
+                project.pragma_errors.append((
+                    path, line, "knob-dynamic pragma requires a non-empty reason",
+                ))
+                continue
+            project.pragmas.append(Pragma(path, line, "knob-dynamic", reason))
+            bucket = project.allow_lines.setdefault(C.RULE_CONFIG_KNOB, set())
+            bucket.update((path, ln) for ln in covered)
         for m in _ALLOW_RE.finditer(text):
             rule, reason = m.group(1), m.group(2).strip()
             matched = True
@@ -807,6 +867,8 @@ def build_project(paths: list[Path], repo_root: Path) -> Project:
         sc = _FileScanner(rel, tree, project)
         sc.visit(tree)
         scanners.append(sc)
+        project.trees[rel] = tree
+        project.imports_by_file[rel] = sc.imports
         extract_pragmas(rel, source, tree, project)
     resolve_calls(scanners, project)
     return project

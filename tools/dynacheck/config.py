@@ -23,6 +23,9 @@ RULE_HOLDS_LOCK_UNVERIFIED = "holds-lock-unverified"
 RULE_CORO_LEAK = "coroutine-leak"
 RULE_CURSOR = "cursor-discipline"
 RULE_REGISTRY_DRIFT = "registry-drift"
+RULE_WIRE_CONTRACT = "wire-contract"
+RULE_LOOP_AFFINITY = "loop-affinity"
+RULE_CONFIG_KNOB = "config-knob"
 
 ALL_RULES = (
     RULE_TRANSITIVE_BLOCKING,
@@ -31,6 +34,9 @@ ALL_RULES = (
     RULE_CORO_LEAK,
     RULE_CURSOR,
     RULE_REGISTRY_DRIFT,
+    RULE_WIRE_CONTRACT,
+    RULE_LOOP_AFFINITY,
+    RULE_CONFIG_KNOB,
 )
 
 # ---------------------------------------------------------------------------
@@ -256,6 +262,95 @@ AUDITED_CURSOR_WRITERS: dict[str, set[str]] = {
 }
 
 # ---------------------------------------------------------------------------
+# wire-contract: the per-plane frame-key schema lives in
+# dynamo_tpu/runtime/wire.py (SCHEMAS / CONTEXTS / VALUES); the rule
+# parses that file STATICALLY — Engine A never imports product code.
+# WIRE_PLANE_FILES registers which scanned files speak which planes;
+# production/consumption is accounted per plane across its files.
+# ---------------------------------------------------------------------------
+
+WIRE_SCHEMA_FILE = "dynamo_tpu/runtime/wire.py"
+
+# {file suffix -> planes spoken}. A file's wire.* references must belong
+# to one of its planes; raw string-literal keys at send sites matching a
+# plane key are backslide findings.
+WIRE_PLANE_FILES: dict[str, tuple[str, ...]] = {
+    "dynamo_tpu/runtime/dataplane.py": ("dataplane",),
+    "dynamo_tpu/runtime/store/client.py": ("store", "store.event"),
+    "dynamo_tpu/runtime/store/server.py": ("store", "store.event"),
+    "dynamo_tpu/runtime/component.py": ("instance", "store.event"),
+    "dynamo_tpu/llm/discovery.py": ("store.event",),
+    "dynamo_tpu/obs/snapshot.py": ("snapshot",),
+    "dynamo_tpu/llm/kv_pool/peer_client.py": ("kvstream", "kvimport"),
+    "dynamo_tpu/backends/jax/main.py": ("kvstream", "kvimport"),
+    "dynamo_tpu/backends/mocker/main.py": ("kvstream",),
+    "dynamo_tpu/engine/core.py": ("kvimport",),
+}
+
+# Call names whose dict-literal arguments are frame SEND sites: a raw
+# string key there (in a registered plane file, matching a plane key)
+# is a backslide to the pre-registry idiom. Directly-yielded dict
+# literals in plane files are send sites too (streaming handlers).
+WIRE_SEND_FNS = {"pack", "send_frame", "write_frame", "push"}
+
+# Functions producing store-plane keys through KWARG names (the
+# ``_request(op, k=..., v=...)`` splice): each keyword name at a call to
+# one of these is a produced key for the file's planes.
+WIRE_KWARG_PRODUCERS = {"_request"}
+
+# ---------------------------------------------------------------------------
+# loop-affinity: state the EXTERNAL/loop-affine convention declares
+# single-loop-owned. {file suffix -> {(class, attr): description}}. The
+# rule flags any write to one of these reachable (over the call graph)
+# from a thread entry point (to_thread / run_in_executor / submit /
+# Thread(target=...)).
+# ---------------------------------------------------------------------------
+
+LOOP_AFFINE: dict[str, dict[tuple[str, str], str]] = {
+    "dynamo_tpu/obs/snapshot.py": {
+        ("SnapshotPublisher", "_snapbuf"): "bounded snapshot buffer",
+    },
+    "dynamo_tpu/llm/kv_router/publisher.py": {
+        ("KvEventPublisher", "_buf"): "KV event buffer",
+    },
+    "dynamo_tpu/runtime/component.py": {
+        ("EndpointClient", "_quarantine"): "lease-expiry quarantine map",
+    },
+    "dynamo_tpu/llm/discovery.py": {
+        ("ModelWatcher", "_deferred"): "deferred model-removal map",
+    },
+    "dynamo_tpu/llm/kv_pool/global_index.py": {
+        ("GlobalKvIndex", "_tiers"): "per-worker tier ledger",
+        ("GlobalKvIndex", "_last_event_id"): "per-worker event cursor",
+        ("GlobalKvIndex", "_fwd_id"): "forwarded-event id counter",
+    },
+}
+
+# Thread entry vocabulary (callgraph records the spawned callable at
+# these sites): asyncio.to_thread(fn), loop.run_in_executor(None, fn),
+# executor.submit(fn), threading.Thread(target=fn).
+THREAD_SPAWNERS = {"to_thread", "run_in_executor", "submit", "Thread"}
+
+# ---------------------------------------------------------------------------
+# config-knob: the central registry lives in dynamo_tpu/knobs.py (KNOBS /
+# PREFIXES); the rule parses it statically, collects every env read in
+# the tree (os.environ / os.getenv / knobs.* accessors / wrapper
+# functions whose body reads the env through a parameter), resolves
+# dynamically-built names through module constants and parameter
+# defaults, and fails undocumented, unused, duplicate-default, and
+# unresolvable reads. `# dynacheck: knob-dynamic(<reason>)` escapes a
+# genuinely dynamic name.
+# ---------------------------------------------------------------------------
+
+KNOB_REGISTRY_FILE = "dynamo_tpu/knobs.py"
+KNOB_DOC_FILE = "README.md"
+
+# Accessor functions on the knobs module (arg 0 is the knob name).
+KNOB_ACCESSORS = {
+    "raw", "get", "get_str", "get_int", "get_float", "get_bool", "default",
+}
+
+# ---------------------------------------------------------------------------
 # File selection.
 # ---------------------------------------------------------------------------
 
@@ -277,4 +372,7 @@ MODEL_DEPTHS = {
     "allocator": 18,
     "cursor": 12,
     "breaker": 18,
+    "quarantine": 20,
+    "keepalive": 12,
+    "planner": 16,
 }

@@ -1,0 +1,835 @@
+"""Engine A v2 rules: the PR 16 contract analyses.
+
+7. ``wire-contract`` — the per-plane frame-key registry
+   (``dynamo_tpu/runtime/wire.py``) is parsed STATICALLY; every
+   ``wire.<CONST>`` reference in a registered plane file is classified as
+   produced (dict-literal key, subscript store, ``_request`` kwarg) or
+   consumed (subscript load, ``.get``/``.pop``/``.setdefault``,
+   ``in``-test). A key produced but never consumed, consumed but never
+   produced, reused across planes sharing a parse context with
+   conflicting meaning, or written as a raw string literal at a send
+   site, is drift.
+8. ``loop-affinity`` — state in the ``LOOP_AFFINE`` registry is owned by
+   one event loop; any write reachable over the call graph from a thread
+   entry point (``to_thread`` / ``run_in_executor`` / ``submit`` /
+   ``Thread(target=...)``) is a cross-loop race.
+9. ``config-knob`` — every env read in the tree must resolve into the
+   central knob registry (``dynamo_tpu/knobs.py``): direct ``os.environ``
+   reads of a registered prefix outside the registry are bypasses,
+   accessor/wrapper reads of unregistered names are failures, literal
+   defaults at call sites duplicate the registry's single default,
+   registered knobs nobody reads are dead, and registered knobs missing
+   from the README are undocumented. Dynamically-built names resolve
+   through module constants and parameter defaults; true escapes carry
+   ``# dynacheck: knob-dynamic(<reason>)``.
+
+Like the rest of Engine A these under-approximate: an unresolvable
+construct stays silent rather than spamming.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.dynacheck import config as C
+from tools.dynacheck.callgraph import FuncInfo, Project, _module_path, dotted_name
+from tools.dynacheck.interproc import Finding
+
+_CONSUME_METHODS = {"get", "pop", "setdefault"}
+
+
+def _tree_scan(project: Project) -> bool:
+    return any(p.startswith("dynamo_tpu/") for p in project.trees)
+
+
+def _match_file(project: Project, suffix: str) -> str | None:
+    for p in project.trees:
+        if p.endswith(suffix):
+            return p
+    return None
+
+
+def _parents(tree: ast.Module) -> dict[ast.AST, ast.AST]:
+    out: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _module_aliases(project: Project, path: str, target_suffix: str) -> set[str]:
+    """Local names in ``path`` bound to the module whose repo-relative
+    path ends with ``target_suffix`` (e.g. the wire or knobs module)."""
+    out: set[str] = set()
+    for name, dotted in project.imports_by_file.get(path, {}).items():
+        mpath = _module_path(dotted, project.root)
+        if mpath is not None and mpath.endswith(target_suffix):
+            out.add(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 7: wire-contract
+# ---------------------------------------------------------------------------
+
+
+class _WireSchema:
+    def __init__(self) -> None:
+        self.consts: dict[str, str] = {}       # CONST name -> key string
+        self.schemas: dict[str, dict[str, str]] = {}  # plane -> {CONST: meaning}
+        self.contexts: dict[str, str] = {}     # plane -> parse context tag
+        self.values: set[str] = set()          # discriminator VALUE consts
+        self.path = ""
+
+    def plane_keys(self, plane: str) -> dict[str, str]:
+        """{key string -> CONST name} for one plane."""
+        return {
+            self.consts[c]: c
+            for c in self.schemas.get(plane, ())
+            if c in self.consts
+        }
+
+
+def _load_wire_schema(project: Project) -> tuple[_WireSchema | None, list[Finding]]:
+    path = _match_file(project, C.WIRE_SCHEMA_FILE)
+    if path is None:
+        if _tree_scan(project):
+            return None, [Finding(
+                C.WIRE_SCHEMA_FILE, 0, C.RULE_WIRE_CONTRACT,
+                "wire schema module is registered but not in the scanned "
+                "tree: the module moved or was deleted — update "
+                "tools/dynacheck/config.py WIRE_SCHEMA_FILE",
+            )]
+        return None, []
+    ws = _WireSchema()
+    ws.path = path
+    tree = project.trees[path]
+    findings: list[Finding] = []
+    for node in tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if (
+                t.id.isupper()
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+            ):
+                ws.consts[t.id] = value.value
+            elif t.id in ("SCHEMAS", "CONTEXTS", "VALUES") and isinstance(
+                value, ast.Dict
+            ):
+                try:
+                    table = ast.literal_eval(value)
+                except ValueError:
+                    findings.append(Finding(
+                        path, node.lineno, C.RULE_WIRE_CONTRACT,
+                        f"{t.id} must be a pure dict literal so the "
+                        "checker can read it statically",
+                    ))
+                    continue
+                if t.id == "SCHEMAS":
+                    ws.schemas = table
+                elif t.id == "CONTEXTS":
+                    ws.contexts = table
+                else:
+                    ws.values = set(table)
+    # Registry self-consistency (the static twin of wire._self_check).
+    registered = {c for s in ws.schemas.values() for c in s} | ws.values
+    for plane, schema in sorted(ws.schemas.items()):
+        if plane not in ws.contexts:
+            findings.append(Finding(
+                path, 0, C.RULE_WIRE_CONTRACT,
+                f"plane {plane!r} has no parse context in CONTEXTS",
+            ))
+        for const in sorted(schema):
+            if const not in ws.consts:
+                findings.append(Finding(
+                    path, 0, C.RULE_WIRE_CONTRACT,
+                    f"SCHEMAS[{plane!r}] names {const}, which is not a "
+                    "str constant in the wire module",
+                ))
+    for name in sorted(ws.consts):
+        if name not in registered:
+            findings.append(Finding(
+                path, 0, C.RULE_WIRE_CONTRACT,
+                f"wire constant {name} is not registered in SCHEMAS or "
+                "VALUES",
+            ))
+    # Cross-plane conflicts: same parse context + same key string +
+    # different meaning is ambiguous for every reader of that context.
+    by_ctx_key: dict[tuple[str, str], list[tuple[str, str, str]]] = {}
+    for plane, schema in ws.schemas.items():
+        ctx = ws.contexts.get(plane, plane)
+        for const, meaning in schema.items():
+            key = ws.consts.get(const)
+            if key is not None:
+                by_ctx_key.setdefault((ctx, key), []).append(
+                    (plane, const, meaning)
+                )
+    for (ctx, key), uses in sorted(by_ctx_key.items()):
+        if len({m for _, _, m in uses}) > 1:
+            detail = "; ".join(
+                f"{plane}.{const} = {meaning!r}"
+                for plane, const, meaning in sorted(uses)
+            )
+            findings.append(Finding(
+                path, 0, C.RULE_WIRE_CONTRACT,
+                f"key {key!r} is reused with conflicting meaning inside "
+                f"parse context {ctx!r} ({detail}): a reader of this "
+                "context cannot tell the two apart — split the planes "
+                "into different contexts or rename a key",
+            ))
+    return ws, findings
+
+
+def check_wire_contract(project: Project) -> list[Finding]:
+    ws, findings = _load_wire_schema(project)
+    if ws is None:
+        return findings
+    # site accounting: (plane, CONST) -> [(path, line)]
+    produced: dict[tuple[str, str], list[tuple[str, int]]] = {}
+    consumed: dict[tuple[str, str], list[tuple[str, int]]] = {}
+    files_by_plane: dict[str, list[str]] = {}
+    registered_present: dict[str, tuple[str, ...]] = {}
+    for suffix, planes in C.WIRE_PLANE_FILES.items():
+        path = _match_file(project, suffix)
+        if path is None:
+            continue
+        registered_present[path] = planes
+        for plane in planes:
+            files_by_plane.setdefault(plane, []).append(path)
+
+    for path, planes in sorted(registered_present.items()):
+        tree = project.trees[path]
+        parents = _parents(tree)
+        aliases = _module_aliases(project, path, C.WIRE_SCHEMA_FILE)
+        # key string -> (plane, CONST) for this file's planes (first
+        # plane claiming a key wins; same-file planes never collide in
+        # practice because their contexts differ).
+        file_keys: dict[str, tuple[str, str]] = {}
+        for plane in planes:
+            for key, const in ws.plane_keys(plane).items():
+                file_keys.setdefault(key, (plane, const))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and (
+                isinstance(node.value, ast.Name) and node.value.id in aliases
+            ):
+                const = node.attr
+                if const in ws.values or const not in ws.consts:
+                    continue
+                plane = next(
+                    (p for p in planes if const in ws.schemas.get(p, ())), None
+                )
+                if plane is None:
+                    owners = sorted(
+                        p for p, s in ws.schemas.items() if const in s
+                    )
+                    if owners and not project.suppressed(
+                        C.RULE_WIRE_CONTRACT, path, node.lineno
+                    ):
+                        findings.append(Finding(
+                            path, node.lineno, C.RULE_WIRE_CONTRACT,
+                            f"{path} references {const} of plane "
+                            f"{owners[0]!r}, but is not registered for it "
+                            "— add the plane in tools/dynacheck/config.py "
+                            "WIRE_PLANE_FILES or use the right schema",
+                        ))
+                    continue
+                cls = _classify_ref(node, parents)
+                site = (path, node.lineno)
+                if cls == "produced":
+                    produced.setdefault((plane, const), []).append(site)
+                elif cls == "consumed":
+                    consumed.setdefault((plane, const), []).append(site)
+            elif isinstance(node, ast.Call):
+                # _request(op, k=..., v=...) splice: kwarg names are
+                # produced store keys.
+                name = (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else None
+                )
+                if name in C.WIRE_KWARG_PRODUCERS:
+                    for kw in node.keywords:
+                        if kw.arg and kw.arg in file_keys:
+                            plane, const = file_keys[kw.arg]
+                            produced.setdefault((plane, const), []).append(
+                                (path, node.lineno)
+                            )
+        # Backslide scan: raw string keys at send sites.
+        findings.extend(_raw_literal_sends(project, path, tree, parents, file_keys))
+
+    # Pairing: only judged for planes whose full registered file set was
+    # scanned — a narrow scan proves nothing about the other side.
+    complete = {
+        plane for plane, suffixes in _plane_suffixes().items()
+        if all(_match_file(project, sfx) is not None for sfx in suffixes)
+        and plane in files_by_plane
+    }
+    for plane in sorted(complete):
+        for const in sorted(ws.schemas.get(plane, ())):
+            if const not in ws.consts:
+                continue
+            prod = produced.get((plane, const), [])
+            cons = consumed.get((plane, const), [])
+            if prod and not cons:
+                path, line = min(prod)
+                if not project.suppressed(C.RULE_WIRE_CONTRACT, path, line):
+                    findings.append(Finding(
+                        path, line, C.RULE_WIRE_CONTRACT,
+                        f"wire key {const} ({ws.consts[const]!r}, plane "
+                        f"{plane}) is produced here but consumed nowhere "
+                        "in the plane's files: dead weight on the wire, "
+                        "or the consumer forgot to parse it",
+                    ))
+            elif cons and not prod:
+                path, line = min(cons)
+                if not project.suppressed(C.RULE_WIRE_CONTRACT, path, line):
+                    findings.append(Finding(
+                        path, line, C.RULE_WIRE_CONTRACT,
+                        f"wire key {const} ({ws.consts[const]!r}, plane "
+                        f"{plane}) is consumed here but produced nowhere "
+                        "in the plane's files: this branch can never "
+                        "fire, or the producer forgot to send it",
+                    ))
+            elif not prod and not cons:
+                findings.append(Finding(
+                    ws.path, 0, C.RULE_WIRE_CONTRACT,
+                    f"wire key {const} ({ws.consts[const]!r}, plane "
+                    f"{plane}) is registered but neither produced nor "
+                    "consumed anywhere: drop it from the schema",
+                ))
+    return findings
+
+
+def _plane_suffixes() -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    for suffix, planes in C.WIRE_PLANE_FILES.items():
+        for plane in planes:
+            out.setdefault(plane, []).append(suffix)
+    return out
+
+
+def _classify_ref(node: ast.Attribute, parents: dict) -> str | None:
+    parent = parents.get(node)
+    if isinstance(parent, ast.Dict) and node in parent.keys:
+        return "produced"
+    if isinstance(parent, ast.Subscript) and parent.slice is node:
+        if isinstance(parent.ctx, ast.Store):
+            return "produced"
+        return "consumed"
+    if (
+        isinstance(parent, ast.Call)
+        and parent.args
+        and parent.args[0] is node
+        and isinstance(parent.func, ast.Attribute)
+        and parent.func.attr in _CONSUME_METHODS
+    ):
+        return "consumed"
+    if isinstance(parent, ast.Compare) and parent.left is node and any(
+        isinstance(op, (ast.In, ast.NotIn)) for op in parent.ops
+    ):
+        return "consumed"
+    return None  # neutral reference (default value, comparison operand, ...)
+
+
+def _raw_literal_sends(
+    project: Project, path: str, tree: ast.Module, parents: dict,
+    file_keys: dict[str, tuple[str, str]],
+) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        parent = parents.get(node)
+        send_site = False
+        if isinstance(parent, (ast.Yield, ast.YieldFrom)):
+            send_site = True
+        elif isinstance(parent, ast.Call) and node in parent.args:
+            name = (
+                parent.func.attr if isinstance(parent.func, ast.Attribute)
+                else parent.func.id if isinstance(parent.func, ast.Name)
+                else None
+            )
+            send_site = name in C.WIRE_SEND_FNS
+        if not send_site:
+            continue
+        for key in node.keys:
+            if (
+                isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+                and key.value in file_keys
+            ):
+                if project.suppressed(C.RULE_WIRE_CONTRACT, path, key.lineno):
+                    continue
+                plane, const = file_keys[key.value]
+                out.append(Finding(
+                    path, key.lineno, C.RULE_WIRE_CONTRACT,
+                    f"raw string literal {key.value!r} used as a frame "
+                    f"key at a send site: use wire.{const} (plane "
+                    f"{plane}) so the contract stays checkable",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 8: loop-affinity
+# ---------------------------------------------------------------------------
+
+
+def check_loop_affinity(project: Project) -> list[Finding]:
+    # Resolve the registry against the scanned tree.
+    affine: dict[tuple[str, str], tuple[str, str]] = {}  # (class, attr) -> (path, desc)
+    attr_names: dict[str, tuple[str, str]] = {}          # attr -> (class, desc)
+    findings: list[Finding] = []
+    tree_scan = _tree_scan(project)
+    for suffix, entries in sorted(C.LOOP_AFFINE.items()):
+        path = _match_file(project, suffix)
+        if path is None:
+            if tree_scan and suffix.startswith("dynamo_tpu/"):
+                findings.append(Finding(
+                    suffix, 0, C.RULE_LOOP_AFFINITY,
+                    f"LOOP_AFFINE registers {suffix} but no scanned file "
+                    "matches it — update tools/dynacheck/config.py",
+                ))
+            continue
+        for (cls, attr), desc in sorted(entries.items()):
+            if path not in project.classes.get(cls, set()):
+                findings.append(Finding(
+                    path, 0, C.RULE_LOOP_AFFINITY,
+                    f"LOOP_AFFINE entry ({cls}, {attr}): class {cls} no "
+                    f"longer exists in {path}",
+                ))
+                continue
+            affine[(cls, attr)] = (path, desc)
+            attr_names[attr] = (cls, desc)
+    if not affine:
+        return findings
+
+    # BFS from every thread-spawned callable; keep one spawn witness per
+    # reached function for the message.
+    origin: dict[str, tuple[str, str, int]] = {}  # func key -> (root qual, path, line)
+    frontier: list[str] = []
+    for f in sorted(project.functions.values(), key=lambda fi: fi.key):
+        for cs in f.spawn_sites:
+            for t in sorted(cs.targets):
+                if t not in origin:
+                    tinfo = project.functions.get(t)
+                    if tinfo is None:
+                        continue
+                    origin[t] = (tinfo.qualname, f.path, cs.line)
+                    frontier.append(t)
+    while frontier:
+        nxt: list[str] = []
+        for key in frontier:
+            info = project.functions.get(key)
+            if info is None:
+                continue
+            for cs in info.calls:
+                for t in sorted(cs.targets):
+                    if t not in origin:
+                        origin[t] = origin[key]
+                        nxt.append(t)
+        frontier = nxt
+
+    for key in sorted(origin):
+        info = project.functions.get(key)
+        if info is None:
+            continue
+        cls = (
+            info.qualname.split(".")[0]
+            if "." in info.qualname
+            and info.qualname.split(".")[0] in project.classes
+            else None
+        )
+        root_qual, spawn_path, spawn_line = origin[key]
+        for w in info.writes:
+            hit: tuple[str, str] | None = None  # (class, desc)
+            if (
+                cls is not None
+                and (cls, w.attr) in affine
+                and affine[(cls, w.attr)][0] == info.path
+                and w.receiver in ("self", "self(alias)")
+            ):
+                hit = (cls, affine[(cls, w.attr)][1])
+            elif (
+                w.attr in attr_names
+                and w.receiver not in ("self", "self(alias)", "<local>", "<global>")
+            ):
+                # Foreign receiver (`pub._snapbuf.append(...)`) from a
+                # thread context: same race, reached from outside.
+                hit = attr_names[w.attr]
+            if hit is None:
+                continue
+            if project.suppressed(C.RULE_LOOP_AFFINITY, info.path, w.line):
+                continue
+            owner_cls, desc = hit
+            findings.append(Finding(
+                info.path, w.line, C.RULE_LOOP_AFFINITY,
+                f"{info.qualname} writes {owner_cls}.{w.attr} ({desc}), "
+                "which is loop-affine, but is reachable from thread "
+                f"entry point {root_qual!r} (spawned at "
+                f"{spawn_path}:{spawn_line}): a cross-loop write races "
+                "the owning event loop — marshal through "
+                "call_soon_threadsafe or keep the touch on the loop",
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 9: config-knob
+# ---------------------------------------------------------------------------
+
+def _doc_token_re(prefixes: tuple[str, ...]) -> re.Pattern[str]:
+    alts = "|".join(re.escape(p) for p in prefixes)
+    return re.compile(r"\b(?:" + alts + r")[A-Z0-9_]*[A-Z0-9]\b")
+
+
+class _KnobRegistry:
+    def __init__(self) -> None:
+        self.path = ""
+        self.prefixes: tuple[str, ...] = ()
+        self.knobs: dict[str, int] = {}      # name -> registration line
+        self.defaults: dict[str, object] = {}  # name -> literal default
+
+
+def _load_knob_registry(project: Project) -> tuple[_KnobRegistry | None, list[Finding]]:
+    path = _match_file(project, C.KNOB_REGISTRY_FILE)
+    if path is None:
+        if _tree_scan(project):
+            return None, [Finding(
+                C.KNOB_REGISTRY_FILE, 0, C.RULE_CONFIG_KNOB,
+                "knob registry module is registered but not in the "
+                "scanned tree — update tools/dynacheck/config.py "
+                "KNOB_REGISTRY_FILE",
+            )]
+        return None, []
+    reg = _KnobRegistry()
+    reg.path = path
+    tree = project.trees[path]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "PREFIXES":
+                    try:
+                        reg.prefixes = tuple(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "Knob"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+            reg.knobs[name] = node.lineno
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                reg.defaults[name] = node.args[1].value
+    if not reg.prefixes:
+        reg.prefixes = ("DYN_", "DYNAMO_TPU_")
+    return reg, []
+
+
+def _body_skip_nested(nodes: list[ast.AST]):
+    """Walk statements without descending into nested defs (each nested
+    def is its own FuncInfo and walks itself)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _resolve_name_expr(
+    expr: ast.expr | None,
+    module_consts: dict[str, str],
+    param_defaults: dict[str, ast.expr],
+) -> str | None:
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        if expr.id in module_consts:
+            return module_consts[expr.id]
+        default = param_defaults.get(expr.id)
+        if default is not None:
+            return _resolve_name_expr(default, module_consts, {})
+        return None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _resolve_name_expr(expr.left, module_consts, param_defaults)
+        right = _resolve_name_expr(expr.right, module_consts, param_defaults)
+        if left is not None and right is not None:
+            return left + right
+    if isinstance(expr, ast.JoinedStr):
+        parts: list[str] = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                inner = _resolve_name_expr(v.value, module_consts, param_defaults)
+                if inner is None:
+                    return None
+                parts.append(inner)
+        return "".join(parts)
+    return None
+
+
+def _env_read_site(node: ast.AST, os_aliases: set[str]):
+    """(name_expr, default_expr) when ``node`` reads the environment via
+    os.environ.get / os.getenv / os.environ[...]; else None."""
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        if head not in os_aliases:
+            return None
+        if rest in ("environ.get", "getenv"):
+            name = node.args[0] if node.args else None
+            default = node.args[1] if len(node.args) > 1 else None
+            return (name, default)
+        return None
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.ctx, ast.Load)
+        and dotted_name(node.value) is not None
+    ):
+        d = dotted_name(node.value)
+        head, _, rest = d.partition(".")
+        if head in os_aliases and rest == "environ":
+            return (node.slice, None)
+    return None
+
+
+def check_config_knobs(project: Project) -> list[Finding]:
+    reg, findings = _load_knob_registry(project)
+    if reg is None:
+        return findings
+    # Absence-based checks (knob never read / never documented) only mean
+    # something when the registry was scanned alongside the code that
+    # would read it — a lone-file scan proves nothing about "nowhere".
+    global_checks = len(project.trees) > 1
+
+    # Per-file context tables.
+    module_consts: dict[str, dict[str, str]] = {}
+    for path, tree in project.trees.items():
+        consts: dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                if isinstance(node.value.value, str):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            consts[t.id] = node.value.value
+        module_consts[path] = consts
+
+    def os_aliases(path: str) -> set[str]:
+        return {
+            name
+            for name, dotted in project.imports_by_file.get(path, {}).items()
+            if dotted == "os" or dotted.startswith("os.")
+        } | ({"os"} if "os" not in project.imports_by_file.get(path, {}) else set())
+
+    def param_defaults_of(f: FuncInfo | None) -> dict[str, ast.expr]:
+        if f is None or f.node is None:
+            return {}
+        node = f.node
+        args = node.args
+        out: dict[str, ast.expr] = {}
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            out[arg.arg] = default
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None:
+                out[arg.arg] = default
+        return out
+
+    # Pass 1: wrapper discovery — a function whose body reads the env
+    # through one of its own parameters is an accessor in disguise; its
+    # CALL SITES carry the knob names.
+    wrappers: dict[tuple[str, str], int] = {}  # (path, func name) -> name param index
+    for f in project.functions.values():
+        if f.node is None or f.path == reg.path:
+            continue
+        params = [
+            a.arg for a in f.node.args.posonlyargs + f.node.args.args
+        ]
+        for node in _body_skip_nested(f.node.body):
+            site = _env_read_site(node, os_aliases(f.path))
+            if site is None:
+                continue
+            name_expr, _default = site
+            if isinstance(name_expr, ast.Name) and name_expr.id in params:
+                wrappers[(f.path, f.name)] = params.index(name_expr.id)
+
+    knob_aliases: dict[str, set[str]] = {
+        path: _module_aliases(project, path, C.KNOB_REGISTRY_FILE)
+        for path in project.trees
+    }
+
+    reads: dict[str, list[tuple[str, int]]] = {}  # knob name -> sites
+
+    def record_read(name: str, path: str, line: int, *, registry_required: bool) -> None:
+        reads.setdefault(name, []).append((path, line))
+        if name not in reg.knobs:
+            if registry_required or name.startswith(reg.prefixes):
+                if not project.suppressed(C.RULE_CONFIG_KNOB, path, line):
+                    findings.append(Finding(
+                        path, line, C.RULE_CONFIG_KNOB,
+                        f"env knob {name!r} is read here but not "
+                        f"registered in {C.KNOB_REGISTRY_FILE}: register "
+                        "it (one default, one doc line) so the table "
+                        "stays the single source of truth",
+                    ))
+
+    def unresolved(path: str, line: int, via: str) -> None:
+        if project.suppressed(C.RULE_CONFIG_KNOB, path, line):
+            return
+        findings.append(Finding(
+            path, line, C.RULE_CONFIG_KNOB,
+            f"env read via {via} with a dynamically-built name the "
+            "checker cannot resolve: route it through a module constant "
+            "or mark it `# dynacheck: knob-dynamic(<reason>)`",
+        ))
+
+    def scan_region(
+        path: str, nodes: list[ast.AST], f: FuncInfo | None
+    ) -> None:
+        consts = module_consts.get(path, {})
+        defaults = param_defaults_of(f)
+        oa = os_aliases(path)
+        ka = knob_aliases.get(path, set())
+        for node in _body_skip_nested(nodes):
+            site = _env_read_site(node, oa) if path != reg.path else None
+            if site is not None:
+                name_expr, default_expr = site
+                if (
+                    isinstance(name_expr, ast.Name)
+                    and f is not None
+                    and f.node is not None
+                    and name_expr.id in {
+                        a.arg for a in f.node.args.posonlyargs + f.node.args.args
+                    }
+                ):
+                    continue  # wrapper internals: call sites are checked
+                name = _resolve_name_expr(name_expr, consts, defaults)
+                line = getattr(node, "lineno", 0)
+                if name is None:
+                    unresolved(path, line, "os.environ")
+                    continue
+                if not name.startswith(reg.prefixes):
+                    continue  # foreign env (JAX_PLATFORMS, TMPDIR, ...)
+                record_read(name, path, line, registry_required=False)
+                if path != reg.path and not project.suppressed(
+                    C.RULE_CONFIG_KNOB, path, line
+                ):
+                    findings.append(Finding(
+                        path, line, C.RULE_CONFIG_KNOB,
+                        f"direct os.environ read of {name!r} bypasses "
+                        "the registry: read it through dynamo_tpu.knobs "
+                        "so the default lives in exactly one place",
+                    ))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            line = node.lineno
+            func = node.func
+            # knobs.get_*/raw/default("NAME")
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in C.KNOB_ACCESSORS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ka
+            ):
+                name = _resolve_name_expr(
+                    node.args[0] if node.args else None, consts, defaults
+                )
+                if name is None:
+                    unresolved(path, line, f"knobs.{func.attr}")
+                else:
+                    record_read(name, path, line, registry_required=True)
+                continue
+            # wrapper call sites: _env("DYN_X", cfg.field)
+            wname = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None
+            )
+            if wname is not None and (path, wname) in wrappers:
+                idx = wrappers[(path, wname)]
+                arg = node.args[idx] if len(node.args) > idx else None
+                name = _resolve_name_expr(arg, consts, defaults)
+                if name is None:
+                    unresolved(path, line, f"{wname}()")
+                    continue
+                record_read(name, path, line, registry_required=True)
+                for j, other in enumerate(node.args):
+                    if j == idx:
+                        continue
+                    if isinstance(other, ast.Constant) and other.value is not None:
+                        if project.suppressed(C.RULE_CONFIG_KNOB, path, line):
+                            continue
+                        findings.append(Finding(
+                            path, line, C.RULE_CONFIG_KNOB,
+                            f"call to {wname}() passes a literal default "
+                            f"for {name!r}, duplicating the registry's "
+                            "single default: drop the literal and let "
+                            f"{C.KNOB_REGISTRY_FILE} own it",
+                        ))
+
+    for path, tree in project.trees.items():
+        scan_region(path, tree.body, None)
+    for f in project.functions.values():
+        if f.node is not None:
+            scan_region(f.path, f.node.body, f)
+
+    if global_checks:
+        for name in sorted(reg.knobs):
+            if name not in reads:
+                findings.append(Finding(
+                    reg.path, reg.knobs[name], C.RULE_CONFIG_KNOB,
+                    f"knob {name} is registered but read nowhere in the "
+                    "tree: dead configuration — wire it up or drop it",
+                ))
+        doc_path = project.root / C.KNOB_DOC_FILE
+        try:
+            doc_text = doc_path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            doc_text = None
+        if doc_text is None:
+            findings.append(Finding(
+                C.KNOB_DOC_FILE, 0, C.RULE_CONFIG_KNOB,
+                "knob documentation file is missing: every registered "
+                "knob needs a README anchor",
+            ))
+        else:
+            documented = set(_doc_token_re(reg.prefixes).findall(doc_text))
+            for name in sorted(reg.knobs):
+                if name not in documented:
+                    findings.append(Finding(
+                        reg.path, reg.knobs[name], C.RULE_CONFIG_KNOB,
+                        f"knob {name} is registered but undocumented in "
+                        f"{C.KNOB_DOC_FILE}: regenerate the table with "
+                        "`python -m tools.dynacheck --knobs-md`",
+                    ))
+            for name in sorted(documented):
+                if name.startswith(reg.prefixes) and name not in reg.knobs:
+                    findings.append(Finding(
+                        C.KNOB_DOC_FILE, 0, C.RULE_CONFIG_KNOB,
+                        f"{C.KNOB_DOC_FILE} documents {name}, which is "
+                        "not a registered knob: doc rot — remove it or "
+                        "register it",
+                    ))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

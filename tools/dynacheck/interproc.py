@@ -55,6 +55,13 @@ def run_all(project: Project) -> list[Finding]:
     findings.extend(check_coroutine_leaks(project))
     findings.extend(check_cursor_discipline(project))
     findings.extend(check_registry_drift(project))
+    # v2 contract rules live in their own module; imported lazily because
+    # contracts.py borrows Finding from here.
+    from tools.dynacheck import contracts
+
+    findings.extend(contracts.check_wire_contract(project))
+    findings.extend(contracts.check_loop_affinity(project))
+    findings.extend(contracts.check_config_knobs(project))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
 

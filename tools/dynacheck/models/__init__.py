@@ -8,6 +8,12 @@ machines, explored exhaustively by :mod:`tools.dynacheck.explore`.
   cursor protocol against a synchronous reference trace.
 - ``breaker`` drives the REAL :class:`CircuitBreaker` under a virtual
   clock, including the cancelled-probe re-arm.
+- ``quarantine`` models EndpointClient's lease-expiry quarantine machine
+  (grace windows, due sweeps, reconcile) against ground-truth liveness.
+- ``keepalive`` models the store client's lease keepalive + session
+  resurrection protocol (same-id re-grant, task cancellation, re-puts).
+- ``planner`` drives the REAL :class:`PlannerController` on a virtual
+  timeline through demand swings, SLO misses and control-plane outages.
 """
 
 from __future__ import annotations
@@ -15,5 +21,11 @@ from __future__ import annotations
 from tools.dynacheck.models.allocator import AllocatorModel
 from tools.dynacheck.models.breaker import BreakerModel
 from tools.dynacheck.models.cursor import CursorModel
+from tools.dynacheck.models.keepalive import KeepaliveModel
+from tools.dynacheck.models.planner import PlannerModel
+from tools.dynacheck.models.quarantine import QuarantineModel
 
-ALL_MODELS = (AllocatorModel, CursorModel, BreakerModel)
+ALL_MODELS = (
+    AllocatorModel, CursorModel, BreakerModel,
+    QuarantineModel, KeepaliveModel, PlannerModel,
+)

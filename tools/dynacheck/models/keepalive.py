@@ -1,0 +1,212 @@
+"""Keepalive model: the store client's lease keepalive + session
+resurrection protocol (runtime/store/client.py) as an executable
+miniature.
+
+One lease with one leased key, explored through every interleaving of
+keepalive beats (healthy, connection-refused, lease-expired), server-side
+lease expiry, connection loss, reconnect completion (which must CANCEL
+the old keepalive task before starting the replacement), mid-resurrection
+re-put failures, and client-side revocation. The transition rules mirror
+``_keepalive_loop`` / ``_reconnect_loop`` / ``lease_revoke`` line for
+line.
+
+Invariants checked at EVERY reachable state:
+
+- **single keepalive task** — never two live keepalive tasks for one
+  lease (the double-beat bug: the old task survives a reconnect and
+  both hammer the server, masking real TTL misses);
+- **same lease id** — every resurrection re-grants with ``want=old id``,
+  so the lease the server holds is always the id the client's meta map
+  is keyed by;
+- **leased keys follow the lease** — while connected with a live lease,
+  every key the client still considers leased is present server-side
+  (a failed re-put DROPS the client entry rather than leaving it
+  phantom);
+- **revocation is terminal** — after ``lease_revoke`` nothing beats, and
+  no resurrection path re-creates the lease;
+- **resurrection converges (liveness)** — from any disconnected state
+  with a pending reconnect, completing the reconnect restores: session
+  up, same lease id, exactly one keepalive task, keys re-put.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+
+class _State:
+    def __init__(self) -> None:
+        self.connected = True
+        self.reconnect_pending = False
+        self.revoked = False
+        # Client side: lease meta registered, keepalive task count, the
+        # leased key tracked in _leased_kv.
+        self.meta = True
+        self.tasks = 1
+        self.client_key = True
+        # Server side: lease alive, granted under the client's id, key
+        # attached.
+        self.server_lease = True
+        self.same_id = True
+        self.server_key = True
+
+    def clone(self) -> "_State":
+        new = _State.__new__(_State)
+        new.__dict__.update(self.__dict__)
+        return new
+
+
+class KeepaliveModel(Model):
+    name = "keepalive"
+    max_depth = C.MODEL_DEPTHS["keepalive"]
+    # Injection points for the fixture suite:
+    #   cancel_before_restart=False leaves the old keepalive task running
+    #   across a reconnect (the double-beat bug);
+    #   regrant_with_want=False re-grants under a fresh server-chosen id,
+    #   orphaning the client's meta map key.
+    cancel_before_restart: bool = True
+    regrant_with_want: bool = True
+
+    def initial_states(self):
+        yield "leased", _State()
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        acts: list[tuple[str, Callable[[Any], Any]]] = []
+        if state.revoked:
+            return acts
+        if state.connected and state.tasks > 0:
+            if state.server_lease:
+                acts.append(("beat_ok", self._beat_ok))
+            else:
+                # The beat comes back StoreError("no such lease"): the
+                # loop resurrects in place — re-grant want=id, re-put.
+                acts.append(("beat_resurrect", self._beat_resurrect))
+                acts.append(("beat_resurrect_reput_fails",
+                             self._beat_resurrect_reput_fails))
+        if state.connected:
+            acts.append(("disconnect", self._disconnect))
+            acts.append(("revoke", self._revoke))
+        if state.server_lease:
+            acts.append(("server_expire", self._server_expire))
+        if state.reconnect_pending and not state.connected:
+            acts.append(("reconnect_complete", self._reconnect_complete))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    # -- transitions (mirroring store/client.py) ---------------------------
+
+    @staticmethod
+    def _beat_ok(state: _State) -> _State:
+        return state.clone()  # TTL refreshed; no protocol state moves
+
+    def _resurrect(self, st: _State, reput_ok: bool) -> _State:
+        # _keepalive_loop's StoreError branch: re-grant under the SAME id
+        # (want=lease_id), then re-put every _leased_kv entry.
+        st.server_lease = True
+        if not self.regrant_with_want:
+            st.same_id = False
+        if st.client_key:
+            if reput_ok:
+                st.server_key = True
+            else:
+                st.client_key = False  # StoreError: entry dropped
+        return st
+
+    def _beat_resurrect(self, state: _State) -> _State:
+        return self._resurrect(state.clone(), reput_ok=True)
+
+    def _beat_resurrect_reput_fails(self, state: _State) -> _State:
+        return self._resurrect(state.clone(), reput_ok=False)
+
+    @staticmethod
+    def _disconnect(state: _State) -> _State:
+        st = state.clone()
+        st.connected = False
+        st.reconnect_pending = True
+        # The keepalive task keeps looping (ConnectionError branch just
+        # counts failures); the reconnect loop owns recovery.
+        return st
+
+    @staticmethod
+    def _server_expire(state: _State) -> _State:
+        st = state.clone()
+        st.server_lease = False
+        st.server_key = False  # lease-attached keys die with the lease
+        return st
+
+    def _reconnect_complete(self, state: _State) -> _State:
+        st = state.clone()
+        st.connected = True
+        st.reconnect_pending = False
+        if st.meta:
+            # _reconnect_loop: cancel the old keepalive task, re-grant
+            # want=old id, start a fresh task, re-put leased keys.
+            if self.cancel_before_restart:
+                st.tasks = 0
+            st.tasks += 1
+            st = self._resurrect(st, reput_ok=True)
+        return st
+
+    @staticmethod
+    def _revoke(state: _State) -> _State:
+        st = state.clone()
+        st.revoked = True
+        st.meta = False
+        st.tasks = 0
+        st.client_key = False
+        st.server_lease = False
+        st.server_key = False
+        return st
+
+    # -- invariants --------------------------------------------------------
+
+    def invariants(self, state: _State) -> list[str]:
+        out: list[str] = []
+        if state.tasks > 1:
+            out.append(
+                f"{state.tasks} live keepalive tasks for one lease: the "
+                "old task survived a reconnect"
+            )
+        if state.server_lease and not state.same_id:
+            out.append(
+                "lease resurrected under a different id: the client's "
+                "meta map and leased-kv records point at a dead id"
+            )
+        if (
+            state.connected
+            and state.server_lease
+            and state.client_key
+            and not state.server_key
+        ):
+            out.append(
+                "client considers a key leased but the server lost it: "
+                "resurrection must re-put or drop the entry"
+            )
+        if state.revoked and (state.server_lease or state.tasks > 0):
+            out.append(
+                "lease revoked but still beating or alive server-side "
+                f"(tasks={state.tasks}, server_lease={state.server_lease})"
+            )
+        # Resurrection converges: completing a pending reconnect restores
+        # the session to exactly-one-task, same-id, keys-on-server.
+        if state.reconnect_pending and not state.connected and state.meta:
+            sim = self._reconnect_complete(state)
+            if sim.tasks != 1 or not sim.same_id or (
+                sim.client_key and not sim.server_key
+            ):
+                out.append(
+                    "reconnect does not restore the lease session "
+                    f"(tasks={sim.tasks}, same_id={sim.same_id}, "
+                    f"key_on_server={sim.server_key})"
+                )
+        return out
+
+    def fingerprint(self, state: _State) -> Any:
+        return (
+            state.connected, state.reconnect_pending, state.revoked,
+            state.meta, min(state.tasks, 3), state.client_key,
+            state.server_lease, state.same_id, state.server_key,
+        )

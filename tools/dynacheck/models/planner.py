@@ -1,0 +1,224 @@
+"""Planner model: the REAL :class:`PlannerController`
+(planner/controller.py) driven cycle by cycle on a virtual timeline
+through every interleaving of demand levels, SLO misses and control-plane
+outages, with a stub planner (plan = demand) and the recording connector.
+
+Guard-rail invariants, accumulated per transition and checked at EVERY
+reachable state:
+
+- **scale-up cooldown** — no two scale-ups closer than the up-cooldown
+  (the up-down-up flap guard's first half);
+- **scale-down cooldown + hysteresis** — no two scale-downs closer than
+  the down-cooldown, and every scale-down is preceded by at least
+  ``down_stable_cycles`` consecutive below-target cycles, tracked by an
+  independent shadow streak (not the controller's own counter);
+- **bounded actuation** — the target moves at most ``max_step_up`` up /
+  ``max_step_down`` down per cycle and stays inside [min, max]: a
+  scale-down only ever drains one replica at a time;
+- **degraded freeze** — a control-plane-dark cycle makes every pool
+  ``degraded_hold``: targets unchanged, NO connector actuation, and the
+  hysteresis streak frozen (an outage must not count toward a
+  scale-down);
+- **actuation every healthy cycle** — a non-degraded cycle reconciles
+  the pool exactly once, at the standing target.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable
+
+from dynamo_tpu.planner.controller import ControllerConfig, PlannerController
+from dynamo_tpu.planner.planner_core import Observation, Plan, RecordingConnector
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+# The degraded branch warns every cycle; thousands of explored states
+# would flood the log.
+logging.getLogger("dynamo_tpu.planner.controller").setLevel(logging.ERROR)
+
+POOL = "backend"
+INTERVAL = 1.0
+UP_CD = 2.0
+DOWN_CD = 4.0
+DOWN_CYCLES = 2
+STEP_UP = 2
+STEP_DOWN = 1
+MIN_R, MAX_R = 1, 4
+
+_CFG = ControllerConfig(
+    interval_s=INTERVAL,
+    scale_up_cooldown_s=UP_CD,
+    scale_down_cooldown_s=DOWN_CD,
+    down_stable_cycles=DOWN_CYCLES,
+    max_step_up=STEP_UP,
+    max_step_down=STEP_DOWN,
+    queue_depth_per_replica=0.0,  # demand drives through the plan only
+    shed_pressure=False,
+    attainment_floor=0.92,
+    min_replicas=MIN_R,
+    max_replicas=MAX_R,
+)
+
+_POOL_FIELDS = (
+    "target", "desired", "last_scale_up_t", "last_scale_down_t",
+    "below_streak", "last_action", "last_reason",
+)
+
+_loop: asyncio.AbstractEventLoop | None = None
+
+
+def _run(coro):
+    global _loop
+    if _loop is None:
+        _loop = asyncio.new_event_loop()
+    return _loop.run_until_complete(coro)
+
+
+class _PlanStub:
+    """plan = demand: the controller's guard rails are under test, not
+    the predictor's math."""
+
+    def compute_plan(self, obs: Observation) -> Plan:
+        d = max(1, int(round(obs.request_rate)))
+        return Plan(
+            prefill_replicas=d, decode_replicas=d,
+            predicted_rate=obs.request_rate,
+            correction_prefill=1.0, correction_decode=1.0,
+        )
+
+
+class _State:
+    def __init__(self, controller_cls: type = PlannerController):
+        self.now = 0.0
+        self.shadow_below = 0           # independent below-target streak
+        self.violations: tuple[str, ...] = ()
+        self.connector = RecordingConnector()
+        self.ctrl = controller_cls(
+            _PlanStub(), self.connector, pools={POOL: "max"},
+            config=_CFG, clock=self._clock,
+        )
+
+    def _clock(self) -> float:
+        return self.now
+
+    def clone(self) -> "_State":
+        new = _State(type(self.ctrl))
+        new.now = self.now
+        new.shadow_below = self.shadow_below
+        new.violations = self.violations
+        src, dst = self.ctrl.pools[POOL], new.ctrl.pools[POOL]
+        for f in _POOL_FIELDS:
+            setattr(dst, f, getattr(src, f))
+        new.ctrl.cycles = self.ctrl.cycles
+        return new
+
+
+def _obs(rate: float, *, degraded: bool = False, slo=None) -> Observation:
+    return Observation(
+        request_rate=rate, mean_isl=64.0, mean_osl=32.0,
+        slo_attainment=slo, control_plane_degraded=degraded,
+    )
+
+
+class PlannerModel(Model):
+    name = "planner"
+    max_depth = C.MODEL_DEPTHS["planner"]
+    # Injection point for the fixture suite: a controller subclass with
+    # the guard rails removed proves the invariants can fire.
+    controller_cls: type = PlannerController
+
+    def initial_states(self):
+        yield "steady", _State(self.controller_cls)
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        return [
+            ("cycle_degraded", lambda s: self._cycle(s, _obs(1.0, degraded=True))),
+            ("cycle_demand_1", lambda s: self._cycle(s, _obs(1.0))),
+            ("cycle_demand_3", lambda s: self._cycle(s, _obs(3.0))),
+            ("cycle_demand_5", lambda s: self._cycle(s, _obs(5.0))),
+            ("cycle_slo_miss", lambda s: self._cycle(
+                s, _obs(1.0, slo={"ttft": 0.5, "tpot": 1.0}))),
+        ]
+
+    def _cycle(self, state: _State, obs: Observation) -> _State:
+        st = state.clone()
+        st.now += INTERVAL
+        pool = st.ctrl.pools[POOL]
+        prev_target = pool.target
+        prev_up_t = pool.last_scale_up_t
+        prev_down_t = pool.last_scale_down_t
+        prev_streak = pool.below_streak
+        prev_calls = len(st.connector.calls)
+        bad: list[str] = []
+
+        actions = _run(st.ctrl.cycle(obs))
+        action = actions.get(POOL, "<missing>")
+        calls = st.connector.calls[prev_calls:]
+
+        if obs.control_plane_degraded:
+            if action != "degraded_hold":
+                bad.append(f"degraded cycle decided {action!r}")
+            if pool.target != prev_target:
+                bad.append(
+                    f"degraded cycle moved target {prev_target}->{pool.target}"
+                )
+            if calls:
+                bad.append(f"degraded cycle actuated: {calls}")
+            if pool.below_streak != prev_streak:
+                bad.append(
+                    "degraded cycle advanced the hysteresis streak "
+                    f"{prev_streak}->{pool.below_streak}"
+                )
+        else:
+            if calls != [(POOL, pool.target)]:
+                bad.append(
+                    f"healthy cycle actuated {calls!r}, expected one "
+                    f"reconcile at target {pool.target}"
+                )
+            delta = pool.target - prev_target
+            if delta > STEP_UP or delta < -STEP_DOWN:
+                bad.append(f"target moved {delta:+d} in one cycle")
+            if not MIN_R <= pool.target <= MAX_R:
+                bad.append(f"target {pool.target} outside [{MIN_R},{MAX_R}]")
+            if action == "scale_up" and st.now - prev_up_t < UP_CD:
+                bad.append(
+                    f"scale-up {st.now - prev_up_t:.1f}s after the last "
+                    f"(cooldown {UP_CD}s)"
+                )
+            if action == "scale_down":
+                if st.now - prev_down_t < DOWN_CD:
+                    bad.append(
+                        f"scale-down {st.now - prev_down_t:.1f}s after the "
+                        f"last (cooldown {DOWN_CD}s)"
+                    )
+                if st.shadow_below + 1 < DOWN_CYCLES:
+                    bad.append(
+                        "scale-down after only "
+                        f"{st.shadow_below + 1} below-target cycle(s) "
+                        f"(need {DOWN_CYCLES})"
+                    )
+            # Independent shadow streak from the desired/target trace.
+            if pool.desired < prev_target:
+                st.shadow_below += 1
+            else:
+                st.shadow_below = 0
+        if bad:
+            st.violations = st.violations + tuple(bad)
+        return st
+
+    def invariants(self, state: _State) -> list[str]:
+        return list(state.violations)
+
+    def fingerprint(self, state: _State) -> Any:
+        pool = state.ctrl.pools[POOL]
+        cap_up = min(UP_CD + INTERVAL, state.now - pool.last_scale_up_t)
+        cap_down = min(DOWN_CD + INTERVAL, state.now - pool.last_scale_down_t)
+        return (
+            pool.target, pool.desired, pool.last_action,
+            min(pool.below_streak, DOWN_CYCLES + 1),
+            min(state.shadow_below, DOWN_CYCLES + 1),
+            cap_up, cap_down,
+            state.violations,
+        )

@@ -1,0 +1,241 @@
+"""Quarantine model: EndpointClient's lease-expiry quarantine machine
+(runtime/component.py) as an executable miniature under a virtual clock.
+
+One instance, explored through every interleaving of watch events (PUT,
+lease-expiry DELETE with each egress-stats verdict, explicit DELETE),
+ground-truth liveness flips, reconnect reconciliation, and due sweeps.
+The real EndpointClient entangles a store session, dataplane egress and
+an event loop, so — like the cursor model — this is a faithful
+transcription of the decision logic rather than a drive of the class;
+the transition rules mirror ``_on_discovery_event`` / ``_sweep_quarantine``
+/ ``_reconcile`` line for line.
+
+Invariants checked at EVERY reachable state:
+
+- **explicit deregisters are honored** — after an explicit DELETE (a
+  graceful drain said goodbye), the instance is neither routable nor
+  quarantined until a fresh PUT re-registers it;
+- **quarantine implies routable** — the grace window exists to KEEP the
+  instance routable while it is probed; a quarantine entry for an
+  unregistered instance is a leak;
+- **bounded grace** — once the lease-expiry DELETE for a dead instance
+  has been processed, the instance is either removed or quarantined with
+  a due probe no further than one grace window out: no routing past
+  grace to a truly-dead instance;
+- **no quarantine-forever (liveness)** — from ANY state where a dead
+  instance sits in quarantine, running the due sweeps (whose probes see
+  the ground truth) removes it within two rounds — the exact bug shape
+  a sweep that re-arms unconditionally would introduce;
+- **counter sanity** — recoveries + expiries never exceed quarantine
+  entries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tools.dynacheck import config as C
+from tools.dynacheck.explore import Model
+
+GRACE_S = 4.0
+PROBE_SOON_S = 1.0
+
+
+class _State:
+    def __init__(self) -> None:
+        self.now = 0.0
+        self.live = True            # ground truth: backend process alive
+        self.registered = True      # in EndpointClient.instances (routable)
+        self.store_has = True       # record present in the store listing
+        self.quarantine_due = None  # due time in _quarantine, or None
+        self.lease_lost = False     # lease-expiry DELETE processed, no PUT since
+        self.explicit_pending = False  # explicit DELETE processed, no PUT since
+        self.quarantined_total = 0
+        self.recovered_total = 0
+        self.expired_total = 0
+
+    def clone(self) -> "_State":
+        new = _State.__new__(_State)
+        new.__dict__.update(self.__dict__)
+        return new
+
+
+class QuarantineModel(Model):
+    name = "quarantine"
+    max_depth = C.MODEL_DEPTHS["quarantine"]
+    # Injection point for the fixture suite: True makes the due sweep
+    # re-arm even when the probe says dead — the quarantine-forever bug.
+    sweep_rearms_dead: bool = False
+
+    def initial_states(self):
+        yield "registered", _State()
+
+    def actions(self, state: _State) -> list[tuple[str, Callable[[Any], Any]]]:
+        acts: list[tuple[str, Callable[[Any], Any]]] = [
+            ("ev_put", self._ev_put),
+        ]
+        if state.live:
+            acts.append(("kill", self._kill))
+        else:
+            acts.append(("revive", self._revive))
+        if state.registered:
+            # Lease-expiry DELETE: the egress-stats judge can say
+            # connected (possibly stale), breaker-open, or nothing.
+            acts.append(("ev_lease_judged_up", self._lease_up))
+            acts.append(("ev_lease_judged_down", self._lease_down))
+            acts.append(("ev_lease_judged_unknown", self._lease_unknown))
+        if state.registered or state.quarantine_due is not None:
+            acts.append(("ev_explicit_delete", self._explicit))
+        if state.quarantine_due is not None:
+            acts.append(("sweep_due", self._sweep_due))
+        if state.registered and not state.store_has:
+            acts.append(("reconcile_missing", self._reconcile))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    # -- transitions (mirroring component.py) ------------------------------
+
+    @staticmethod
+    def _ev_put(state: _State) -> _State:
+        st = state.clone()
+        st.store_has = True
+        st.registered = True
+        st.lease_lost = False
+        st.explicit_pending = False
+        if st.quarantine_due is not None:
+            st.quarantine_due = None
+            st.recovered_total += 1
+        return st
+
+    @staticmethod
+    def _kill(state: _State) -> _State:
+        st = state.clone()
+        st.live = False
+        return st
+
+    @staticmethod
+    def _revive(state: _State) -> _State:
+        st = state.clone()
+        st.live = True
+        return st
+
+    def _lease_expired(self, state: _State, judged) -> _State:
+        st = state.clone()
+        st.store_has = False
+        st.lease_lost = True
+        if judged is False:
+            return self._remove(st)
+        delay = GRACE_S if judged else PROBE_SOON_S
+        if st.quarantine_due is None:
+            st.quarantined_total += 1
+        st.quarantine_due = st.now + delay
+        return st
+
+    def _lease_up(self, state: _State) -> _State:
+        return self._lease_expired(state, True)
+
+    def _lease_down(self, state: _State) -> _State:
+        return self._lease_expired(state, False)
+
+    def _lease_unknown(self, state: _State) -> _State:
+        return self._lease_expired(state, None)
+
+    def _explicit(self, state: _State) -> _State:
+        st = state.clone()
+        st.store_has = False
+        st.explicit_pending = True
+        return self._remove(st)
+
+    @staticmethod
+    def _remove(st: _State) -> _State:
+        st.registered = False
+        st.quarantine_due = None
+        st.lease_lost = False
+        return st
+
+    def _sweep_due(self, state: _State) -> _State:
+        # The sweep task wakes at the due time and probes; the probe is a
+        # real dial, so it sees the ground truth.
+        st = state.clone()
+        st.now = max(st.now, st.quarantine_due)
+        if st.live or self.sweep_rearms_dead:
+            st.quarantine_due = st.now + GRACE_S
+        else:
+            st.expired_total += 1
+            self._remove(st)
+        return st
+
+    def _reconcile(self, state: _State) -> _State:
+        # Reconnect reconciliation: a cached instance missing from the
+        # listing is probed; alive → quarantined, dead → removed.
+        st = state.clone()
+        if st.live:
+            if st.quarantine_due is None:
+                st.quarantined_total += 1
+                st.quarantine_due = st.now + GRACE_S
+        else:
+            self._remove(st)
+        return st
+
+    # -- invariants --------------------------------------------------------
+
+    def invariants(self, state: _State) -> list[str]:
+        out: list[str] = []
+        if state.explicit_pending and (
+            state.registered or state.quarantine_due is not None
+        ):
+            out.append(
+                "explicit deregister not honored: instance still "
+                f"registered={state.registered}, "
+                f"quarantined={state.quarantine_due is not None}"
+            )
+        if state.quarantine_due is not None and not state.registered:
+            out.append(
+                "quarantine entry for an unregistered instance: the grace "
+                "window exists to keep it routable while probed"
+            )
+        if state.lease_lost and not state.live and state.registered:
+            if state.quarantine_due is None:
+                out.append(
+                    "dead instance routable after lease expiry with no "
+                    "quarantine tracking: nothing will ever remove it"
+                )
+            elif state.quarantine_due - state.now > GRACE_S:
+                out.append(
+                    "dead instance routable with a probe scheduled past "
+                    f"one grace window ({state.quarantine_due - state.now:.1f}s "
+                    f"> {GRACE_S}s)"
+                )
+        # No quarantine-forever (liveness): a dead quarantined instance
+        # must be removed within two due sweeps.
+        if state.quarantine_due is not None and not state.live:
+            sim = state.clone()
+            for _ in range(2):
+                if sim.quarantine_due is None:
+                    break
+                sim = self._sweep_due(sim)
+            if sim.quarantine_due is not None:
+                out.append(
+                    "dead instance quarantined forever: two due sweeps "
+                    "with failing probes did not remove it"
+                )
+        if state.recovered_total + state.expired_total > state.quarantined_total:
+            out.append(
+                f"counter drift: recovered={state.recovered_total} + "
+                f"expired={state.expired_total} > "
+                f"quarantined={state.quarantined_total}"
+            )
+        return out
+
+    def fingerprint(self, state: _State) -> Any:
+        due = (
+            None if state.quarantine_due is None
+            else min(GRACE_S, state.quarantine_due - state.now)
+        )
+        return (
+            state.live, state.registered, state.store_has,
+            state.lease_lost, state.explicit_pending, due,
+            min(state.quarantined_total, 3),
+            min(state.recovered_total, 3),
+            min(state.expired_total, 3),
+        )
