@@ -37,9 +37,15 @@ import uuid
 import msgpack
 from typing import Any, AsyncIterator
 
+from dynamo_tpu import knobs
 from dynamo_tpu.runtime import wire
 
 from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter
+from dynamo_tpu.llm.disagg_pool import (
+    ChunkCursorPublisher,
+    ChunkCursorWatcher,
+    StreamingHandoff,
+)
 from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_pool import PeerKvClient
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
@@ -505,6 +511,7 @@ async def run_jax_worker(
     # /metrics (queue depth, budget utilization, acceptance rate, hit
     # rate, ...) — evaluated at scrape time against the live core.
     from dynamo_tpu.runtime.status_server import (
+        bind_disagg_gauges,
         bind_fair_queue_gauges,
         bind_kv_cache_gauges,
         bind_kv_pool_gauges,
@@ -591,6 +598,15 @@ async def run_jax_worker(
                     out["kv_transfer_params"]["worker_id"] = worker_id
                 yield out
 
+        # Streaming handoff (ISSUE 17): advertise committed chunks on the
+        # cursor plane as they land, so decode pullers overlap transfer
+        # with this worker's remaining prefill compute.
+        cursor_pub = ChunkCursorPublisher(runtime.store, namespace, worker_id)
+        await cursor_pub.start()
+        core.on_chunk_commit = cursor_pub.engine_callback(
+            asyncio.get_running_loop()
+        )
+
         async def kv_transfer_handler(request: Any, context: Context) -> AsyncIterator[Any]:
             # v2 streamed transfer: descriptors first (cheap), then page
             # data in chunks — the engine keeps prefilling while pages
@@ -601,8 +617,17 @@ async def run_jax_worker(
             # is one gather at a fixed dispatch cost) against streaming
             # overlap with the consumer's imports.
             chunk = int(request.get(wire.KV_CHUNK_BLOCKS, 32))
+            # Windowed request (streaming handoff): serve only the asked
+            # committed-block window, and keep the hold unless this is
+            # the FINAL window — the puller streams windows while the
+            # prefill is still running, then releases with the tail.
+            windowed = wire.KV_WINDOW_START in request
+            ws = int(request.get(wire.KV_WINDOW_START, 0))
+            wc = request.get(wire.KV_WINDOW_COUNT)
+            wc = int(wc) if wc is not None else None
+            release = (not windowed) or bool(request.get(wire.KV_WINDOW_FINAL))
             try:
-                descs = core.export_descriptors(rid)
+                descs = core.export_descriptors(rid, start=ws, count=wc)
             except KeyError:
                 yield {wire.KV_ERROR: f"no held blocks for {rid}"}
                 return
@@ -611,7 +636,8 @@ async def run_jax_worker(
             try:
                 for s in range(0, len(descs), chunk):
                     pages = await asyncio.to_thread(
-                        core.read_held_pages, rid, s, chunk
+                        core.read_held_pages, rid, ws + s,
+                        min(chunk, len(descs) - s),
                     )
                     yield {
                         wire.KV_VERSION: core.KV_WIRE_VERSION,
@@ -619,7 +645,8 @@ async def run_jax_worker(
                         wire.KV_PAGES: pages,
                     }
             finally:
-                core.release_held(rid)
+                if release:
+                    core.release_held(rid)
 
         transfer_ep = (
             runtime.namespace(namespace).component(component).endpoint("kv_transfer")
@@ -738,6 +765,18 @@ async def run_jax_worker(
         peer_kv = PeerKvClient(core, fetch_client)
         _peer_clients.append(peer_kv)
 
+        # Streaming handoff (ISSUE 17): follow prefill chunk cursors and
+        # pull committed windows while the remote prefill is still
+        # chunking. Gated by DYN_DISAGG_STREAMING; a dark cursor plane
+        # (old prefill fleet, store hiccup) degrades to the reply-gated
+        # pull via the cursor timeout.
+        handoff: StreamingHandoff | None = None
+        if knobs.get_bool("DYN_DISAGG_STREAMING"):
+            cursor_watch = ChunkCursorWatcher(runtime.store, namespace)
+            await cursor_watch.start()
+            handoff = StreamingHandoff(peer_kv, cursor_watch, transfer_client)
+            bind_disagg_gauges(runtime.status, handoff.stats.as_dict)
+
         qname = _prefill_queue(namespace)
 
         async def handler(request: Any, context: Context) -> AsyncIterator[Any]:
@@ -780,6 +819,7 @@ async def run_jax_worker(
                     async for out in _remote_prefill_then_decode(
                         core, engine, pre, context, runtime.store, qname,
                         transfer_client, emitted, tracer=disagg.tracer,
+                        handoff=handoff,
                     ):
                         yield out
                     return
@@ -996,14 +1036,21 @@ async def _run_multihost(
 async def _remote_prefill_then_decode(
     core, engine, pre: PreprocessedRequest, context: Context,
     store, qname: str, transfer_client, emitted: list[int] | None = None,
-    tracer=None, reply_timeout: float = 120.0,
+    tracer=None, reply_timeout: float = 120.0, handoff=None,
 ) -> AsyncIterator[Any]:
     """Decode-first disaggregation: queued remote prefill, block pull,
     local continuation by token replay (reference handlers.py:113-151;
     queue flow disagg_serving.md:28-66).
 
     ``emitted`` (if given) collects every token yielded to the caller so a
-    mid-stream failure can resume instead of replaying the stream."""
+    mid-stream failure can resume instead of replaying the stream.
+
+    ``handoff`` (a :class:`StreamingHandoff`) overlaps the KV transfer
+    with the remote prefill itself: committed chunk windows stream in
+    while the prefill is still running, and a fully streamed handoff
+    skips the reply-gated pull below entirely. Any streaming failure —
+    at any chunk boundary — falls through to that legacy pull, and
+    failing that to the caller's local-recompute replay, bit-identically."""
     from dynamo_tpu.llm.protocols.common import LLMEngineOutput
     from dynamo_tpu.runtime.store.client import StoreClient
 
@@ -1014,6 +1061,11 @@ async def _remote_prefill_then_decode(
     )
     reply_key = f"/dynamo/prefill-reply/{pre.request_id}-{uuid.uuid4().hex[:8]}"
     sub = await store.kv_watch(reply_key, with_initial=False)
+    # Start following the chunk cursor BEFORE the queue push: the first
+    # committed chunks may land within the reply round-trip.
+    stream_task: asyncio.Task | None = None
+    if handoff is not None:
+        stream_task = asyncio.create_task(handoff.run(pre.request_id))
     first: dict | None = None
     t_handoff = time.time()
     try:
@@ -1039,6 +1091,10 @@ async def _remote_prefill_then_decode(
         if event.value is not None:
             first = msgpack.unpackb(event.value, raw=False)
     finally:
+        if first is None and stream_task is not None:
+            # Reply timeout / push failure: don't leak a streaming task
+            # that would keep pulling for an abandoned handoff.
+            stream_task.cancel()
         await sub.unsubscribe()
         await store.kv_del(reply_key)
         if tracer is not None:
@@ -1051,16 +1107,49 @@ async def _remote_prefill_then_decode(
                     "ok": first is not None and "error" not in (first or {}),
                 },
             )
-    if first is None:
-        raise ConnectionError("prefill worker returned no output")
-    if "error" in first:
+    if first is None or "error" in first:
+        if stream_task is not None:
+            stream_task.cancel()
+        if first is None:
+            raise ConnectionError("prefill worker returned no output")
         raise ConnectionError(f"remote prefill failed: {first['error']}")
     out1 = LLMEngineOutput.from_wire(first)
     xfer = out1.kv_transfer_params or {}
     prefill_worker = xfer.get("worker_id")
     rid = xfer.get("request_id")
 
-    if prefill_worker is not None and rid is not None:
+    # Streaming handoff resolution: by reply time most chunks should
+    # already be local — wait (bounded) for the in-flight tail. A fully
+    # streamed handoff sent the FINAL window (hold released server-side)
+    # and skips the legacy pull entirely.
+    streamed = False
+    if stream_task is not None:
+        if stream_task.done():
+            streamed = bool(stream_task.result())
+        elif rid is None or handoff.watcher.cursor(rid) is None:
+            # No cursor ever arrived (old prefill fleet, dark event
+            # plane): don't hold TTFT hostage — legacy pull now.
+            stream_task.cancel()
+        else:
+            try:
+                streamed = bool(await asyncio.wait_for(
+                    stream_task, handoff.peer_kv.total_timeout_s
+                ))
+            except asyncio.TimeoutError:
+                streamed = False  # wait_for cancelled the tail
+
+    if prefill_worker is not None and rid is not None and streamed:
+        if tracer is not None:
+            tracer.record(
+                "kv_stream", t_handoff, time.time(), headers=context.headers,
+                attrs={
+                    "request_id": pre.request_id,
+                    "prefill_worker": prefill_worker,
+                    "chunks": handoff.stats.chunks_pulled,
+                    "streamed": True,
+                },
+            )
+    if prefill_worker is not None and rid is not None and not streamed:
         descs: list[dict] | None = None
         imported = total = dropped = 0
         t_xfer = time.time()

@@ -14,20 +14,35 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import logging
 import time
+import uuid
 from typing import Any, AsyncIterator
+
+import msgpack
 
 from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelRuntimeConfig
 from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.llm.protocols.common import (
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
 from dynamo_tpu import knobs
 from dynamo_tpu.runtime import Context, DistributedRuntime, chaos, wire
 from dynamo_tpu.runtime.worker import dynamo_worker
 from dynamo_tpu.tokens import compute_seq_hashes
 
 log = logging.getLogger("dynamo_tpu.backends.mocker")
+
+
+def _prefill_queue(namespace: str) -> str:
+    """Same work-queue name as the jax worker: mock prefill/decode pools
+    interoperate with real ones on the wire."""
+    return f"prefill:{namespace}"
 
 
 async def _pull_peer_prefix_mock(
@@ -110,6 +125,235 @@ async def _pull_peer_prefix_mock(
     return imported
 
 
+class _MockWindowPuller:
+    """PeerKvClient.pull_held_window twin for the mocker's streaming
+    handoff: windows are hash slices pulled over the EXISTING kv_fetch
+    plane (the mock cache retains committed blocks, so there is no hold
+    to window — the decode side computes the request's block hashes
+    itself and asks for ``hashes[start:start+count]``). Each window is
+    priced on the clock by DYN_DISAGG_CHUNK_US_PER_BLOCK, and any hole —
+    short window, dtype mismatch, severed stream — RAISES so the handoff
+    aborts to the reply-gated pull instead of continuing with gaps."""
+
+    def __init__(self, engine: MockTpuEngine, fetch_client):
+        self.engine = engine
+        self.fetch_client = fetch_client
+        # StreamingHandoff's bounded tail-wait reads this, like
+        # PeerKvClient's.
+        self.total_timeout_s = knobs.get_float("DYN_KV_POOL_PULL_TIMEOUT_S")
+        self._hashes: dict[str, list[int]] = {}
+
+    def register(self, request_id: str, token_ids: list[int]) -> None:
+        self._hashes[request_id] = compute_seq_hashes(
+            token_ids, self.engine.args.block_size
+        )
+
+    def forget(self, request_id: str) -> None:
+        self._hashes.pop(request_id, None)
+
+    async def pull_held_window(
+        self, _transfer_client, worker_id, request_id: str,
+        start: int, count: int, final: bool = False,
+    ) -> int:
+        hashes = self._hashes[request_id]
+        window = hashes[start:start + count]
+        if len(window) < count:
+            raise ConnectionError(
+                f"cursor for {request_id} advertises block "
+                f"{start + count} past the {len(hashes)}-block prompt"
+            )
+        if not window:
+            return 0  # empty FINAL window: nothing to release in the mock
+        if chaos.active():
+            await chaos.inject("kv_transfer.pull", str(worker_id))
+        frame_timeout = knobs.get_float("DYN_KV_POOL_FRAME_TIMEOUT_S")
+        stream = await self.fetch_client.direct(
+            worker_id, {wire.KV_HASHES: window}
+        )
+        held: list[int] = []
+        while True:
+            try:
+                frame = await asyncio.wait_for(stream.__anext__(), frame_timeout)
+            except StopAsyncIteration:
+                break
+            dtype = frame.get(wire.KV_DTYPE)
+            if dtype is not None and (
+                (dtype == "int8") != (self.engine.args.kv_dtype == "int8")
+            ):
+                self.engine.peer_stats.dtype_mismatches += 1
+                raise ValueError(
+                    f"KV dtype mismatch: peer pages are {dtype!r}, local "
+                    f"cache is {self.engine.args.kv_dtype!r}"
+                )
+            held.extend(frame.get(wire.KV_HELD) or [])
+        if len(held) < count:
+            raise ConnectionError(
+                f"handoff window short for {request_id}: peer holds "
+                f"{len(held)}/{count} blocks at offset {start}"
+            )
+        parents = [
+            hashes[start + i - 1] if start + i > 0 else None
+            for i in range(count)
+        ]
+        imported, cost_s = self.engine.import_peer_blocks(held[:count], parents)
+        # Chunk-priced handoff on the clock: the streamed copy costs
+        # per-block microseconds x the kv dtype byte ratio, on top of
+        # whatever the kv-pull knob already priced.
+        cost_s += (
+            count
+            * knobs.get_float("DYN_DISAGG_CHUNK_US_PER_BLOCK")
+            * self.engine._kv_byte_ratio
+            / 1e6
+            / self.engine.args.speedup_ratio
+        )
+        if cost_s > 0:
+            await asyncio.sleep(cost_s)
+        return imported
+
+
+async def _remote_prefill_then_decode_mock(
+    engine: MockTpuEngine, pre: PreprocessedRequest, context: Context,
+    store, qname: str, fetch_client, puller: _MockWindowPuller,
+    handoff, emitted: list[int] | None = None, tracer=None,
+    reply_timeout: float = 120.0,
+) -> AsyncIterator[Any]:
+    """The jax worker's _remote_prefill_then_decode, mocker-flavored:
+    queued remote prefill, chunk-streamed (or reply-gated) block pull
+    over kv_fetch, local continuation by token replay. Byte-identical to
+    the aggregated run by the replay_base contract."""
+    from dynamo_tpu.runtime.store.client import StoreClient
+
+    prefill_req = dataclasses.replace(
+        pre,
+        stop=StopConditions(max_tokens=1, ignore_eos=True),
+        kv_transfer_params={"do_remote_decode": True},
+    )
+    reply_key = f"/dynamo/prefill-reply/{pre.request_id}-{uuid.uuid4().hex[:8]}"
+    sub = await store.kv_watch(reply_key, with_initial=False)
+    stream_task: asyncio.Task | None = None
+    if handoff is not None:
+        puller.register(pre.request_id, list(pre.token_ids))
+        stream_task = asyncio.create_task(handoff.run(pre.request_id))
+    first: dict | None = None
+    t_handoff = time.time()
+    try:
+        await store.queue_push(
+            qname,
+            msgpack.packb(
+                {
+                    "request": prefill_req.to_wire(),
+                    "reply_key": reply_key,
+                    "traceparent": (context.headers or {}).get("traceparent"),
+                },
+                use_bin_type=True,
+            ),
+        )
+        ev = await sub.get(timeout=reply_timeout)
+        event = StoreClient.as_watch_event(ev)
+        if event.value is not None:
+            first = msgpack.unpackb(event.value, raw=False)
+    finally:
+        if first is None and stream_task is not None:
+            stream_task.cancel()
+        await sub.unsubscribe()
+        await store.kv_del(reply_key)
+        if tracer is not None:
+            tracer.record(
+                "prefill_handoff", t_handoff, time.time(),
+                headers=context.headers,
+                attrs={
+                    "request_id": pre.request_id,
+                    "prefill_tokens": len(pre.token_ids),
+                    "ok": first is not None and "error" not in (first or {}),
+                },
+            )
+    if first is None or "error" in first:
+        if stream_task is not None:
+            stream_task.cancel()
+            puller.forget(pre.request_id)
+        if first is None:
+            raise ConnectionError("prefill worker returned no output")
+        raise ConnectionError(f"remote prefill failed: {first['error']}")
+    out1 = LLMEngineOutput.from_wire(first)
+    xfer = out1.kv_transfer_params or {}
+    prefill_worker = xfer.get("worker_id")
+    rid = xfer.get("request_id")
+
+    streamed = False
+    if stream_task is not None:
+        try:
+            if stream_task.done():
+                streamed = bool(stream_task.result())
+            elif rid is None or handoff.watcher.cursor(rid) is None:
+                stream_task.cancel()
+            else:
+                try:
+                    streamed = bool(await asyncio.wait_for(
+                        stream_task, puller.total_timeout_s
+                    ))
+                except asyncio.TimeoutError:
+                    streamed = False
+        finally:
+            puller.forget(pre.request_id)
+
+    if prefill_worker is not None and streamed and tracer is not None:
+        tracer.record(
+            "kv_stream", t_handoff, time.time(), headers=context.headers,
+            attrs={
+                "request_id": pre.request_id,
+                "prefill_worker": prefill_worker,
+                "chunks": handoff.stats.chunks_pulled,
+                "streamed": True,
+            },
+        )
+    if prefill_worker is not None and not streamed:
+        # Reply-gated legacy pull: the peer-prefix pull re-imports
+        # idempotently, so blocks a cancelled stream already landed are
+        # skipped by hash.
+        await _pull_peer_prefix_mock(
+            engine, fetch_client, {"worker_id": prefill_worker},
+            list(pre.token_ids),
+        )
+
+    token1 = out1.token_ids[0]
+    first_chunk = LLMEngineOutput(
+        token_ids=[token1], meta=dict(out1.meta, remote_prefill=True)
+    )
+    # The mock tokenizer has no EOS; only explicit stop tokens and the
+    # caller's max_tokens gate token1 (mirrors _first_token_finish).
+    finish = pre.stop.check_token(token1, 1, frozenset())
+    if finish == "length":
+        finish = None
+    if finish is None and pre.stop.max_tokens is not None and pre.stop.max_tokens <= 1:
+        finish = out1.finish_reason or "length"
+    if finish is not None:
+        first_chunk.finish_reason = finish
+        first_chunk.prompt_tokens = len(pre.token_ids)
+        first_chunk.completion_tokens = 1
+        if emitted is not None:
+            emitted.append(token1)
+        yield first_chunk.to_wire()
+        return
+    if emitted is not None:
+        emitted.append(token1)
+    yield first_chunk.to_wire()
+
+    cont = dataclasses.replace(
+        pre,
+        token_ids=list(pre.token_ids) + [token1],
+        stop=pre.stop.after_replay(1),
+        kv_transfer_params=None,
+        # Unlike the jax worker (a real model conditions on the grown
+        # prompt), the mock token function needs the replay count to
+        # continue its cycle where the remote prefill stopped.
+        replayed_tokens=pre.replayed_tokens + 1,
+    )
+    async for out in engine.generate(cont.to_wire(), context):
+        if emitted is not None:
+            emitted.extend(LLMEngineOutput.from_wire(out).token_ids)
+        yield out
+
+
 async def run_mocker(
     runtime: DistributedRuntime,
     model_name: str = "mock-model",
@@ -121,6 +365,8 @@ async def run_mocker(
     engine_out: list | None = None,
     obs_publish: bool = True,
     obs_interval_s: float = 1.0,
+    role: str = "aggregated",
+    disagg_config=None,
 ) -> None:
     args = engine_args or MockEngineArgs()
     engine = MockTpuEngine(args)
@@ -247,7 +493,217 @@ async def run_mocker(
         async for out in engine.generate(request, context):
             yield out
 
-    await endpoint.serve(handler)
+    if role == "prefill":
+        # Disagg prefill pool member (ISSUE 17), mirroring the jax
+        # worker's prefill role: consume the namespace work queue, run
+        # max_tokens=1 prefills, advertise chunk commits on the cursor
+        # plane as they land, reply over a short-TTL lease. Not
+        # registered with the frontend — decode workers own client
+        # traffic.
+        from dynamo_tpu.llm.disagg_pool import ChunkCursorPublisher
+
+        cursor_pub = ChunkCursorPublisher(runtime.store, namespace, worker_id)
+        await cursor_pub.start()
+        # The sim loop runs ON the event loop: the hook may enqueue
+        # directly, no call_soon_threadsafe hop (unlike EngineCore's).
+        engine.on_chunk_commit = cursor_pub.note_nowait
+        engine.cursor_publisher = cursor_pub  # test/benchmark access
+        qname = _prefill_queue(namespace)
+        sem = asyncio.Semaphore(args.max_num_seqs)
+        _inflight: set[asyncio.Task] = set()
+
+        async def _serve_queued(task: dict) -> None:
+            try:
+                req = task["request"]
+                tp = task.get("traceparent")
+                ctx = Context(
+                    req.get("request_id") or f"qprefill-{uuid.uuid4().hex[:8]}",
+                    headers={"traceparent": tp} if tp else None,
+                )
+                last: dict | None = None
+                async for out in engine.generate(req, ctx):
+                    last = out
+                if last is None:
+                    last = {"error": "prefill produced no output"}
+                if last.get("kv_transfer_params"):
+                    last["kv_transfer_params"]["worker_id"] = worker_id
+                lease = await runtime.store.lease_grant(ttl=60.0, keepalive=False)
+                await runtime.store.kv_put(
+                    task["reply_key"],
+                    msgpack.packb(last, use_bin_type=True),
+                    lease=lease,
+                )
+            except Exception:
+                log.exception("queued mock prefill failed")
+                try:
+                    lease = await runtime.store.lease_grant(
+                        ttl=60.0, keepalive=False
+                    )
+                    await runtime.store.kv_put(
+                        task["reply_key"],
+                        msgpack.packb(
+                            {"error": "remote prefill failed"},
+                            use_bin_type=True,
+                        ),
+                        lease=lease,
+                    )
+                except Exception:  # noqa: BLE001 — store down; caller times out
+                    log.warning(
+                        "could not publish prefill-failure reply for %r",
+                        task.get("reply_key"), exc_info=True,
+                    )
+            finally:
+                sem.release()
+
+        async def _consume_queue() -> None:
+            while True:
+                await sem.acquire()
+                try:
+                    payload = await runtime.store.queue_pop(qname, timeout=1.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — store closed on shutdown
+                    log.debug("prefill queue pop failed; consumer exiting",
+                              exc_info=True)
+                    sem.release()
+                    return
+                if payload is None:
+                    sem.release()
+                    continue
+                try:
+                    task = msgpack.unpackb(payload, raw=False)
+                except (ValueError, msgpack.UnpackException):
+                    log.warning("dropping malformed prefill task")
+                    sem.release()
+                    continue
+                t = asyncio.create_task(_serve_queued(task))
+                _inflight.add(t)
+                t.add_done_callback(_inflight.discard)
+
+        await endpoint.serve(handler)
+        consumer = asyncio.create_task(_consume_queue())
+        log.info("mock prefill worker %d ready (model %r)", worker_id, model_name)
+        if served_event is not None:
+            served_event.set()
+        try:
+            await runtime.wait_for_shutdown()
+        finally:
+            consumer.cancel()
+            await cursor_pub.stop()
+        return
+
+    if role == "decode":
+        # Disagg decode pool member: routes long prefills to the prefill
+        # pool and streams committed KV windows back while they run.
+        from dynamo_tpu.llm.disagg import DisaggRouter
+        from dynamo_tpu.llm.disagg_pool import ChunkCursorWatcher, StreamingHandoff
+        from dynamo_tpu.runtime.status_server import bind_disagg_gauges
+        from dynamo_tpu.runtime.tasks import spawn_logged
+
+        disagg = DisaggRouter(disagg_config)
+        spawn_logged(
+            disagg.watch_store(runtime.store, namespace),
+            name="disagg-watch-store", logger=log,
+        )
+        prefill_generate = await (
+            runtime.namespace(namespace).component("prefill")
+            .endpoint("generate").client()
+        )
+        prefill_fetch = await (
+            runtime.namespace(namespace).component("prefill")
+            .endpoint("kv_fetch").client()
+        )
+        puller = _MockWindowPuller(engine, prefill_fetch)
+        handoff = None
+        if knobs.get_bool("DYN_DISAGG_STREAMING"):
+            cursor_watch = ChunkCursorWatcher(runtime.store, namespace)
+            await cursor_watch.start()
+            handoff = StreamingHandoff(puller, cursor_watch, None)
+            bind_disagg_gauges(runtime.status, handoff.stats.as_dict)
+        # Test/benchmark access (engine_out pattern): the handoff stats
+        # are otherwise only visible through /metrics.
+        engine.disagg_handoff = handoff
+        engine.disagg_router = disagg
+        qname = _prefill_queue(namespace)
+
+        async def decode_handler(
+            request: Any, context: Context
+        ) -> AsyncIterator[Any]:
+            if request.get("embed") or request.get("clear_kv_blocks"):
+                async for out in engine.generate(request, context):
+                    yield out
+                return
+            hint = (request.get("kv_transfer_params") or {}).get("peer_prefix")
+            if (
+                hint
+                and hint.get("worker_id") != worker_id
+                and request.get("token_ids")
+            ):
+                await _pull_peer_prefix_mock(
+                    engine, fetch_client, hint, list(request["token_ids"])
+                )
+            pre = PreprocessedRequest.from_wire(request)
+            pre.request_id = pre.request_id or context.id
+            bs = engine.args.block_size
+            cached = bs * len(
+                engine.kv.held_prefix(compute_seq_hashes(pre.token_ids, bs))
+            )
+            uncached = len(pre.token_ids) - cached
+            fallback_replayed = 0
+            depth = 0
+            if prefill_generate.instance_ids():
+                try:
+                    depth = await runtime.store.queue_len(qname)
+                except Exception:  # noqa: BLE001 — store hiccup: stay local
+                    log.debug("queue_len failed; treating prefill queue as "
+                              "full (local prefill)", exc_info=True)
+                    depth = disagg.config.max_prefill_queue_size + 1
+            if (
+                prefill_generate.instance_ids()
+                and disagg.decide(
+                    uncached, depth,
+                    headers=context.headers, request_id=pre.request_id,
+                )
+            ):
+                emitted: list[int] = []
+                try:
+                    async for out in _remote_prefill_then_decode_mock(
+                        engine, pre, context, runtime.store, qname,
+                        prefill_fetch, puller, handoff, emitted,
+                        tracer=disagg.tracer,
+                    ):
+                        yield out
+                    return
+                except Exception:
+                    log.exception(
+                        "remote mock prefill failed for %s; falling back "
+                        "to local", pre.request_id,
+                    )
+                if emitted:
+                    stop = pre.stop.after_replay(len(emitted))
+                    if stop.max_tokens is not None:
+                        stop.max_tokens = max(1, stop.max_tokens)
+                    fallback_replayed = len(emitted)
+                    pre = dataclasses.replace(
+                        pre,
+                        token_ids=list(pre.token_ids) + emitted,
+                        stop=stop,
+                        kv_transfer_params=None,
+                        replayed_tokens=pre.replayed_tokens + len(emitted),
+                    )
+            async for out in engine.generate(pre.to_wire(), context):
+                if fallback_replayed and out.get("finish_reason") is not None:
+                    # Charge replayed tokens once (same usage fix-up as
+                    # the jax decode handler's in-worker fallback).
+                    if out.get("prompt_tokens") is not None:
+                        out["prompt_tokens"] -= fallback_replayed
+                    if out.get("completion_tokens") is not None:
+                        out["completion_tokens"] += fallback_replayed
+                yield out
+
+        await endpoint.serve(decode_handler)
+    else:
+        await endpoint.serve(handler)
     await register_llm(
         endpoint,
         ModelDeploymentCard(
@@ -263,7 +719,7 @@ async def run_mocker(
             ),
         ),
     )
-    log.info("mocker worker %d serving model %r", worker_id, model_name)
+    log.info("mocker %s worker %d serving model %r", role, worker_id, model_name)
     if served_event is not None:
         served_event.set()
     await runtime.wait_for_shutdown()
@@ -273,7 +729,14 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu mocker worker")
     ap.add_argument("--model-name", default="mock-model")
     ap.add_argument("--namespace", default="dynamo")
-    ap.add_argument("--component", default="backend")
+    ap.add_argument("--component", default=None, help="defaults by role")
+    ap.add_argument("--role", default="aggregated",
+                    choices=["aggregated", "prefill", "decode"],
+                    help="disagg pool role: 'prefill' consumes the "
+                         "namespace prefill work queue and streams chunk "
+                         "cursors; 'decode' routes long prefills there "
+                         "and pulls committed KV windows while they run "
+                         "(streams stay byte-identical to 'aggregated')")
     ap.add_argument("--num-kv-blocks", type=int, default=8192)
     ap.add_argument("--block-size", type=int, default=32)
     ap.add_argument("--max-num-seqs", type=int, default=256)
@@ -379,17 +842,22 @@ def main() -> None:
         max_waiting=args.max_waiting,
     )
 
+    component = args.component or (
+        args.role if args.role != "aggregated" else "backend"
+    )
+
     @dynamo_worker()
     async def entry(runtime: DistributedRuntime) -> None:
         await run_mocker(
             runtime,
             model_name=args.model_name,
             namespace=args.namespace,
-            component=args.component,
+            component=component,
             engine_args=engine_args,
             context_length=args.context_length,
             obs_publish=args.obs_publish == "on",
             obs_interval_s=args.obs_interval_s,
+            role=args.role,
         )
 
     entry()

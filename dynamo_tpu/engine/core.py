@@ -1113,6 +1113,13 @@ class EngineCore:
         self._step_lock = threading.Lock()
         self._embed_lock = threading.Lock()
         self._held: dict[str, Sequence] = {}
+        # Chunk-commit notification hook: called as
+        # ``on_chunk_commit(request_id, committed_blocks, done)`` each
+        # time a hold_blocks sequence commits prefill chunks (and once
+        # with done=True at finish). Invoked UNDER the step lock on the
+        # engine thread — the callback must be non-blocking and must not
+        # re-enter the core (hop to the event loop to publish).
+        self.on_chunk_commit = None
         # Disagg transfer accounting (imported vs dropped must be
         # distinguishable — a half-dropped transfer silently recomputes on
         # the decode side; VERDICT r4 weak #7). Surfaced via metrics().
@@ -1707,6 +1714,11 @@ class EngineCore:
             seq.block_ids[idx] = canonical
             seq.pinned_hashes.append(blk.block_hash)
             seq.committed_blocks += 1
+        if completed and seq.hold_blocks and self.on_chunk_commit is not None:
+            # Streaming handoff: the committed prefix is immutable and
+            # readable from now on — advertise the chunk cursor so a
+            # decode peer can pull it while this prefill keeps chunking.
+            self.on_chunk_commit(seq.request_id, seq.committed_blocks, False)
 
     def _assemble_ragged(
         self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
@@ -3758,6 +3770,12 @@ class EngineCore:
                 self._held_deadline[seq.request_id] = (
                     time.monotonic() + self.engine.held_block_ttl_s
                 )
+            if self.on_chunk_commit is not None:
+                # Final cursor: the hold is complete, only the tail (if
+                # anything) remains for a streaming puller.
+                self.on_chunk_commit(
+                    seq.request_id, seq.committed_blocks, True
+                )
         else:
             self._release_blocks(seq)
 
@@ -3791,12 +3809,33 @@ class EngineCore:
 
     KV_WIRE_VERSION = 2
 
-    def export_descriptors(self, request_id: str) -> list[dict]:
+    def _streaming_seq(self, request_id: str) -> "Sequence | None":
+        """The RUNNING hold_blocks sequence for ``request_id``, if any —
+        the streaming-handoff source while prefill is still chunking
+        (once it finishes, the sequence moves to ``_held``). Resolved by
+        scanning ``running`` so release paths need no delisting: cancel,
+        preemption, and finish all remove the sequence from ``running``,
+        which makes a mid-stream puller see KeyError and fall back to
+        local recompute. Callers must hold ``_step_lock``."""
+        for seq in self.running:
+            if seq.request_id == request_id and seq.hold_blocks:
+                return seq
+        return None
+
+    def export_descriptors(
+        self, request_id: str, start: int = 0, count: int | None = None
+    ) -> list[dict]:
         """Phase 1: descriptor snapshot of a held prefill's committed
         blocks. The hold stays until :meth:`release_held` (the caller
-        releases after the data phase)."""
+        releases after the data phase).
+
+        ``start``/``count`` select a committed-block window for the
+        streaming handoff (chunk-pipelined pulls while the prefill is
+        still running — the sequence serves from ``running`` before it
+        ever reaches ``_held``). Defaults describe the whole committed
+        prefix, the legacy pull-after-prefill shape."""
         with self._step_lock:
-            seq = self._held.get(request_id)
+            seq = self._held.get(request_id) or self._streaming_seq(request_id)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
             self._touch_hold(request_id)
@@ -3831,9 +3870,15 @@ class EngineCore:
             if self.engine.kv_quantized:
                 layout["scale_dtype"] = "float32"
                 layout["scale_shape"] = shape[:-1]
+            lo = max(0, start)
+            hi = seq.committed_blocks
+            if count is not None:
+                hi = min(hi, lo + max(0, count))
             descs: list[dict] = []
-            parent: int | None = None
-            for i in range(seq.committed_blocks):
+            parent: int | None = (
+                seq.pinned_hashes[lo - 1] if lo > 0 else None
+            )
+            for i in range(lo, hi):
                 # pinned_hashes tracks every committed block in order —
                 # including generated-token blocks past the prompt, which
                 # prompt_hashes would miss (IndexError at large max_tokens).
@@ -3856,7 +3901,7 @@ class EngineCore:
         the blocking device->host landing runs unlocked — held blocks are
         pinned, and device executions are in-order."""
         with self._step_lock:
-            seq = self._held.get(request_id)
+            seq = self._held.get(request_id) or self._streaming_seq(request_id)
             if seq is None:
                 raise KeyError(f"no held blocks for request {request_id}")
             self._touch_hold(request_id)
@@ -3959,12 +4004,33 @@ class EngineCore:
                 time.monotonic() + self.engine.held_block_ttl_s
             )
 
+    def chunk_cursor(self, request_id: str) -> tuple[int, bool]:
+        """The streaming-handoff cursor: (committed blocks readable now,
+        prefill finished). KeyError when the request holds nothing —
+        either never seen or already released (pullers fall back)."""
+        with self._step_lock:
+            seq = self._held.get(request_id)
+            if seq is not None:
+                return seq.committed_blocks, True
+            seq = self._streaming_seq(request_id)
+            if seq is None:
+                raise KeyError(f"no held blocks for request {request_id}")
+            return seq.committed_blocks, False
+
     def release_held(self, request_id: str) -> None:
         with self._step_lock:
             self._held_deadline.pop(request_id, None)
             seq = self._held.pop(request_id, None)
             if seq is not None:
                 self._release_blocks(seq)
+                return
+            # Still running (streaming handoff abandoned early): drop
+            # the hold intent so _finish releases the blocks immediately
+            # instead of pinning them until the TTL sweep. Clearing
+            # hold_blocks also stops _streaming_seq from serving windows.
+            seq = self._streaming_seq(request_id)
+            if seq is not None:
+                seq.hold_blocks = False
 
     def import_blocks(self, blocks: list[dict]) -> ImportResult:
         """Write transferred KV pages into the local cache as inactive

@@ -51,6 +51,7 @@ from dynamo_tpu.fleet.workload import (
     generate_arrivals,
     tenant_hue,
 )
+from dynamo_tpu.llm.disagg.target import choose_decode_target
 from dynamo_tpu.llm.kv_router.netcost import NetCostModel, NetworkAwareSelector
 from dynamo_tpu.llm.kv_router.protocols import RouterConfig
 from dynamo_tpu.llm.kv_router.router import best_peer_hint
@@ -140,6 +141,11 @@ class FleetSpec:
     base_iter_us: float = 20_000.0
     prefill_us_per_token: float = 100.0
     decode_us_per_seq: float = 5_000.0
+    # Step scheduler ("chunked" | "waves"), passed to every worker's
+    # mock engine. Waves is where disagg earns its keep: an aggregated
+    # worker stalls every decode lane while a prompt prefills, a disagg
+    # decode worker never prefills (its continuations arrive cached).
+    scheduling: str = "chunked"
     # Routing.
     network_aware: bool = False
     overlap_weight: float = 1.0
@@ -187,6 +193,25 @@ class FleetSpec:
     # Keep per-request token streams in the report (the bit-identity
     # audits want them; the big bench fleet turns them off to save RAM).
     keep_streams: bool = True
+    # Disaggregated topology (ISSUE 17): split the fleet into a prefill
+    # pool and a decode pool. Arrivals whose prompt exceeds
+    # ``max_local_prefill_tokens`` run their prefill on a prefill-pool
+    # worker (max_tokens=1 — TTFT comes from that worker), then the KV
+    # hands off to a COST-CHOSEN decode worker (the production
+    # ``choose_decode_target``) where the stream continues by token
+    # replay, bit-identically. ``streaming_handoff`` prices the
+    # chunk-pipelined transfer: all but the final ``disagg_chunk_blocks``
+    # window moved while prefill was still chunking, so only the tail
+    # charge lands on the decode clock; False replays the legacy
+    # pull-after-prefill (every block billed after prefill completes).
+    # The planner sees the pools separately ({"prefill", "decode"}
+    # components) and shifts the ratio live.
+    disagg: bool = False
+    max_local_prefill_tokens: int = 32
+    disagg_chunk_blocks: int = 16
+    streaming_handoff: bool = True
+    # Initial/static prefill share of the pool (each pool keeps >= 1).
+    prefill_fraction: float = 0.34
 
 
 @dataclass
@@ -206,9 +231,12 @@ class _Rec:
 
 
 class SimWorker:
-    def __init__(self, wid: int, spec: FleetSpec, t0: float):
+    def __init__(
+        self, wid: int, spec: FleetSpec, t0: float, role: str = "backend"
+    ):
         self.id = wid
         self.spec = spec
+        self.role = role                       # "backend" | "prefill" | "decode"
         self.vt = t0                           # local virtual clock
         self.draining = False
         self.dead = False
@@ -226,6 +254,7 @@ class SimWorker:
                 base_iter_us=spec.base_iter_us,
                 prefill_us_per_token=spec.prefill_us_per_token,
                 decode_us_per_seq=spec.decode_us_per_seq,
+                scheduling=spec.scheduling,
                 kv_pull_us_per_block=0.0,      # pulls priced per-source here
             )
         )
@@ -266,10 +295,15 @@ class SimConnector:
     async def set_replicas(self, component: str, replicas: int) -> None:
         h = self.harness
         self.calls.append((h.t, component, replicas))
-        live = [w for w in h.workers if not w.dead and not w.draining]
+        role = component if h.spec.disagg else "backend"
+        live = [
+            w
+            for w in h.workers
+            if not w.dead and not w.draining and w.role == role
+        ]
         if replicas > len(live):
             for _ in range(replicas - len(live)):
-                h.spawn_worker()
+                h.spawn_worker(role=role)
             self.scale_ups += 1
         elif replicas < len(live):
             # Victim choice mirrors an orchestrator draining the
@@ -284,8 +318,11 @@ class SimConnector:
             self.scale_downs += 1
 
     def current(self, component: str) -> int:
+        role = component if self.harness.spec.disagg else "backend"
         return sum(
-            1 for w in self.harness.workers if not w.dead and not w.draining
+            1
+            for w in self.harness.workers
+            if not w.dead and not w.draining and w.role == role
         )
 
 
@@ -322,6 +359,12 @@ class FleetReport:
     blackout_shed: int = 0           # NEW requests shed mid-blackout
     reregister_lag_s: float = 0.0    # slowest post-recovery re-register
     kv_resyncs: int = 0              # inventory resyncs on session replay
+    # Disagg audit (ISSUE 17; all zero on an aggregated fleet).
+    e2e_p50_ms: float = 0.0          # arrival -> last token, completions
+    remote_prefills: int = 0         # requests whose prefill ran remote
+    handoffs_streamed: int = 0       # KV handoffs that landed via import
+    handoff_fallbacks: int = 0       # handoffs degraded to local recompute
+    handoff_blocks: int = 0          # blocks moved prefill -> decode
 
     def summary(self) -> dict:
         d = {k: v for k, v in self.__dict__.items() if k != "streams"}
@@ -339,6 +382,20 @@ class FleetHarness:
         self.retired_drained = 0
         self.migrations = 0
         self.failed_pulls = 0
+        # Disagg handoff ledger (ISSUE 17): rid -> pending handoff info
+        # while the remote prefill runs; _handed_off marks prefill legs
+        # whose continuation already landed on a decode worker.
+        self._handoffs: dict[str, dict] = {}
+        self._handed_off: set[str] = set()
+        # Continuations in flight to a decode worker: wid -> [(ready_t,
+        # seq)]. Delivered when the TARGET's own clock reaches ready_t —
+        # never by jumping its clock, which would steal virtual time
+        # from co-resident decode lanes.
+        self._pending_cont: dict[int, list[tuple[float, _Seq]]] = {}
+        self.remote_prefills = 0
+        self.handoffs_streamed = 0
+        self.handoff_fallbacks = 0
+        self.handoff_blocks = 0
         self.placements: dict[int, int] = {}
         self.pulls_by_source: dict[int, int] = {}
         self.recs: dict[str, _Rec] = {}
@@ -409,7 +466,14 @@ class FleetHarness:
         self.controller = PlannerController(
             self.planner,
             self.connector,
-            pools={"backend": "max"},   # aggregated mocker fleet
+            # Aggregated fleet: one pool sized to the max requirement.
+            # Disagg fleet: the planner's native split — prefill and
+            # decode scale independently, so the ratio shifts live.
+            pools=(
+                {"prefill": "prefill", "decode": "decode"}
+                if spec.disagg
+                else {"backend": "max"}
+            ),
             config=spec.controller
             or ControllerConfig(
                 interval_s=spec.control_interval_s,
@@ -425,17 +489,32 @@ class FleetHarness:
             clock=lambda: self.t,
         )
         start = spec.initial_replicas if spec.planner_on else spec.static_replicas
-        for pool in self.controller.pools.values():
-            pool.target = pool.desired = start
-        for _ in range(start):
-            self.spawn_worker()
+        if spec.disagg:
+            starts = self._pool_split(start)
+            for comp, pool in self.controller.pools.items():
+                pool.target = pool.desired = starts[comp]
+            for comp in ("prefill", "decode"):
+                for _ in range(starts[comp]):
+                    self.spawn_worker(role=comp)
+        else:
+            for pool in self.controller.pools.values():
+                pool.target = pool.desired = start
+            for _ in range(start):
+                self.spawn_worker()
         # Per-window stats the controller tick turns into an Observation.
         self._win = self._fresh_window()
 
     # -- fleet plumbing ----------------------------------------------------
 
-    def spawn_worker(self) -> SimWorker:
-        w = SimWorker(self._next_wid, self.spec, self.t)
+    def _pool_split(self, total: int) -> dict[str, int]:
+        """Split ``total`` replicas into disagg pools: the prefill pool
+        gets ``prefill_fraction`` of the budget, both pools keep >= 1."""
+        total = max(2, total)
+        p = max(1, min(total - 1, round(total * self.spec.prefill_fraction)))
+        return {"prefill": p, "decode": total - p}
+
+    def spawn_worker(self, role: str = "backend") -> SimWorker:
+        w = SimWorker(self._next_wid, self.spec, self.t, role=role)
         self._next_wid += 1
         self.workers.append(w)
         self.placements.setdefault(w.id, 0)
@@ -545,11 +624,25 @@ class FleetHarness:
         exclude: set[int] | None = None,
         deadline: bool = True,
     ) -> None:
+        # Disagg: a fresh long-prompt arrival runs its prefill on the
+        # prefill pool, then hands off (the streaming-handoff contract).
+        # Replays (migration, handoff fallback) and short prompts decode
+        # locally in the decode pool — and if the prefill pool is gone,
+        # the remote route degrades to exactly that local path.
+        if (
+            self.spec.disagg
+            and replay_base == 0
+            and exclude is None
+            and len(arr.token_ids) > self.spec.max_local_prefill_tokens
+            and self._route_remote_prefill(arr, deadline=deadline)
+        ):
+            return
         cands = [
             w
             for w in self._live(routable=True)
             if (not exclude or w.id not in exclude)
             and self._discovered(w, self.t)
+            and (not self.spec.disagg or w.role == "decode")
         ]
         in_blackout = self._store_dark and replay_base == 0
         if not cands:
@@ -643,6 +736,173 @@ class FleetHarness:
             self.pulls_by_source.get(source, 0) + imported
         )
 
+    # -- disaggregated topology (ISSUE 17) ---------------------------------
+
+    def _route_remote_prefill(self, arr: Arrival, *, deadline: bool) -> bool:
+        """Place the prefill leg (max_tokens=1) on the least-loaded
+        prefill-pool worker; the first token — TTFT — streams from there.
+        Returns False when no prefill worker is routable, and the caller
+        degrades to a local decode-pool route."""
+        cands = [
+            w
+            for w in self._live(routable=True)
+            if w.role == "prefill" and self._discovered(w, self.t)
+        ]
+        if not cands:
+            return False
+        if self._store_dark:
+            self.blackout_routed += 1
+        w = min(
+            cands,
+            key=lambda x: (len(x.eng._waiting) + len(x.eng._running), x.id),
+        )
+        w.vt = max(w.vt, self.t)
+        self.placements[w.id] = self.placements.get(w.id, 0) + 1
+        prompt = arr.token_ids
+        hashes = compute_seq_hashes(prompt, self.spec.block_size)
+        seq = _Seq(
+            request_id=arr.rid,
+            prompt=list(prompt),
+            max_tokens=1,
+            out=asyncio.Queue(),
+            seq=TokenBlockSequence(list(prompt), self.spec.block_size),
+            prompt_hashes=hashes,
+            stop=StopConditions(max_tokens=1, ignore_eos=True),
+            tenant_id=arr.tenant,
+        )
+        if deadline and arr.deadline_ms is not None:
+            seq.deadline_epoch = arr.t + arr.deadline_ms / 1e3
+        w.eng._waiting.append(seq)
+        w.inflight.append(seq)
+        self.active.add_request(arr.rid, w.id, len(prompt), 0)
+        self.recs[arr.rid].workers.append(w.id)
+        self.remote_prefills += 1
+        self._handoffs[arr.rid] = {"src": w.id, "hashes": hashes}
+        return True
+
+    def _complete_handoff(self, src: SimWorker, rec: _Rec, hand: dict) -> None:
+        """Prefill finished on ``src``: pick the decode target with the
+        production chooser, price the KV handoff onto its clock, and
+        continue the stream there by token replay. A sever (partition or
+        dead source) at the handoff boundary degrades to local recompute
+        on the decode worker — bit-identical, since the token function
+        depends only on stream position (the mocker's stand-in for the
+        deterministic recompute of the same prompt)."""
+        spec = self.spec
+        arr = rec.arrival
+        remaining = arr.osl - rec.n_tokens
+        if remaining <= 0:
+            return
+        cands = [
+            w
+            for w in self._live(routable=True)
+            if w.role == "decode" and self._discovered(w, self.t)
+        ]
+        self._handed_off.add(arr.rid)
+        if not cands:
+            rec.shed = "no_workers"
+            rec.done = True
+            self._win["sheds"] += 1
+            self.active.free(arr.rid)
+            return
+        by_id = {w.id: w for w in cands}
+        hashes = hand["hashes"]
+        tid = choose_decode_target(
+            sorted(by_id),
+            len(hashes),
+            lambda wid: src.pull_ms_per_block,
+            lambda wid: float(
+                len(by_id[wid].eng._waiting)
+                + len(by_id[wid].eng._running)
+                + len(self._pending_cont.get(wid, []))
+            ),
+        )
+        w = by_id[tid]
+        self.placements[w.id] = self.placements.get(w.id, 0) + 1
+        # The handoff departs when prefill finished, on the SOURCE clock;
+        # only the transfer tail separates that from decode start — the
+        # wire does the work, so the tail delays THIS continuation
+        # without charging the target's compute clock.
+        departed = max(src.vt, self.t)
+        cut = self._partitioned
+        blocked = (
+            src.dead
+            or cut.get(src.id, 0.0) > self.t
+            or cut.get(w.id, 0.0) > self.t
+        )
+        if blocked:
+            # Sever mid-handoff: burn the timeout budget, skip the
+            # import — local recompute serves the continuation.
+            self.failed_pulls += 1
+            self.handoff_fallbacks += 1
+            ready = departed + PULL_TIMEOUT_MS / 1e3
+            w.eng.peer_stats.note_pull(src.id, 0, PULL_TIMEOUT_MS, False)
+        else:
+            parents = [
+                hashes[i - 1] if i else None for i in range(len(hashes))
+            ]
+            # imported counts only blocks the target didn't already hold
+            # (a hot shared prefix may be cached there) — a zero-block
+            # handoff is still a streamed handoff, just free.
+            imported, _ = w.eng.import_peer_blocks(hashes, parents)
+            cost_ms = 0.0
+            if imported:
+                # Streaming handoff: every window but the last moved
+                # while prefill was still chunking, so only the tail
+                # remains in flight at prefill completion; the legacy
+                # pull serializes every block behind prefill.
+                charged = (
+                    min(imported, spec.disagg_chunk_blocks)
+                    if spec.streaming_handoff
+                    else imported
+                )
+                cost_ms = charged * src.pull_ms_per_block
+                w.eng.peer_stats.note_pull(src.id, imported, cost_ms, True)
+                self.pulls_by_source[src.id] = (
+                    self.pulls_by_source.get(src.id, 0) + imported
+                )
+            ready = departed + cost_ms / 1e3
+            self.handoffs_streamed += 1
+            self.handoff_blocks += imported
+        prompt = arr.token_ids
+        seq = _Seq(
+            request_id=arr.rid,
+            prompt=list(prompt),
+            max_tokens=remaining,
+            out=asyncio.Queue(),
+            seq=TokenBlockSequence(list(prompt), spec.block_size),
+            prompt_hashes=hashes,
+            stop=StopConditions(max_tokens=remaining, ignore_eos=True),
+            tenant_id=arr.tenant,
+            # Token replay from the committed position (the migration
+            # contract): the continuation stream stays byte-identical.
+            replay_base=rec.n_tokens,
+        )
+        self._pending_cont.setdefault(w.id, []).append((ready, seq))
+        self.active.free(arr.rid)
+        self.active.add_request(arr.rid, w.id, len(prompt), len(hashes))
+        rec.workers.append(w.id)
+
+    def _ready_pending(self, w: SimWorker, limit: float) -> None:
+        """Admit queued continuations whose handoff tail has landed by
+        worker-clock ``limit``."""
+        q = self._pending_cont.get(w.id)
+        if not q:
+            return
+        rest = [item for item in q if item[0] > limit]
+        for ready, seq in q:
+            if ready <= limit:
+                w.eng._waiting.append(seq)
+                w.inflight.append(seq)
+        if rest:
+            self._pending_cont[w.id] = rest
+        else:
+            self._pending_cont.pop(w.id, None)
+
+    def _next_pending(self, w: SimWorker) -> float | None:
+        q = self._pending_cont.get(w.id)
+        return min(r for r, _ in q) if q else None
+
     # -- stream collection -------------------------------------------------
 
     def _drain_frames(self, w: SimWorker) -> None:
@@ -650,7 +910,20 @@ class FleetHarness:
         for seq in w.inflight:
             self._drain_seq(w, seq)
             rec = self.recs.get(seq.request_id)
-            if rec is not None and rec.done and seq.out.empty():
+            if rec is None:
+                continue
+            retired = rec.done
+            # A handed-off prefill leg is finished from THIS worker's
+            # perspective even though the request lives on: the
+            # continuation is someone else's inflight entry.
+            if (
+                not retired
+                and seq.request_id in self._handed_off
+                and seq.replay_base == 0
+                and seq.generated >= seq.max_tokens
+            ):
+                retired = True
+            if retired and seq.out.empty():
                 done.append(seq)
         for seq in done:
             w.inflight.remove(seq)
@@ -679,10 +952,19 @@ class FleetHarness:
                     self._win["sheds"] += 1
                     rec.done = True
                     self.active.free(rec.arrival.rid)
+                    self._handoffs.pop(seq.request_id, None)
                 elif rec.n_tokens >= self._budget(rec):
                     rec.done = True
                     self.active.free(rec.arrival.rid)
                     self._finish_stats(rec)
+                    self._handoffs.pop(seq.request_id, None)
+                else:
+                    # Disagg: the prefill leg closed with the stream
+                    # still short of its budget — the handoff fires now,
+                    # on the source worker's clock.
+                    hand = self._handoffs.pop(seq.request_id, None)
+                    if hand is not None:
+                        self._complete_handoff(w, rec, hand)
 
     def _budget(self, rec: _Rec) -> int:
         return rec.arrival.osl
@@ -704,12 +986,22 @@ class FleetHarness:
         for w in list(self.workers):
             if w.dead:
                 continue
-            while w.vt < until and w.busy:
-                w.step()
-                self._drain_frames(w)
+            while w.vt < until:
+                self._ready_pending(w, w.vt)
+                if w.busy:
+                    w.step()
+                    self._drain_frames(w)
+                    continue
+                # Idle: jump straight to the next continuation landing
+                # (if any lands inside this window).
+                nxt = self._next_pending(w)
+                if nxt is None or nxt > until:
+                    break
+                w.vt = max(w.vt, nxt)
             if not w.busy:
                 w.vt = max(w.vt, until)
-                if w.draining:
+                self._ready_pending(w, w.vt)
+                if w.draining and not w.busy and w.id not in self._pending_cont:
                     # Graceful drain complete: everything the worker
                     # accepted has streamed; now it retires.
                     w.dead = True
@@ -749,7 +1041,14 @@ class FleetHarness:
             ),
             shed_delta=float(win["sheds"]),
             slo_attainment=att or None,
-            live_workers={"backend": len(live)},
+            live_workers=(
+                {
+                    "prefill": sum(1 for w in live if w.role == "prefill"),
+                    "decode": sum(1 for w in live if w.role == "decode"),
+                }
+                if spec.disagg
+                else {"backend": len(live)}
+            ),
             # Store blackout (ISSUE 15): the event-plane feed is dark, so
             # the REAL controller's degraded_hold path freezes actuation —
             # the harness drives the same production code the fleet runs.
@@ -803,6 +1102,9 @@ class FleetHarness:
         w.dead = True
         w.eng._dead = True
         victims = list(w.inflight)
+        # Continuations still in flight to this worker die with it too —
+        # they re-route through the same migration replay below.
+        victims += [seq for _, seq in self._pending_cont.pop(w.id, [])]
         for seq in victims:
             self._drain_seq(w, seq)
         w.inflight.clear()
@@ -811,6 +1113,17 @@ class FleetHarness:
             rec = self.recs.get(seq.request_id)
             if rec is None or rec.done:
                 continue
+            if (
+                seq.request_id in self._handed_off
+                and seq.replay_base == 0
+                and seq.generated >= seq.max_tokens
+            ):
+                # A retired prefill leg: the continuation already lives
+                # on a decode worker — nothing here to migrate.
+                continue
+            # A prefill leg killed mid-prompt never hands off; the
+            # migration replay below recomputes it on a survivor.
+            self._handoffs.pop(seq.request_id, None)
             remaining = rec.arrival.osl - rec.n_tokens
             if remaining <= 0:
                 continue
@@ -918,9 +1231,17 @@ class FleetHarness:
             # Drain the tail: advance everyone until nothing is in
             # flight (bounded — a wedged fleet fails loudly).
             deadline = spec.duration_s * (1.0 + MAX_OVERRUN)
-            while any(w.busy for w in self._live()):
+            while any(w.busy for w in self._live()) or self._pending_cont:
                 horizon = (
-                    max(w.vt for w in self._live() if w.busy) + 1.0
+                    max(
+                        [w.vt for w in self._live() if w.busy]
+                        + [
+                            r
+                            for q in self._pending_cont.values()
+                            for r, _ in q
+                        ]
+                    )
+                    + 1.0
                 )
                 if horizon > deadline:
                     raise RuntimeError(
@@ -948,6 +1269,7 @@ class FleetHarness:
         completed = shed = broken = tokens = 0
         ttfts: list[float] = []
         tpots: list[float] = []
+        e2es: list[float] = []
         for rec in self.recs.values():
             arr = rec.arrival
             if rec.shed is not None:
@@ -959,6 +1281,8 @@ class FleetHarness:
             if rec.done and rec.n_tokens == arr.osl:
                 completed += 1
                 tokens += rec.n_tokens
+                if rec.t_last is not None:
+                    e2es.append(rec.t_last - arr.t)
                 if rec.t_first is not None:
                     ttfts.append(rec.t_first - arr.t)
                     if (
@@ -978,6 +1302,7 @@ class FleetHarness:
         ok_tpot = sum(1 for v in tpots if v <= spec.sla.itl_s)
         ttfts.sort()
         tpots.sort()
+        e2es.sort()
 
         def pct(vals: list[float], q: float) -> float:
             if not vals:
@@ -988,6 +1313,7 @@ class FleetHarness:
             scenario=(
                 ("planner" if spec.planner_on else "static")
                 + ("+netroute" if spec.network_aware else "")
+                + ("+disagg" if spec.disagg else "")
             ),
             duration_s=spec.duration_s,
             requests=total,
@@ -1037,6 +1363,11 @@ class FleetHarness:
                 3,
             ),
             kv_resyncs=len(self._resynced),
+            e2e_p50_ms=round(pct(e2es, 0.50) * 1e3, 1),
+            remote_prefills=self.remote_prefills,
+            handoffs_streamed=self.handoffs_streamed,
+            handoff_fallbacks=self.handoff_fallbacks,
+            handoff_blocks=self.handoff_blocks,
         )
 
 
@@ -1132,6 +1463,109 @@ def run_fleet_ab(
         "static": static,
         "static_budget_replicas": budget,
     }
+
+
+def disagg_tenants(
+    scale: float = 1.0,
+    users: int = 40_000,
+    diurnal_period_s: float = 240.0,
+    deadline_ms: float | None = None,
+) -> list[TenantSpec]:
+    """The disagg A/B's long-prompt mix: prefill-heavy chat and RAG
+    traffic (isl >> osl threshold for remote prefill) with the standard
+    0.6-amplitude diurnal swing — a 4x peak/trough ratio. Long prompts
+    are where disagg lives or dies: the KV transfer is tens of blocks,
+    so serializing it behind prefill (the legacy pull) is visible in
+    every stream's latency, and hiding it (streaming handoff) is the
+    whole claim."""
+    return [
+        TenantSpec(
+            name="chat",
+            users=users,
+            rps=6.0 * scale,
+            diurnal_amplitude=0.6,
+            diurnal_period_s=diurnal_period_s,
+            isl=512,
+            osl=32,
+            shared_prefix_tokens=32,
+            deadline_ms=deadline_ms,
+        ),
+        TenantSpec(
+            name="rag",
+            users=max(1, users // 10),
+            rps=3.0 * scale,
+            diurnal_amplitude=0.6,
+            diurnal_period_s=diurnal_period_s,
+            isl=384,
+            osl=32,
+            shared_prefix_tokens=64,
+            deadline_ms=deadline_ms,
+        ),
+    ]
+
+
+def run_disagg_ab(
+    tenants: list[TenantSpec] | None = None,
+    duration_s: float = 240.0,
+    seed: int = 0,
+    sla: SlaTargets | None = None,
+    total_replicas: int = 6,
+    prefill_fraction: float = 0.5,
+    planner_on: bool = False,
+    max_replicas: int = 16,
+    chaos_disagg: list[ChaosEvent] | None = None,
+    streaming: bool = True,
+    max_local_prefill_tokens: int = 32,
+    scheduling: str = "waves",
+    max_num_seqs: int = 8,
+    decode_us_per_seq: float = 500.0,
+    pull_ms_per_block: float = 4.0,
+    disagg_chunk_blocks: int = 8,
+) -> dict:
+    """The disagg-parity A/B (ISSUE 17): the same diurnal workload on an
+    aggregated fleet and on a prefill/decode-split fleet at the SAME
+    replica budget. Static mode (the deterministic parity audit) freezes
+    both arms at ``total_replicas`` — equal budget by construction;
+    planner mode runs the closed loop on both, per-pool on the disagg
+    arm so the prefill:decode ratio shifts live with the swing.
+
+    The parity claim: disagg end-to-end latency stays within a small
+    factor of aggregated (the streaming handoff hides the transfer
+    behind prefill), while TTFT attainment holds or improves — long
+    prefills no longer ride the decode batch, so the 4x diurnal peak
+    stops inflating first-token latency. Streams must be byte-identical
+    between arms: disagg only moves WHERE tokens are computed.
+    ``chaos_disagg`` applies to the disagg arm only (the sever-mid-
+    handoff audit compares against a no-fault disagg run)."""
+    sla = sla or SlaTargets(ttft_s=0.35, itl_s=0.08)
+    tenants = tenants or disagg_tenants(diurnal_period_s=duration_s)
+
+    def spec(disagg: bool, chaos: list[ChaosEvent] | None = None) -> FleetSpec:
+        return FleetSpec(
+            tenants=tenants,
+            duration_s=duration_s,
+            seed=seed,
+            planner_on=planner_on,
+            static_replicas=total_replicas,
+            initial_replicas=total_replicas,
+            max_replicas=max_replicas,
+            max_num_seqs=max_num_seqs,
+            decode_us_per_seq=decode_us_per_seq,
+            pull_ms_per_block=pull_ms_per_block,
+            sla=sla,
+            disagg=disagg,
+            prefill_fraction=prefill_fraction,
+            streaming_handoff=streaming,
+            max_local_prefill_tokens=max_local_prefill_tokens,
+            disagg_chunk_blocks=disagg_chunk_blocks,
+            scheduling=scheduling,
+            chaos=list(chaos or []),
+            keep_streams=True,
+        )
+
+    agg = FleetHarness(spec(False)).run()
+    disagg = FleetHarness(spec(True, chaos_disagg)).run()
+    return {"agg": agg, "disagg": disagg}
 
 
 def run_blackout_ab(

@@ -106,6 +106,18 @@ KNOBS: dict[str, Knob] = _freeze(
          "per-frame deadline on a peer KV pull stream"),
     Knob("DYN_KV_POOL_PULL_TIMEOUT_S", 30.0, "float", "kv-pool",
          "whole-pull deadline on a peer KV prefix fetch"),
+    # -- disaggregated serving ------------------------------------------
+    Knob("DYN_DISAGG_STREAMING", True, "bool", "disagg",
+         "chunk-pipelined KV handoff: pull committed prefill chunks "
+         "while prefill is still running (off = legacy pull-after-prefill)"),
+    Knob("DYN_DISAGG_CHUNK_BLOCKS", 16, "int", "disagg",
+         "KV blocks pulled per streaming-handoff window"),
+    Knob("DYN_DISAGG_CURSOR_TIMEOUT_S", 30.0, "float", "disagg",
+         "max wait for the first chunk-cursor event before the handoff "
+         "degrades to the reply-gated legacy pull"),
+    Knob("DYN_DISAGG_CHUNK_US_PER_BLOCK", 20.0, "float", "disagg",
+         "mocker virtual-clock price per handoff block (chunk-pipelined "
+         "transfer cost in the deterministic fleet A/B)"),
     # -- TPU kernels ----------------------------------------------------
     Knob("DYNAMO_TPU_PAGED_ATTN", "xla", "str", "kernels",
          "paged-attention backend: `xla` or `pallas`"),

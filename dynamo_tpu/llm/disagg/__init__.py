@@ -1,0 +1,18 @@
+"""Disaggregated prefill/decode: decision, target choice, hot-reload.
+
+Package split of the original ``llm/disagg.py`` module (ISSUE 17): the
+local-vs-remote prefill decision and store-watched config live in
+``router``, the NetCost-priced decode-target choice in ``target``. The
+streaming chunk-pipelined handoff itself is the sibling
+``llm/disagg_pool`` package. Import surface is unchanged:
+``from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter``.
+"""
+
+from dynamo_tpu.llm.disagg.router import (  # noqa: F401
+    DISAGG_CONFIG_KEY,
+    DisaggConfig,
+    DisaggRouter,
+)
+from dynamo_tpu.llm.disagg.target import (  # noqa: F401
+    choose_decode_target,
+)

@@ -5,6 +5,15 @@ it is long enough to be worth the KV transfer AND the prefill fleet isn't
 backed up — otherwise prefilling locally is faster. Thresholds hot-reload
 from the control-plane store so operators can tune a live system.
 
+Control-plane degradation (ISSUE 15 semantics): while the store is dark,
+the router serves its LAST-KNOWN-GOOD policy. Key deletions that arrive
+around a blackout — lease revokes as the connection dies, or events
+drained from the subscription queue after the session already dropped —
+are blackout artifacts, not operator intent, and are DEFERRED: the policy
+keeps its last value until a post-reconnect event re-asserts authority
+(the replayed watch's initial snapshot does exactly that). Only an
+explicit delete observed on a live session reverts to defaults.
+
 Capability parity: reference `lib/llm/src/disagg_router.rs:24-100`
 (prefill-length + queue-depth conditions, etcd-watched config) and
 `docs/architecture/disagg_serving.md:46-56`.
@@ -40,6 +49,10 @@ class DisaggRouter:
         # kv_transfer recorded by the decode worker around the actual
         # queue round-trip and block pull) share this tracer.
         self.tracer = tracing.get_tracer("disagg")
+        # Policy flips deferred because the store was dark (or the delete
+        # was a lease/conn-death artifact) when they arrived. Observable
+        # so the blackout A/B can pin the behavior.
+        self.deferred_resets = 0
 
     def should_remote_prefill(
         self, prefill_length: int, queue_depth: int = 0
@@ -75,19 +88,47 @@ class DisaggRouter:
         )
         return remote
 
+    def apply_watch_event(self, event, connected: bool = True) -> bool:
+        """Fold one config-key watch event into the live policy. Returns
+        True when the policy changed. Split from :meth:`watch_store` so
+        the degradation contract is testable without a store.
+
+        Puts always apply — they carry the operator's data regardless of
+        when they were drained. Deletes revert to defaults ONLY when they
+        are explicit retractions observed on a live session; a delete
+        with a lease/conn-death reason, or one drained while the store is
+        dark, is a blackout artifact and defers (last-known-good wins
+        until the reconnect replay re-asserts the key's true state)."""
+        if event.type == "put" and event.value is not None:
+            try:
+                self.config = DisaggConfig(**json.loads(event.value))
+                log.info("disagg config reloaded: %s", self.config)
+                return True
+            except (ValueError, TypeError) as e:
+                log.warning("bad disagg config at %s: %s", event.key, e)
+            return False
+        if event.type == "delete":
+            if not connected or event.reason == "lease":
+                self.deferred_resets += 1
+                log.warning(
+                    "deferring disagg policy reset (store dark or lease "
+                    "revoke); keeping last-known-good %s", self.config,
+                )
+                return False
+            self.config = DisaggConfig()
+            log.info("disagg config key deleted; reverting to defaults")
+            return True
+        return False
+
     async def watch_store(self, store, namespace: str) -> None:
-        """Follow config updates at DISAGG_CONFIG_KEY (hot reload)."""
+        """Follow config updates at DISAGG_CONFIG_KEY (hot reload). The
+        subscription survives store blackouts (the client replays watches
+        with their initial snapshot on reconnect); events drained around
+        an outage go through :meth:`apply_watch_event`'s deferral rules."""
         from dynamo_tpu.runtime.store.client import StoreClient
 
         key = DISAGG_CONFIG_KEY.format(namespace=namespace)
         sub = await store.kv_watch(key)
         async for ev in sub:
             event = StoreClient.as_watch_event(ev)
-            if event.type != "put" or event.value is None:
-                continue
-            try:
-                data = json.loads(event.value)
-                self.config = DisaggConfig(**data)
-                log.info("disagg config reloaded: %s", self.config)
-            except (ValueError, TypeError) as e:
-                log.warning("bad disagg config at %s: %s", key, e)
+            self.apply_watch_event(event, connected=store.connected)

@@ -252,6 +252,122 @@ class PeerKvClient:
             st.pulls_fallback += 1
         return imported
 
+    async def pull_held_window(
+        self,
+        transfer_client,
+        worker_id: int,
+        request_id: str,
+        start: int,
+        count: int,
+        final: bool = False,
+    ) -> int:
+        """Pull ONE committed window ``[start, start+count)`` of a held or
+        still-running prefill through the ``kv_transfer`` endpoint (the
+        streaming-handoff data path, ISSUE 17); returns blocks imported.
+
+        Same protections as :meth:`pull_prefix` — dataplane breakers on
+        the dial, per-frame and whole-window deadlines, chaos sever point
+        — but failures RAISE instead of swallowing: the streaming handoff
+        must abort the stream and degrade to the reply-gated pull, not
+        silently continue with a hole. ``final`` releases the server-side
+        hold after the window (sent exactly once, on the last window of a
+        finished prefill)."""
+        st = self.stats
+        st.pulls_attempted += 1
+        t0 = time.monotonic()
+        deadline = t0 + self.total_timeout_s
+        imported = 0
+        ok = False
+        try:
+            if chaos.active():
+                await chaos.inject("kv_transfer.pull", str(worker_id))
+            stream = await transfer_client.direct(
+                worker_id,
+                {
+                    wire.KV_REQUEST_ID: request_id,
+                    wire.KV_WINDOW_START: start,
+                    wire.KV_WINDOW_COUNT: count,
+                    wire.KV_WINDOW_FINAL: final,
+                    wire.KV_CHUNK_BLOCKS: self.chunk_blocks,
+                },
+            )
+            descs: list[dict] | None = None
+            received = 0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(
+                        f"handoff window exceeded {self.total_timeout_s:.1f}s"
+                    )
+                try:
+                    frame = await asyncio.wait_for(
+                        stream.__anext__(),
+                        min(self.frame_timeout_s, remaining),
+                    )
+                except StopAsyncIteration:
+                    break
+                if wire.KV_ERROR in frame:
+                    # The hold is gone (released, swept, or preempted):
+                    # the stream is over, the caller falls back.
+                    raise ConnectionError(
+                        f"handoff window refused: {frame[wire.KV_ERROR]}"
+                    )
+                ver = frame.get(wire.KV_VERSION)
+                if ver != 2:
+                    raise ConnectionError(
+                        f"unsupported KV transfer wire version {ver!r}"
+                    )
+                if wire.KV_BLOCKS in frame:
+                    descs = frame[wire.KV_BLOCKS]
+                    if len(descs) < count:
+                        # The server's committed prefix is SHORTER than
+                        # the cursor advertised (preempted prefill re-
+                        # committing): advancing past it would leave a
+                        # hole, so abort and let the caller fall back.
+                        raise ConnectionError(
+                            f"handoff window short: {len(descs)}/{count} "
+                            "blocks committed server-side"
+                        )
+                    continue
+                if descs is None:
+                    raise ConnectionError(
+                        "handoff data frame before descriptors"
+                    )
+                s = frame[wire.KV_START]
+                batch = [
+                    {**descs[s + j], wire.IMP_KV: kv}
+                    for j, kv in enumerate(frame[wire.KV_PAGES])
+                ]
+                for b in batch:
+                    st.bytes_pulled += len(b[wire.IMP_KV])
+                received += len(batch)
+                res = await asyncio.to_thread(self.core.import_blocks, batch)
+                imported += res.imported
+            if descs is None or received < len(descs):
+                # The server died mid-window AFTER descriptors (its
+                # stream just ends): a short window must not pass for a
+                # complete one, or the handoff would continue with a
+                # hole in the prefix.
+                raise ConnectionError(
+                    f"handoff window truncated: {received}/"
+                    f"{len(descs or [])} pages"
+                )
+            ok = True
+            return imported
+        finally:
+            elapsed_ms = (time.monotonic() - t0) * 1e3
+            st.pull_ms_total += elapsed_ms
+            st.last_pull_ms = elapsed_ms
+            st.blocks_pulled += imported
+            # Window pulls feed the same per-peer NetKV cost EWMAs as
+            # prefix pulls — the router's decode-placement scoring should
+            # price the links the handoff actually uses.
+            st.note_pull(int(worker_id), imported, elapsed_ms, ok)
+            if ok:
+                st.pulls_succeeded += 1
+            else:
+                st.pulls_fallback += 1
+
     def pool_stats(self) -> dict:
         """kv_pool_* gauge payload for this worker's pull side."""
         return self.stats.as_dict()

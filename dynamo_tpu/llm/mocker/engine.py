@@ -147,6 +147,10 @@ class _Seq:
     tenant_id: str = ""
     priority: int = 0
     deadline_epoch: float | None = None
+    # do_remote_decode request (disagg prefill side): advertise chunk
+    # commits through the engine's on_chunk_commit hook and tag the
+    # final output with kv_transfer_params for the reply contract.
+    notify_chunks: bool = False
     # Phase timestamps for the tracer (0.0 = not reached yet). The spans
     # are emitted retroactively when the stream closes so the sim loop's
     # hot path only ever stamps a float.
@@ -202,6 +206,12 @@ class MockTpuEngine:
         from dynamo_tpu.llm.kv_pool import PeerPullStats
 
         self.peer_stats = PeerPullStats()
+        # Streaming disagg mirror (ISSUE 17), same contract as
+        # EngineCore.on_chunk_commit: fired as a do_remote_decode
+        # sequence commits prefill chunks (done=True at finish). The sim
+        # loop runs ON the event loop, so the callback may touch
+        # loop-affine state directly — no thread hop needed.
+        self.on_chunk_commit = None
         self._spec_default = (
             SpecConfig(k=self.args.spec_k)
             if self.args.spec_decode != "off"
@@ -343,6 +353,9 @@ class MockTpuEngine:
             replay_base=pre.replayed_tokens,
             tenant_id=pre.tenant_id or "",
             priority=pre.priority or 0,
+            notify_chunks=bool(
+                (pre.kv_transfer_params or {}).get("do_remote_decode")
+            ),
         )
         if pre.deadline_epoch is not None:
             seq.deadline_epoch = pre.deadline_epoch
@@ -808,6 +821,15 @@ class MockTpuEngine:
                     self.kv.commit_block(h, parent)
                     seq.partials_held -= 1
                     seq.pinned.append(h)
+                if (
+                    seq.notify_chunks
+                    and self.on_chunk_commit is not None
+                    and end_block > max(start_block, seq.cached_blocks)
+                ):
+                    # Absolute cursor: blocks [0, end_block) are all in
+                    # cache now (cached prefix included). done rides
+                    # _finish, mirroring EngineCore.
+                    self.on_chunk_commit(seq.request_id, end_block, False)
                 if seq.prefill_done:
                     seq.t_prefill_done = time.time()
                 continue
@@ -910,6 +932,11 @@ class MockTpuEngine:
                 out.finish_reason = finish
                 out.prompt_tokens = len(seq.prompt)
                 out.completion_tokens = seq.generated
+                if seq.notify_chunks:
+                    # Disagg reply contract: the decode side pulls held
+                    # blocks keyed by this id (the worker stamps its
+                    # worker_id into the same dict before replying).
+                    out.kv_transfer_params = {"request_id": seq.request_id}
                 seq.out.put_nowait(out.to_wire())
                 finished.append(seq)
             else:
@@ -1010,6 +1037,14 @@ class MockTpuEngine:
         return seq.seq.partial_tokens
 
     def _finish(self, seq: _Seq, emit: bool) -> None:
+        if seq.notify_chunks and self.on_chunk_commit is not None:
+            # Final cursor: every full prompt block is committed (the
+            # mock cache RETAINS committed blocks after release, which
+            # is what makes the decode side's window pulls work — no
+            # hold/release plumbing needed in the mirror).
+            self.on_chunk_commit(
+                seq.request_id, len(seq.prompt) // self.args.block_size, True
+            )
         self.kv.release(seq.pinned)
         if seq.partials_held:
             self.kv.release_partial(seq.partials_held)

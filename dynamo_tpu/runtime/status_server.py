@@ -349,6 +349,57 @@ def bind_kv_pool_gauges(
         )
 
 
+# Streaming-disaggregation handoff gauges (ISSUE 17): chunk-pipelined
+# pull progress on the decode side. `early_chunks` is the headline — a
+# nonzero value PROVES transfer/compute overlap (chunks landed before
+# the prefill's final cursor), which is what the disagg smoke asserts.
+DISAGG_GAUGES: dict[str, tuple[str, str]] = {
+    "handoffs_started": (
+        "disagg_handoffs_total",
+        "Streaming handoffs attempted for remotely-prefilled requests",
+    ),
+    "handoffs_streamed": (
+        "disagg_handoffs_streamed_total",
+        "Handoffs fully streamed chunk-by-chunk (legacy pull skipped)",
+    ),
+    "handoffs_fallback": (
+        "disagg_handoff_fallback_total",
+        "Handoffs degraded to the reply-gated pull (cursor timeout, "
+        "severed window, or import refusal)",
+    ),
+    "chunks_pulled": (
+        "disagg_chunks_pulled_total",
+        "KV chunk windows pulled over the streaming handoff",
+    ),
+    "early_chunks": (
+        "disagg_early_chunks_total",
+        "Chunk windows pulled BEFORE the prefill finished (the overlap "
+        "the subsystem exists to create)",
+    ),
+    "blocks_streamed": (
+        "disagg_streamed_blocks_total",
+        "KV blocks moved by streaming windows",
+    ),
+    "cursor_timeouts": (
+        "disagg_cursor_timeouts_total",
+        "Handoffs that saw no cursor advance within the timeout",
+    ),
+}
+
+
+def bind_disagg_gauges(
+    status: "SystemStatusServer | None", disagg_stats: Callable[[], dict]
+) -> None:
+    """Export a decode worker's streaming-handoff gauges on /metrics."""
+    if status is None:
+        return
+    scoped = status.metrics.scoped(service="disagg")
+    for key, (name, doc) in DISAGG_GAUGES.items():
+        scoped.gauge(name, doc).set_function(
+            lambda k=key: float(disagg_stats().get(k, 0) or 0)
+        )
+
+
 # Per-tenant fair-queue gauges: queue depth and DRR deficit per tenant.
 # Tenant labels are dynamic (tenants appear as their first request
 # arrives), so these sync via a before_render hook like the egress

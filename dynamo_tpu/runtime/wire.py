@@ -30,6 +30,10 @@ Planes and their parse contexts:
   transfers (``llm/kv_pool/peer_client.py``, ``backends/*/main.py``).
 - ``kvimport``   — per-block import descriptors handed to
   ``EngineCore.import_blocks`` (host-side record, same codec).
+- ``disagg.cursor`` — per-request chunk-cursor events the prefill
+  worker publishes on the event plane as committed KV blocks land
+  (``llm/disagg_pool/cursor.py``); the decode worker's streaming
+  handoff consumes them to pull chunks while prefill is still running.
 
 Keep this module stdlib-only and leaf-level: the checker imports
 nothing from it (it parses the AST), but product code imports it from
@@ -133,6 +137,16 @@ KV_PAGES = "kv"         # data frame: raw page bytes, one per block
 KV_DONE = "done"        # trailer frame: total blocks sent
 KV_HELD = "held"        # mocker data frame: held prefix length
 KV_ERROR = "error"      # error frame: abort reason
+KV_WINDOW_START = "ws"  # windowed transfer request: first committed block
+KV_WINDOW_COUNT = "wc"  # windowed transfer request: max blocks this window
+KV_WINDOW_FINAL = "wf"  # windowed transfer request: release the hold after
+
+# -- disagg chunk-cursor events (streaming handoff, bus subject) ------------
+
+CUR_REQUEST_ID = "rid"  # cursor event: prefill request id
+CUR_WORKER = "w"        # cursor event: prefill worker id holding the blocks
+CUR_COMMITTED = "c"     # cursor event: committed KV blocks so far
+CUR_DONE = "d"          # cursor event: prefill finished (cursor is final)
 
 # -- KV import descriptors (EngineCore.import_blocks) -----------------------
 
@@ -227,6 +241,15 @@ SCHEMAS: dict[str, dict[str, str]] = {
         "KV_DONE": "total blocks sent",
         "KV_HELD": "held prefix length",
         "KV_ERROR": "abort reason",
+        "KV_WINDOW_START": "window first block index",
+        "KV_WINDOW_COUNT": "window max blocks",
+        "KV_WINDOW_FINAL": "release hold after window",
+    },
+    "disagg.cursor": {
+        "CUR_REQUEST_ID": "prefill request id",
+        "CUR_WORKER": "prefill worker id",
+        "CUR_COMMITTED": "committed blocks so far",
+        "CUR_DONE": "prefill finished",
     },
     "kvimport": {
         "IMP_HASH": "block content hash",
@@ -250,6 +273,7 @@ CONTEXTS: dict[str, str] = {
     "snapshot": "snapshot-record",
     "kvstream": "kv-stream-frame",
     "kvimport": "kv-import-record",
+    "disagg.cursor": "disagg-cursor-event",
 }
 
 # Discriminator VALUES (not keys): registered so the module self-check

@@ -1,0 +1,457 @@
+"""Streaming disaggregation (ISSUE 17): chunk cursors, windowed
+handoff, policy degradation, and the mocker disagg mirror.
+
+Layers under test, bottom up: cursor publisher/watcher coalescing on
+the event plane; StreamingHandoff's window loop and every fallback edge
+(timeout, sever, regression); DisaggRouter's control-plane degradation
+contract (pinned) and decision path; choose_decode_target's cost model;
+and the full mocker prefill+decode pools streaming byte-identically to
+an aggregated run with at least one chunk pulled before the prefill
+completed.
+"""
+
+import asyncio
+import json
+from contextlib import suppress
+
+import pytest
+
+from dynamo_tpu.llm.disagg import DisaggConfig, DisaggRouter, choose_decode_target
+from dynamo_tpu.llm.disagg_pool import (
+    ChunkCursorPublisher,
+    ChunkCursorWatcher,
+    StreamingHandoff,
+    disagg_cursor_subject,
+)
+from dynamo_tpu.runtime.store.client import WatchEvent
+
+pytestmark = [pytest.mark.e2e, pytest.mark.pre_merge]
+
+
+# ---------------------------------------------------------------------------
+# Cursor plane: publisher coalescing + watcher advances
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_publisher_coalesces_to_latest():
+    pub = ChunkCursorPublisher(store=None, namespace="ns", worker_id=7)
+    pub.note_nowait("r1", 2, False)
+    pub.note_nowait("r1", 5, False)
+    pub.note_nowait("r2", 1, False)
+    assert pub._pending["r1"] == (5, False)
+    assert len(pub._pending) == 2
+    # A final cursor is never regressed by a stale commit arriving late.
+    pub.note_nowait("r1", 8, True)
+    pub.note_nowait("r1", 6, False)
+    assert pub._pending["r1"] == (8, True)
+
+
+async def test_cursor_roundtrip_over_store():
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    rt = await DistributedRuntime.create(store.address)
+    try:
+        watcher = ChunkCursorWatcher(rt.store, "ns")
+        await watcher.start()
+        pub = ChunkCursorPublisher(rt.store, "ns", worker_id=3)
+        await pub.start()
+        pub.note_nowait("req-a", 4, False)
+        got = await asyncio.wait_for(watcher.wait_advance("req-a", 0, 5.0), 10)
+        assert got == (3, 4, False)
+        pub.note_nowait("req-a", 9, True)
+        got = await asyncio.wait_for(watcher.wait_advance("req-a", 4, 5.0), 10)
+        assert got == (3, 9, True)
+        assert pub.published_total == 2
+        # A final cursor satisfies ANY wait (the handoff turns it into
+        # the final window); only a missing cursor times out.
+        assert await watcher.wait_advance("req-a", 99, 0.1) == (3, 9, True)
+        with pytest.raises(asyncio.TimeoutError):
+            await watcher.wait_advance("req-never", 0, 0.1)
+        watcher.forget("req-a")
+        assert watcher.cursor("req-a") is None
+        await pub.stop()
+        await watcher.stop()
+    finally:
+        rt.signal_shutdown()
+        with suppress(Exception):  # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
+            await rt.shutdown()
+        await store.stop()
+
+
+def test_cursor_subject_is_per_namespace():
+    assert disagg_cursor_subject("a") != disagg_cursor_subject("b")
+
+
+# ---------------------------------------------------------------------------
+# StreamingHandoff: window loop + fallback edges
+# ---------------------------------------------------------------------------
+
+
+class _FakeWatcher:
+    """Scripted cursor advances; raises TimeoutError when exhausted."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.forgotten = []
+
+    async def wait_advance(self, rid, beyond, timeout):
+        while self.script:
+            cur = self.script.pop(0)
+            if cur[1] > beyond or cur[2]:
+                return cur
+        raise asyncio.TimeoutError
+
+    def forget(self, rid):
+        self.forgotten.append(rid)
+
+    def cursor(self, rid):
+        return None
+
+
+class _FakePuller:
+    def __init__(self, fail_at=None):
+        self.windows = []
+        self.fail_at = fail_at
+        self.total_timeout_s = 5.0
+
+    async def pull_held_window(self, _c, worker, rid, start, count, final=False):
+        if self.fail_at is not None and len(self.windows) == self.fail_at:
+            raise ConnectionError("severed mid-handoff")
+        self.windows.append((start, count, final))
+        return count
+
+
+async def test_handoff_streams_windows_and_marks_early_chunks():
+    # Cursor: 3 committed while running, then final at 5.
+    watcher = _FakeWatcher([(1, 3, False), (1, 5, True)])
+    puller = _FakePuller()
+    h = StreamingHandoff(puller, watcher, None, chunk_blocks=2,
+                         cursor_timeout_s=1.0)
+    assert await h.run("rid") is True
+    # Windows cover [0,5) exactly, final flag only on the last.
+    assert puller.windows == [(0, 2, False), (2, 1, False), (3, 2, True)]
+    assert h.stats.handoffs_streamed == 1
+    assert h.stats.early_chunks == 2          # pulled before the final cursor
+    assert h.stats.blocks_streamed == 5
+    assert h.stats.handoffs_fallback == 0
+    assert watcher.forgotten == ["rid"]
+
+
+async def test_handoff_cursor_timeout_degrades_to_fallback():
+    h = StreamingHandoff(_FakePuller(), _FakeWatcher([]), None,
+                         cursor_timeout_s=0.01)
+    assert await h.run("rid") is False
+    assert h.stats.cursor_timeouts == 1
+    assert h.stats.handoffs_fallback == 1
+
+
+async def test_handoff_severed_window_degrades_to_fallback():
+    watcher = _FakeWatcher([(1, 4, True)])
+    puller = _FakePuller(fail_at=1)  # second window dies
+    h = StreamingHandoff(puller, watcher, None, chunk_blocks=2,
+                         cursor_timeout_s=1.0)
+    assert await h.run("rid") is False
+    assert h.stats.handoffs_fallback == 1
+    assert h.stats.handoffs_streamed == 0
+
+
+async def test_handoff_waits_out_cursor_regression():
+    # Preempted prefill: cursor regresses to 1 then re-passes to 3.
+    watcher = _FakeWatcher([(1, 2, False), (1, 1, False), (1, 3, True)])
+    puller = _FakePuller()
+    h = StreamingHandoff(puller, watcher, None, chunk_blocks=8,
+                         cursor_timeout_s=1.0)
+    assert await h.run("rid") is True
+    assert puller.windows == [(0, 2, False), (2, 1, True)]
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1 (pinned): policy deferral while the control plane degrades
+# ---------------------------------------------------------------------------
+
+
+def _put(cfg: dict) -> WatchEvent:
+    return WatchEvent("put", "k", json.dumps(cfg).encode(), 1)
+
+
+def test_disagg_policy_defers_resets_while_store_degraded():
+    """PINNED degradation contract: a policy flip observed as a lease
+    expiry, or drained while the store is dark, must NOT revert the live
+    config to defaults — last-known-good policy keeps serving until the
+    control plane recovers (ISSUE 15 semantics applied to disagg)."""
+    r = DisaggRouter()
+    assert r.apply_watch_event(_put({"max_local_prefill_length": 7}))
+    assert r.config.max_local_prefill_length == 7
+
+    # Lease-reason delete (conn-death revoke): deferred.
+    assert not r.apply_watch_event(
+        WatchEvent("delete", "k", b"", 2, reason="lease"), connected=True
+    )
+    assert r.config.max_local_prefill_length == 7
+    # Explicit retraction drained while DISCONNECTED: deferred too.
+    assert not r.apply_watch_event(
+        WatchEvent("delete", "k", b"", 3, reason="del"), connected=False
+    )
+    assert r.config.max_local_prefill_length == 7
+    assert r.deferred_resets == 2
+
+    # Puts always apply, even while dark (operator data beats liveness
+    # guesses).
+    assert r.apply_watch_event(_put({"max_local_prefill_length": 9}),
+                               connected=False)
+    assert r.config.max_local_prefill_length == 9
+
+    # An explicit delete on a LIVE session is a real retraction.
+    assert r.apply_watch_event(
+        WatchEvent("delete", "k", b"", 4, reason="del"), connected=True
+    )
+    assert r.config.max_local_prefill_length == DisaggConfig().max_local_prefill_length
+
+
+def test_disagg_policy_rejects_malformed_config():
+    r = DisaggRouter(DisaggConfig(max_local_prefill_length=7))
+    assert not r.apply_watch_event(WatchEvent("put", "k", b"{not json", 1))
+    assert not r.apply_watch_event(
+        WatchEvent("put", "k", b'{"no_such_field": 1}', 2)
+    )
+    assert r.config.max_local_prefill_length == 7
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: decision path + span attribution, and the decode chooser
+# ---------------------------------------------------------------------------
+
+
+def test_should_remote_prefill_thresholds_and_queue_gate():
+    r = DisaggRouter(DisaggConfig(max_local_prefill_length=50,
+                                  max_prefill_queue_size=2))
+    assert not r.should_remote_prefill(50)     # at threshold: local
+    assert r.should_remote_prefill(51)         # past threshold: remote
+    assert r.should_remote_prefill(51, queue_depth=2)   # queue at cap: ok
+    assert not r.should_remote_prefill(51, queue_depth=3)  # over: gated
+    r.config.enabled = False
+    assert not r.should_remote_prefill(10_000)
+
+
+def test_decide_records_attributed_span():
+    from dynamo_tpu import tracing
+
+    tracing.configure(enabled=True, sample=1.0)
+    col = tracing.get_collector()
+    col.clear()
+    try:
+        r = DisaggRouter(DisaggConfig(max_local_prefill_length=50))
+        assert r.decide(100, 1, request_id="rid-1")
+        assert not r.decide(10, 0, request_id="rid-2")
+        spans = [s for s in col.spans() if s.name == "disagg_decision"]
+        assert len(spans) == 2
+        remote = next(s for s in spans if s.attrs["request_id"] == "rid-1")
+        assert remote.attrs["remote"] is True
+        assert remote.attrs["prefill_length"] == 100
+        assert remote.attrs["queue_depth"] == 1
+        local = next(s for s in spans if s.attrs["request_id"] == "rid-2")
+        assert local.attrs["remote"] is False
+    finally:
+        col.clear()
+
+
+def test_choose_decode_target_prices_transfer_plus_queue():
+    prices = {1: 2.0, 2: 0.5, 3: 0.5}
+    depths = {1: 0, 2: 10, 3: 1}
+    # Pure transfer: worker 2/3 tie at 0.5ms/blk -> lowest id wins.
+    assert choose_decode_target([1, 2, 3], 8, prices.__getitem__) == 2
+    # Queue penalty flips the tie: worker 2's backlog prices it out.
+    assert choose_decode_target(
+        [1, 2, 3], 8, prices.__getitem__, queue_depth=depths.__getitem__
+    ) == 3
+    # Large enough transfers amortize queueing over the slow link.
+    assert choose_decode_target(
+        [1, 2], 1000, prices.__getitem__, queue_depth=depths.__getitem__
+    ) == 2
+    assert choose_decode_target([], 8, prices.__getitem__) is None
+
+
+# ---------------------------------------------------------------------------
+# Mocker mirror e2e: streaming disagg pools, byte-identical, chunk-early
+# ---------------------------------------------------------------------------
+
+
+class MockDisaggPools:
+    """Store + mock prefill pool + mock decode pool. Long prompts with a
+    tight prefill-chunk force multi-chunk remote prefills so the cursor
+    plane carries real mid-prefill advances."""
+
+    def __init__(self, prefill_chunk=8, block_size=8, streaming=True,
+                 decode_config=None):
+        from dynamo_tpu.llm.mocker import MockEngineArgs
+
+        self.streaming = streaming
+        self.decode_config = decode_config or DisaggConfig(
+            max_local_prefill_length=16
+        )
+        self.args = MockEngineArgs(
+            num_kv_blocks=512, block_size=block_size, speedup_ratio=20.0,
+            scheduling="chunked", prefill_chunk=prefill_chunk,
+        )
+
+    async def __aenter__(self) -> "MockDisaggPools":
+        from dynamo_tpu.backends.mocker import run_mocker
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.store import StoreServer
+
+        self.store = StoreServer()
+        await self.store.start()
+        self.runtimes = []
+        self.tasks = []
+        self.engines = []
+
+        for role, component in (("prefill", "prefill"), ("decode", "decode")):
+            rt = await DistributedRuntime.create(self.store.address)
+            self.runtimes.append(rt)
+            served = asyncio.Event()
+            self.tasks.append(asyncio.create_task(run_mocker(
+                rt, model_name="mock", namespace="dynamo",
+                component=component, engine_args=self.args,
+                served_event=served, engine_out=self.engines,
+                obs_publish=False, role=role,
+                disagg_config=self.decode_config,
+            )))
+            await asyncio.wait_for(served.wait(), 15)
+        self.prefill_engine, self.decode_engine = self.engines
+        self.decode_client = await (
+            self.runtimes[1].namespace("dynamo").component("decode")
+            .endpoint("generate").client()
+        )
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        from dynamo_tpu.runtime import chaos
+
+        chaos.uninstall()
+        for rt in self.runtimes:
+            rt.signal_shutdown()
+        await asyncio.sleep(0.05)
+        for t in self.tasks:
+            t.cancel()
+        for rt in self.runtimes:
+            with suppress(Exception):  # dynalint: allow-broad-except(best-effort teardown; runtime may already be closed)
+                await rt.shutdown()
+        await self.store.stop()
+
+    async def generate(self, prompt, rid, max_tokens=6):
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions,
+        )
+
+        pre = PreprocessedRequest(
+            model="mock", token_ids=list(prompt), request_id=rid,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=max_tokens),
+        )
+        wid = self.runtimes[1].primary_lease_id
+        toks = []
+        stream = await self.decode_client.direct(wid, pre.to_wire())
+        async for out in stream:
+            toks.extend(out.get("token_ids") or [])
+        return toks
+
+
+async def _aggregated_tokens(prompt, rid, args, max_tokens=6):
+    """Ground truth: the same request on one aggregated mock engine."""
+    from dynamo_tpu.llm.mocker import MockTpuEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime import Context
+
+    engine = MockTpuEngine(args)
+    pre = PreprocessedRequest(
+        model="mock", token_ids=list(prompt), request_id=rid,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+    toks = []
+    async for out in engine.generate(pre.to_wire(), Context(rid)):
+        toks.extend(out.get("token_ids") or [])
+    return toks
+
+
+LONG_PROMPT = list(range(100, 180))  # 80 tokens = 10 blocks @ bs 8
+
+
+async def test_mock_disagg_streams_chunks_byte_identically():
+    """The tentpole acceptance, mocker-side: a long prompt routes to the
+    prefill pool, committed KV windows stream to the decode worker WHILE
+    prefill is still chunking (early_chunks > 0), the stream matches the
+    aggregated run byte for byte, and the legacy reply-gated pull never
+    runs."""
+    async with MockDisaggPools(prefill_chunk=8) as c:
+        want = await _aggregated_tokens(LONG_PROMPT, "agg-1", c.args)
+        got = await c.generate(LONG_PROMPT, "dis-1")
+        assert got == want, "disagg stream diverged from aggregated"
+
+        st = c.decode_engine.disagg_handoff.stats
+        assert st.handoffs_started == 1
+        assert st.handoffs_streamed == 1, st.as_dict()
+        assert st.early_chunks >= 1, (
+            "no chunk was pulled before prefill completion — the handoff "
+            f"did not overlap transfer with compute: {st.as_dict()}"
+        )
+        assert st.blocks_streamed == len(LONG_PROMPT) // c.args.block_size
+        assert st.handoffs_fallback == 0
+        # Prefill ran remotely; decode imported the streamed blocks.
+        assert c.prefill_engine._iterations > 0
+        assert c.decode_engine.peer_stats.blocks_pulled >= st.blocks_streamed
+        # The prefill side actually published mid-prefill cursors.
+        pub = c.prefill_engine.cursor_publisher
+        assert pub.published_total >= 2  # at least one early + the final
+
+
+async def test_mock_disagg_short_prompt_stays_local():
+    async with MockDisaggPools() as c:
+        short = list(range(10))
+        want = await _aggregated_tokens(short, "agg-s", c.args)
+        got = await c.generate(short, "dis-s")
+        assert got == want
+        assert c.decode_engine.disagg_handoff.stats.handoffs_started == 0
+        assert c.prefill_engine._iterations == 0
+
+
+async def test_mock_disagg_sever_mid_handoff_is_bit_identical():
+    """Degradation contract at a chunk boundary: kill the window pull
+    mid-handoff; the request must complete byte-identically through the
+    reply-gated pull / local-recompute path."""
+    from dynamo_tpu.runtime import chaos
+
+    async with MockDisaggPools(prefill_chunk=8) as c:
+        chaos.install(chaos.ChaosPlan.from_dict({
+            "rules": [{
+                "point": "kv_transfer.pull", "action": "sever",
+                "count": 1,
+            }]
+        }))
+        want = await _aggregated_tokens(LONG_PROMPT, "agg-x", c.args)
+        got = await c.generate(LONG_PROMPT, "dis-x")
+        assert got == want, "severed handoff broke byte identity"
+        st = c.decode_engine.disagg_handoff.stats
+        assert st.handoffs_fallback == 1
+
+
+async def test_mock_disagg_streaming_disabled_uses_reply_gated_pull():
+    """DYN_DISAGG_STREAMING=0: the pre-ISSUE-17 pull-after-prefill path,
+    still byte-identical."""
+    import os
+
+    os.environ["DYN_DISAGG_STREAMING"] = "0"
+    try:
+        async with MockDisaggPools(prefill_chunk=8) as c:
+            assert c.decode_engine.disagg_handoff is None
+            want = await _aggregated_tokens(LONG_PROMPT, "agg-l", c.args)
+            got = await c.generate(LONG_PROMPT, "dis-l")
+            assert got == want
+            assert c.decode_engine.peer_stats.pulls_succeeded >= 1
+    finally:
+        os.environ.pop("DYN_DISAGG_STREAMING", None)

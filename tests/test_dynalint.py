@@ -76,6 +76,7 @@ PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     # consumer-facing bounds live elsewhere (ResponseStream's per-token
     # stall deadline, Subscription.get(timeout)).
     ("dynamo_tpu/engine/engine.py", "unbounded-ok", ""): 1,
+    ("dynamo_tpu/llm/disagg_pool/cursor.py", "unbounded-ok", ""): 1,
     ("dynamo_tpu/llm/mocker/engine.py", "unbounded-ok", ""): 1,
     ("dynamo_tpu/runtime/dataplane.py", "unbounded-ok", ""): 2,
     ("dynamo_tpu/runtime/store/client.py", "unbounded-ok", ""): 2,
@@ -87,6 +88,7 @@ PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     # Best-effort teardown in e2e harnesses: the runtime may already be
     # closed by the time __aexit__ re-closes it.
     ("tests/test_disagg.py", "allow", "broad-except"): 1,
+    ("tests/test_disagg_pool.py", "allow", "broad-except"): 2,
     ("tests/test_e2e_frontend.py", "allow", "broad-except"): 1,
     ("tests/test_e2e_jax_worker.py", "allow", "broad-except"): 1,
     ("tests/test_grpc_kserve.py", "allow", "broad-except"): 1,

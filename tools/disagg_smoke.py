@@ -1,0 +1,198 @@
+"""Streaming-disaggregation smoke: the real OpenAI frontend over a
+1-prefill + 1-decode mocker fleet (ISSUE 17). A long chat prompt routes
+through the decode worker, whose `DisaggRouter` ships the prefill to the
+prefill pool over the work queue; committed KV chunk windows stream back
+over the cursor plane WHILE the prefill is still chunking.
+
+Asserts the user-visible contract:
+
+- the stream is byte-identical to a single aggregated worker serving the
+  same request (disagg moves WHERE tokens are computed, never which);
+- the handoff actually streamed (``dynamo_disagg_handoffs_streamed_
+  total`` on the decode worker's /metrics moved) with zero fallbacks;
+- at least one chunk landed BEFORE prefill completion (``dynamo_disagg_
+  early_chunks_total`` >= 1) — transfer overlapped compute, which is the
+  entire point of the subsystem.
+
+CI usage (`.github/workflows/ci.yml` disagg-smoke step) and local:
+
+    python tools/disagg_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+# Runnable straight from a checkout (CI also pip-installs the package).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.megastep_smoke import stream_text  # noqa: E402
+
+# Long enough that the rendered prompt spans many KV blocks and far
+# exceeds the disagg router's local-prefill ceiling below.
+PROMPT = "streaming disaggregation smoke " * 40
+BODY = {
+    "model": "mock",
+    "messages": [{"role": "user", "content": PROMPT}],
+    "max_tokens": 24,
+    "temperature": 0,
+    "stream": True,
+}
+
+
+def _engine_args():
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+
+    # Tight prefill chunks so the remote prefill commits many cursor
+    # advances — the decode side must catch at least one mid-prefill.
+    return MockEngineArgs(
+        num_kv_blocks=4096,
+        block_size=8,
+        speedup_ratio=20.0,
+        scheduling="chunked",
+        prefill_chunk=8,
+    )
+
+
+def _disagg_config():
+    from dynamo_tpu.llm.disagg import DisaggConfig
+
+    return DisaggConfig(max_local_prefill_length=16)
+
+
+async def _boot(roles: list[str]):
+    """Store + one mocker worker per role (each with a live status
+    server) + a frontend; returns (handles, base_url)."""
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.status_server import SystemStatusServer
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    runtimes, tasks, statuses = [], [], []
+    for role in roles:
+        rt = await DistributedRuntime.create(store.address)
+        status = SystemStatusServer(host="127.0.0.1", port=0)
+        await status.start()
+        rt.status = status
+        statuses.append(status)
+        served = asyncio.Event()
+        component = role if role != "aggregated" else "backend"
+        tasks.append(
+            asyncio.create_task(
+                run_mocker(
+                    rt, model_name="mock", component=component,
+                    engine_args=_engine_args(), served_event=served,
+                    role=role, disagg_config=_disagg_config(),
+                )
+            )
+        )
+        await asyncio.wait_for(served.wait(), 30)
+        runtimes.append(rt)
+    front_rt = await DistributedRuntime.create(store.address)
+    runtimes.append(front_rt)
+    ready = asyncio.Event()
+    services: list = []
+    tasks.append(
+        asyncio.create_task(
+            run_frontend(
+                front_rt, http_host="127.0.0.1", http_port=0,
+                ready_event=ready, service_out=services,
+            )
+        )
+    )
+    await asyncio.wait_for(ready.wait(), 30)
+    return (store, runtimes, tasks, statuses), f"http://127.0.0.1:{services[0].port}"
+
+
+async def _teardown(handles) -> None:
+    store, runtimes, tasks, statuses = handles
+    for t in tasks:
+        t.cancel()
+    for rt in runtimes:
+        await rt.shutdown()
+    for st in statuses:
+        await st.stop()
+    await store.stop()
+
+
+async def _wait_model(s, base: str) -> None:
+    for _ in range(200):
+        async with s.get(f"{base}/v1/models") as r:
+            if (await r.json())["data"]:
+                return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("model never appeared on frontend")
+
+
+def _gauge(metrics: str, name: str) -> float:
+    for line in metrics.splitlines():
+        if line.startswith(name):
+            return float(line.rsplit(None, 1)[-1])
+    raise AssertionError(f"gauge {name!r} not on /metrics")
+
+
+async def run() -> None:
+    import aiohttp
+
+    # Reference: one aggregated worker streaming the same request.
+    handles, base = await _boot(["aggregated"])
+    try:
+        async with aiohttp.ClientSession() as s:
+            await _wait_model(s, base)
+            want = await stream_text(s, f"{base}/v1/chat/completions", dict(BODY))
+    finally:
+        await _teardown(handles)
+    assert want, "aggregated reference streamed nothing"
+
+    # The disagg fleet: 1 prefill + 1 decode worker. Only the decode
+    # worker registers with the frontend; the prefill worker serves the
+    # namespace work queue and advertises chunk commits on the cursor
+    # plane as they land.
+    handles, base = await _boot(["prefill", "decode"])
+    try:
+        decode_status = handles[3][1]
+        async with aiohttp.ClientSession() as s:
+            await _wait_model(s, base)
+            got = await stream_text(s, f"{base}/v1/chat/completions", dict(BODY))
+            async with s.get(
+                f"http://127.0.0.1:{decode_status.port}/metrics"
+            ) as r:
+                assert r.status == 200
+                metrics = await r.text()
+    finally:
+        await _teardown(handles)
+
+    assert got == want, (
+        f"disagg stream diverged from the aggregated reference:\n"
+        f"  want: {want!r}\n  got:  {got!r}"
+    )
+    streamed = _gauge(metrics, "dynamo_disagg_handoffs_streamed_total")
+    early = _gauge(metrics, "dynamo_disagg_early_chunks_total")
+    fallbacks = _gauge(metrics, "dynamo_disagg_handoff_fallback_total")
+    chunks = _gauge(metrics, "dynamo_disagg_chunks_pulled_total")
+    assert streamed >= 1, "the request never took the streaming handoff"
+    assert early >= 1, (
+        "no chunk was pulled before prefill completion — transfer never "
+        "overlapped compute"
+    )
+    assert fallbacks == 0, f"{fallbacks} handoffs fell back in a healthy fleet"
+    print(
+        f"disagg-smoke OK: stream byte-identical to the aggregated run; "
+        f"{int(streamed)} streaming handoff(s), {int(chunks)} chunk(s) "
+        f"pulled ({int(early)} before prefill completion), 0 fallbacks",
+        flush=True,
+    )
+
+
+def main() -> int:
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
