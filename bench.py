@@ -1288,6 +1288,146 @@ def run_spec_ab() -> dict:
     }
 
 
+def run_device_draft_ab() -> dict:
+    """On-device n-gram drafting A/B on the mocker's VIRTUAL clock
+    (ISSUE 18): host-drafted speculation vs device-resident ring
+    drafting at EQUAL spec_k, under the universal megastep. The host
+    drafter pays one dispatch per draft->verify->accept round; the
+    device drafter runs up to megastep_k-1 rounds BETWEEN inner
+    iterations of one dispatch, so the per-dispatch overhead amortizes
+    over every round. Two cost profiles ("relay" = measured 58 ms
+    dispatch overhead, "lan" = 0.5 ms) x acceptance {0.5, 0.9}; device
+    draft rounds are priced on the clock (DYN_SPEC_DRAFT_ROUND_US) and
+    drafted tokens like prefill tokens, so ratios carry the drafting
+    cost, not just the win. Streams are asserted bit-identical across
+    spec-off / host-draft / device-draft inside every cell; the REAL
+    engine's parity matrix is pinned by tests/test_spec_decode.py."""
+    import asyncio
+
+    from dynamo_tpu.llm.mocker.engine import MockEngineArgs, MockTpuEngine, _Seq
+    from dynamo_tpu.llm.protocols.common import StopConditions
+    from dynamo_tpu.tokens import TokenBlockSequence, compute_seq_hashes
+
+    B, ISL, OSL, K, MEGA = 16, 128, 64, 4, 8
+    PROFILES = {"relay": 58000.0, "lan": 500.0}
+
+    def run(base_us: float, rate: float | None,
+            device: bool) -> tuple[dict, dict]:
+        args = MockEngineArgs(
+            num_kv_blocks=8192, block_size=32, max_num_seqs=B,
+            max_num_batched_tokens=2048, enable_prefix_caching=False,
+            base_iter_us=base_us, megastep_k=MEGA,
+            **(
+                dict(spec_decode="ngram", spec_k=K,
+                     spec_acceptance_rate=rate, spec_device_draft=device)
+                if rate is not None
+                else {}
+            ),
+        )
+        eng = MockTpuEngine(args)
+        seqs = []
+        for j in range(B):
+            prompt = [1 + (j % 7)] * ISL
+            s = _Seq(
+                request_id=f"s{j}", prompt=prompt, max_tokens=OSL,
+                out=asyncio.Queue(),
+                seq=TokenBlockSequence(prompt, args.block_size),
+                prompt_hashes=compute_seq_hashes(prompt, args.block_size),
+                stop=StopConditions(max_tokens=OSL, ignore_eos=True),
+            )
+            s.spec_k = K if rate is not None else 0
+            s.spec_device = device if rate is not None else False
+            seqs.append(s)
+            eng._waiting.append(s)
+        vt = 0.0
+        first: dict[str, float] = {}
+        prev: dict[str, float] = {}
+        gaps: list[float] = []
+        streams: dict[str, list[int]] = {s.request_id: [] for s in seqs}
+        dispatches = 0
+        while any(s in eng._running or s in eng._waiting for s in seqs):
+            eng._admit()
+            p, d = eng._step()
+            dispatches += 1
+            vt += eng.iter_time_s(
+                p, d, eng._last_kv_blocks_read, eng._last_device_rounds
+            )
+            for s in seqs:
+                while not s.out.empty():
+                    item = s.out.get_nowait()
+                    if not isinstance(item, dict):
+                        continue
+                    toks = item.get("token_ids", [])
+                    if not toks:
+                        continue
+                    streams[s.request_id].extend(toks)
+                    rid = s.request_id
+                    if rid in first:
+                        gaps.extend([(vt - prev[rid]) / len(toks)] * len(toks))
+                    first.setdefault(rid, vt)
+                    prev[rid] = vt
+        gaps.sort()
+        decode_s = vt - max(first.values())
+        st = eng.spec_decode_stats()
+        return {
+            "tpot_p50_ms": round(gaps[len(gaps) // 2] * 1e3, 3),
+            "tpot_p99_ms": round(
+                gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))] * 1e3, 3
+            ),
+            "decode_tok_s": round(B * (OSL - 1) / max(decode_s, 1e-9), 1),
+            "acceptance_rate": round(st["acceptance_rate"], 3),
+            "device_rounds": st["device_rounds"],
+            "device_hits": st["device_hits"],
+            "dispatches_per_accepted_token": round(
+                st["dispatches_per_accepted_token"], 4
+            ),
+            "dispatches": dispatches,
+        }, streams
+
+    rows = []
+    headline = None
+    for profile, base_us in PROFILES.items():
+        base_row, base_streams = run(base_us, None, False)
+        rows.append(dict(base_row, config=f"{profile}-spec-off"))
+        for rate in (0.5, 0.9):
+            host_row, host_streams = run(base_us, rate, False)
+            dev_row, dev_streams = run(base_us, rate, True)
+            assert host_streams == base_streams, (
+                f"{profile}@{rate}: host-draft stream diverged from spec-off"
+            )
+            assert dev_streams == base_streams, (
+                f"{profile}@{rate}: device-draft stream diverged from spec-off"
+            )
+            ratio = round(dev_row["tpot_p50_ms"] / host_row["tpot_p50_ms"], 3)
+            rows.append(dict(host_row, config=f"{profile}-host@{rate}"))
+            rows.append(dict(dev_row, config=f"{profile}-device@{rate}",
+                             tpot_p50_vs_host=ratio))
+            if profile == "relay" and rate == 0.9:
+                headline = ratio
+    return {
+        "metric": (
+            f"mocker on-device-draft A/B decode TPOT p50 ratio "
+            f"(relay profile, acceptance 0.9, B={B}, {ISL}/{OSL}, "
+            f"k={K}, megastep_k={MEGA}, device vs host drafting, "
+            "virtual clock)"
+        ),
+        "value": headline,
+        "unit": "x vs host-drafted spec (lower is better; deterministic "
+                "mocker clock)",
+        "vs_baseline": round(1.0 / headline, 4),
+        "rows": rows,
+        "note": (
+            "device drafting runs up to megastep_k-1 draft->verify->"
+            "accept rounds inside ONE dispatch (ring match priced at "
+            "DYN_SPEC_DRAFT_ROUND_US per round, drafted tokens like "
+            "prefill tokens); the host drafter pays a dispatch per "
+            "round. Streams asserted bit-identical spec-off/host/device "
+            "in every cell; real-engine bit-identity pinned by "
+            "tests/test_spec_decode.py"
+        ),
+    }
+
+
 def run_async_ab() -> dict:
     """Async pipelined-execution A/B on the mocker's VIRTUAL clock
     (ISSUE 5): async-exec off vs on across decode batch widths, with
@@ -1909,6 +2049,12 @@ def main() -> None:
             traceback.print_exc()
         try:
             r = run_spec_ab()
+            results.append(r)
+            print(json.dumps(r), flush=True)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        try:
+            r = run_device_draft_ab()
             results.append(r)
             print(json.dumps(r), flush=True)
         except Exception:  # noqa: BLE001

@@ -1294,6 +1294,14 @@ def main() -> None:
              "dyn.spec_decode k)",
     )
     ap.add_argument(
+        "--spec-device-draft", action="store_true", default=None,
+        help="draft ON DEVICE between megastep inner iterations: the "
+             "history ring lives in the scanned dispatch and each inner "
+             "iteration re-drafts from it — draft->verify->accept loops "
+             "without leaving the device (needs --megastep-k >= 2; "
+             "stream stays bit-identical)",
+    )
+    ap.add_argument(
         "--async-exec", default=None, choices=["on", "off"],
         help="one-step-ahead pipelined engine loop: plan+enqueue step N+1 "
              "while N executes, with device-resident token feedback and "
@@ -1411,6 +1419,7 @@ def main() -> None:
             "max_num_batched_tokens": args.max_num_batched_tokens,
             "spec_decode": args.spec_decode,
             "spec_k": args.spec_k,
+            "spec_device_draft": args.spec_device_draft,
             "megastep_k": args.megastep_k,
             "kv_dtype": args.kv_dtype,
             "async_exec": (

@@ -758,6 +758,13 @@ def main() -> None:
                     help="draft tokens per verify step")
     ap.add_argument("--spec-acceptance-rate", type=float, default=0.6,
                     help="per-draft-token acceptance probability")
+    ap.add_argument("--spec-device-draft", action="store_true",
+                    help="draft on device between megastep inner "
+                         "iterations (ISSUE 18): each later inner "
+                         "iteration becomes a draft->verify->accept "
+                         "round riding the same priced dispatch "
+                         "(needs --megastep-k >= 2; stream stays "
+                         "bit-identical)")
     ap.add_argument("--async-exec", default="off", choices=["on", "off"],
                     help="one-step-ahead overlap model: per-iteration host "
                          "overhead hides under device compute (virtual "
@@ -832,6 +839,7 @@ def main() -> None:
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
         spec_acceptance_rate=args.spec_acceptance_rate,
+        spec_device_draft=args.spec_device_draft,
         async_exec=args.async_exec == "on",
         megastep_k=args.megastep_k,
         kv_dtype=args.kv_dtype,

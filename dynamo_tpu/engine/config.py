@@ -244,6 +244,19 @@ class EngineConfig:
     spec_ngram_min: int = 1
     spec_ngram_max: int = 3
     spec_window: int = 1024
+    # Draft ON DEVICE between megastep inner iterations: each speculating
+    # lane carries a packed prompt+output history ring through the scanned
+    # body, suffix-matches it after every accept/reject, and verifies the
+    # fresh draft in the next inner iteration — draft→verify→accept loops
+    # inside ONE dispatch, so accepted depth compounds to
+    # 1 + (megastep-1)·(spec_k+1) tokens per dispatch. The device matcher
+    # replays spec/ngram.py's proposal exactly (longest suffix first, most
+    # recent occurrence, window bound) or proposes nothing, so the stream
+    # stays bit-identical to host drafting and to spec off. Requires
+    # megastep >= 2 to change anything (the loop lives between inner
+    # iterations); lanes degrade to host drafting per dispatch when block
+    # pressure cannot reserve the worst-case accepted depth.
+    spec_device_draft: bool = False
 
     @property
     def kv_quantized(self) -> bool:

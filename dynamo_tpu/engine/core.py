@@ -51,11 +51,14 @@ from dynamo_tpu.engine.model import (
     forward_tokens,
     init_cache,
     init_params,
+    verify_tokens,
 )
 from dynamo_tpu.engine.sampler import (
     LOGPROBS_K,
+    device_ngram_draft,
     gather_feedback,
     resolve_verify,
+    ring_append,
     sample_seeded,
     stop_flags,
     stop_flags_prefix,
@@ -182,16 +185,24 @@ class _PendingFetch:
     host while the next step computes. ``sr`` carries the (S, R) reshape
     for sample-width dispatches (the legacy 2-D return shape)."""
 
-    def __init__(self, core: "EngineCore", toks, lps, sr=None):
+    def __init__(self, core: "EngineCore", toks, lps, sr=None, aux=None):
         self.core = core
         self.toks = toks
         self.lps = lps
         self.sr = sr
+        self.aux = aux
         self.no = core._note_dispatch()
         start_host_copy(toks)
+        if aux is not None:
+            start_host_copy(aux)
         if lps is not None:
             for a in lps:
                 start_host_copy(a)
+
+    def land_aux(self):
+        """Land the side-channel int array (device-draft round
+        accounting); call only after construction with ``aux``."""
+        return fetch_replicated(self.aux)  # dynalint: sync-ok — double-buffered landing point
 
     def land(self):
         core = self.core
@@ -236,6 +247,13 @@ class _PlannedStep:
     # token: the next plan's token buffer gathers from here on device.
     feed_tokens: Any = None
     feed_index: dict[str, int] = field(default_factory=dict)
+    # request_id -> (start, stride, count): this step's FULL per-lane
+    # emission as flat indices into feed_tokens, in stream order. Set
+    # only by deterministic plans (exactly the ones the async loop may
+    # plan over); a device-drafting lane's next plan gathers these into
+    # its history ring so the on-device drafter sees in-flight tokens
+    # (ISSUE 18).
+    feed_series: dict[str, tuple[int, int, int]] = field(default_factory=dict)
     # False when any lane's advance is data-dependent (verify rows with
     # live drafts): the next plan must commit this step first.
     deterministic: bool = True
@@ -479,6 +497,147 @@ def _megastep_fused_body(
 
         lps = tuple(widen(a0, ar) for a0, ar in zip(lp0, rest_lp))
     return _replicate_out(sampled, mesh), _replicate_out(lps, mesh), cache
+
+
+def _megastep_draft_body(
+    params, cache,
+    # -- iteration 0: the ragged program (exactly _dispatch_fused's shape)
+    tokens, positions, write_pages, write_offs, kv_lens, block_tables,
+    cu_q_lens, num_seqs, gather,
+    seeds_r, counters_r, temp_r, top_k_r, top_p_r,
+    mm_embeds, mm_mask,
+    # -- per-lane continuation state ([S] unless noted)
+    draft, draft_len,        # [S, R-1] host-drafted tokens, live length
+    cont_active,             # bool — lane continues past iteration 0
+    base_pos,                # write position of the first post-0 write at acc=0
+    seeds, temp, top_k, top_p,
+    watch, budgets, min_left,
+    # -- on-device drafting state (ISSUE 18)
+    hist, hist_len,          # [S, H] right-aligned history ring, [S] lengths
+    dd,                      # [S] bool — lanes that draft on device
+    win, nmin, nmax, kmax,   # [S] per-lane resolved drafter knobs
+    *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
+    want_mm=False, ngram_max_static, cfg, engine, mesh=None,
+):
+    """The ON-DEVICE-DRAFTING megastep (ISSUE 18): the universal
+    megastep's ragged first iteration, fused with ``n_steps - 1``
+    verify-SHAPED scanned iterations. Between iterations each
+    device-drafting lane suffix-matches its history ring
+    (:func:`sampler.device_ngram_draft` — the bit-exact scanned-body
+    replay of ``spec/ngram.py``), and the next iteration verifies
+    pending + fresh draft as one width-R row
+    (:func:`model.verify_tokens`), resolves accept/reject on device, and
+    appends the emitted tokens back into the ring
+    (:func:`sampler.ring_append`) — draft→verify→accept LOOPS inside one
+    dispatch, so accepted depth compounds to ``1 + (n_steps-1) * R``
+    tokens per dispatch while the host pays one fixed dispatch overhead.
+
+    Non-drafting lanes (prefill chunks, plain decode rows, host-drafted
+    verify rows riding the same batch) draft nothing each round
+    (``draft_len == 0``), so their rounds degenerate to exactly the
+    fused body's one-token scan semantics — same counters, same budget
+    arithmetic (:func:`sampler.stop_flags_prefix` with the running
+    per-lane ``gen`` base), same under-stop-never-over-stop contract.
+    The host stop-scan stays the authority: a host-side stop truncates
+    the emission via the ``num_computed_tokens`` cursor, and the ring is
+    repacked from host history at the next plan, which is the whole
+    ring-rollback story.
+
+    Returns sampled [n_steps, S, R] plus a [3, n_steps, S] int32 aux
+    (per-round emitted counts / draft lengths / accepted counts — round
+    0 carries the iteration-0 resolution) the commit replays, plus
+    matching logprob arrays."""
+    logits, cache = forward_tokens(
+        params, cache, tokens, positions, write_pages, write_offs,
+        kv_lens, block_tables, cu_q_lens, num_seqs, gather,
+        cfg, engine, mesh,
+        mm_embeds=mm_embeds if want_mm else None,
+        mm_mask=mm_mask if want_mm else None,
+    )
+    t0 = sample_seeded(
+        logits, seeds_r, counters_r, temp_r, top_k_r, top_p_r,
+        need_mask=need_mask, all_greedy=all_greedy,
+    )
+    lp0 = token_logprobs(logits, t0) if want_logprobs else None
+    S = draft.shape[0]
+    R = t0.shape[0] // S
+    t0s = t0.reshape(S, R)
+    acc, cur = resolve_verify(t0s, draft, draft_len)
+    alive0 = cont_active & ~stop_flags_prefix(
+        t0s, acc, watch, budgets, min_left
+    )
+    gen0 = jnp.where(cont_active, acc + 1, 0)   # tokens iteration 0 produced
+    pos0 = base_pos + acc                       # next write position
+    counters0 = counters_r.reshape(S, R)[:, 0]  # per-lane generated base
+    # Iteration-0 emission enters the ring (drafting lanes only; the
+    # ring of a non-dd lane is dead weight carried as zeros).
+    hist, hist_len = ring_append(hist, hist_len, t0s, jnp.where(dd, gen0, 0))
+    jR = jnp.arange(R, dtype=jnp.int32)
+    rep = lambda a: jnp.repeat(a, R, axis=0)  # noqa: E731 — [S] -> [S*R]
+
+    def body(carry, _):
+        tok, cache, alive, pos, gen, hist, hlen = carry
+        act = alive
+        # Redraft from the ring: budget-clamped exactly like the host
+        # (`_draft_for`): at most remaining-budget - 1 so the mandatory
+        # correction/bonus token always fits.
+        kc = jnp.where(dd & act, jnp.minimum(kmax, budgets - gen - 1), 0)
+        dtoks, dlen = device_ngram_draft(
+            hist, hlen, win, nmin, nmax, kc,
+            ngram_max_static=ngram_max_static, slots=R - 1,
+        )
+        slot = jnp.concatenate(
+            [tok[:, None], jnp.where(dtoks >= 0, dtoks, 0)], axis=1
+        )
+        logits, cache = verify_tokens(
+            params, cache, slot, block_tables, pos, dlen, act, cfg,
+            engine, mesh,
+        )
+        cnt = ((counters0 + gen)[:, None] + jR[None, :]).reshape(-1)
+        nxt = sample_seeded(
+            logits, rep(seeds), cnt, rep(temp), rep(top_k), rep(top_p),
+            need_mask=need_mask, all_greedy=all_greedy,
+        )
+        ns = nxt.reshape(S, R)
+        accj, nxt_tok = resolve_verify(ns, dtoks, dlen)
+        e = jnp.where(act, accj + 1, 0)
+        out = jnp.where(act[:, None], ns, tok[:, None])
+        lp = token_logprobs(logits, out.reshape(-1)) if want_logprobs else None
+        stop = stop_flags_prefix(
+            ns, accj, watch, budgets, min_left, gen_base=gen
+        )
+        alive = alive & ~stop
+        pos = pos + e
+        gen = gen + e
+        hist, hlen = ring_append(hist, hlen, ns, jnp.where(dd, e, 0))
+        tok = jnp.where(act, nxt_tok, tok)
+        return (tok, cache, alive, pos, gen, hist, hlen), (out, e, dlen, accj, lp)
+
+    (_, cache, _, _, _, _, _), (rest, es, dls, accs, rest_lp) = jax.lax.scan(
+        body, (cur, cache, alive0, pos0, gen0, hist, hist_len), None,
+        length=n_steps - 1,
+    )
+    sampled = jnp.concatenate([t0s[None], rest], axis=0)  # [n_steps, S, R]
+    aux = jnp.stack([
+        jnp.concatenate([gen0[None], es], axis=0),
+        jnp.concatenate([draft_len[None], dls], axis=0),
+        jnp.concatenate([acc[None], accs], axis=0),
+    ]).astype(jnp.int32)                                  # [3, n_steps, S]
+    lps = None
+    if want_logprobs:
+        def widen(a0, ar):
+            # a0: [S*R(,K)] iteration-0 slots; ar: [n_steps-1, S*R(,K)]
+            a0 = a0.reshape((1, S, R) + a0.shape[1:])
+            ar = ar.reshape((n_steps - 1, S, R) + ar.shape[2:])
+            return jnp.concatenate([a0, ar], axis=0)
+
+        lps = tuple(widen(a0, ar) for a0, ar in zip(lp0, rest_lp))
+    return (
+        _replicate_out(sampled, mesh),
+        _replicate_out(aux, mesh),
+        _replicate_out(lps, mesh),
+        cache,
+    )
 
 
 def _replicate_out(x, mesh):
@@ -812,10 +971,19 @@ class EngineCore:
                 ngram_min=engine_cfg.spec_ngram_min,
                 ngram_max=engine_cfg.spec_ngram_max,
                 window=engine_cfg.spec_window,
+                device=engine_cfg.spec_device_draft,
             )
             if engine_cfg.spec_decode != "off"
             else None
         )
+        # On-device drafting (ISSUE 18): per-lane history ring width.
+        # The host drafter is handed the last window + ngram_max tokens
+        # (`_draft_for`), so a ring of exactly that width sees the same
+        # candidate set — device and host proposals cannot diverge.
+        self._spec_device = (
+            engine_cfg.spec_decode != "off" and engine_cfg.spec_device_draft
+        )
+        self._ring_H = engine_cfg.spec_window + engine_cfg.spec_ngram_max
         self.spec_stats = SpecStats()
         self.cfg = model_cfg
         self.engine = engine_cfg
@@ -1243,6 +1411,23 @@ class EngineCore:
             ),
             donate_argnums=(1,),
         )
+        # On-device drafting megastep (ISSUE 18): same ragged first
+        # iteration, but the n_steps-1 scanned iterations are
+        # verify-SHAPED — each round suffix-matches the per-lane history
+        # ring, verifies the fresh draft R-wide, resolves accept/reject,
+        # and redrafts, so draft→verify→accept loops inside one dispatch.
+        self._drafted = jax.jit(
+            partial(
+                _megastep_draft_body, cfg=model_cfg, engine=engine_cfg,
+                mesh=mesh,
+                ngram_max_static=engine_cfg.spec_ngram_max,
+            ),
+            static_argnames=(
+                "n_steps", "need_mask", "all_greedy", "want_logprobs",
+                "want_mm",
+            ),
+            donate_argnums=(1,),
+        )
         self._prefill_pp = None
         self._decode_pp = None
         if pp_mesh is not None:
@@ -1418,6 +1603,21 @@ class EngineCore:
         if self._inflight is None:
             return None
         return self._inflight.feed_index.get(seq.request_id)
+
+    def _feed_series(self, seq: Sequence) -> tuple[int, int, int] | None:
+        """The in-flight step's FULL emission for this lane as an
+        arithmetic series of flat device-output indices
+        (start, stride, count), or None. Where :meth:`_feed_src` feeds
+        one pending token into the next plan's token buffer, this feeds
+        the whole in-flight tail into a device-drafting lane's history
+        ring — the pending token AND the draft context live on device,
+        so the drafter matches against up-to-the-dispatch history
+        instead of the stale host-visible tail (ISSUE 18: a
+        device-drafting lane no longer needs the pipeline barrier host
+        drafting implied)."""
+        if self._inflight is None:
+            return None
+        return self._inflight.feed_series.get(seq.request_id)
 
     def _note_dispatch(self) -> int:
         """Dispatch-side bookkeeping for the pipelining invariants: the
@@ -1724,19 +1924,24 @@ class EngineCore:
         self, rows: list[tuple[Sequence, list[int], int, int]], S: int,
         n_sample: list[int] | None = None,
         feed_rows: list[int | None] | None = None,
+        force_R: bool = False,
     ) -> "_RaggedBatch":
         """Host-side assembly of ONE ragged forward's inputs over
         arbitrary rows — shared by the plain single-step dispatch
         (:meth:`_dispatch_ragged`) and the universal megastep's first
         iteration (:meth:`_dispatch_fused`), so the two can never
-        disagree about row packing, sample gathers, or counter keys."""
+        disagree about row packing, sample gathers, or counter keys.
+        ``force_R`` keeps the verify sample width even when every row is
+        q_len=1 — a device-drafting dispatch needs the R-wide slots for
+        its inner rounds although iteration 0 carries no host draft."""
         P = self.engine.max_blocks_per_seq
         bs = self.engine.block_size
         total = sum(len(tl) for _, tl, _, _ in rows)
         T = self._bucket_for(total)
         R = (
             self._spec_R
-            if n_sample is not None and any(n > 1 for n in n_sample)
+            if force_R
+            or (n_sample is not None and any(n > 1 for n in n_sample))
             else 1
         )
 
@@ -1968,6 +2173,7 @@ class EngineCore:
         drafts: list[list[int]],
         cont: list[bool],
         n_steps: int,
+        device: list[bool] | None = None,
     ) -> _PendingFetch:
         """Assemble and enqueue one UNIVERSAL megastep (ISSUE 12): the
         same ragged first iteration :meth:`_dispatch_ragged` would run
@@ -1981,8 +2187,19 @@ class EngineCore:
         accept/reject on device, so the continuation restarts from the
         correction token with no host round trip. Returns a pending
         fetch whose ``land()`` yields ([n_steps, S, R] tokens, matching
-        logprob arrays or None)."""
-        b = self._assemble_ragged(rows, S, n_sample, feed_rows)
+        logprob arrays or None).
+
+        When any lane in ``device`` drafts on device (ISSUE 18), the
+        dispatch runs :func:`_megastep_draft_body` instead: each lane's
+        history ring is packed host-side from prompt + out_tokens — with
+        the in-flight tail gathered ON DEVICE from the previous
+        dispatch's output via :meth:`_feed_series`, so the drafter sees
+        tokens the host has not committed yet — and the inner iterations
+        are verify-shaped draft→verify→accept rounds. The pending fetch
+        then also carries the [3, n_steps, S] per-round accounting
+        (``land_aux``)."""
+        use_dd = device is not None and any(device)
+        b = self._assemble_ragged(rows, S, n_sample, feed_rows, force_R=use_dd)
         R = b.R
         W = MEGASTEP_WATCH_W
         draft = np.full((S, R - 1), -1, np.int32)
@@ -1990,9 +2207,13 @@ class EngineCore:
         cont_a = np.zeros(S, bool)
         base_pos = np.zeros(S, np.int32)
         watch = np.full((S, W), -1, np.int32)
-        # Padded / masked lanes never hit their budget (the deepest lane
-        # emits accepted + 1 + (n_steps - 1) <= R + n_steps - 1 tokens).
-        budgets = np.full(S, n_steps + R + 1, np.int32)
+        # Padded / masked lanes never hit their budget. The fused body's
+        # deepest lane emits accepted + 1 + (n_steps - 1) <= R + n_steps
+        # - 1 tokens; a device-drafting lane can emit up to R tokens per
+        # round — n_steps * R worst case — so its padding sits past that.
+        budgets = np.full(
+            S, (n_steps * R if use_dd else n_steps + R) + 1, np.int32
+        )
         min_left = np.zeros(S, np.int32)
         for i, ((seq, toks_list, pos0, _kv), kind) in enumerate(
             zip(rows, kinds)
@@ -2010,6 +2231,11 @@ class EngineCore:
         if b.feed_idx is not None:
             tok_in = self._feed(
                 self._inflight.feed_tokens, tok_in, jnp.asarray(b.feed_idx)
+            )
+        if use_dd:
+            return self._dispatch_drafted(
+                rows, b, device, tok_in, draft, draft_len, cont_a,
+                base_pos, watch, budgets, min_left, n_steps, kinds,
             )
         out, lps, self.cache = self._fused(
             self.params,
@@ -2057,6 +2283,137 @@ class EngineCore:
             self.exec_stats["fused_mixed_dispatches"] += 1
         return _PendingFetch(self, out, lps)  # [n_steps, S, R] on land()
 
+    def _dispatch_drafted(
+        self,
+        rows: list[tuple[Sequence, list[int], int, int]],
+        b,
+        device: list[bool],
+        tok_in,
+        draft,
+        draft_len,
+        cont_a,
+        base_pos,
+        watch,
+        budgets,
+        min_left,
+        n_steps: int,
+        kinds: list[str],
+    ) -> _PendingFetch:
+        """Pack per-lane history rings and enqueue the ON-DEVICE-DRAFTING
+        megastep (:func:`_megastep_draft_body`, ISSUE 18). The ring of a
+        drafting lane is exactly the tail :meth:`_draft_for` would hand
+        the host drafter — last ``window + ngram_max`` tokens of
+        prompt + out_tokens, newest right-aligned — except that under
+        async execution the in-flight step's emission is gathered ON
+        DEVICE from the previous dispatch's output
+        (:meth:`_feed_series`), so the drafter matches against history
+        the host has not committed yet. Host stop-scans stay the
+        authority: the ring is re-packed from host truth every plan, so
+        a host-side truncation (stop string, budget clamp) rolls the
+        ring back for free."""
+        S = int(budgets.shape[0])
+        R = b.R
+        H = self._ring_H
+        hist = np.zeros((S, H), np.int32)
+        hlen = np.zeros(S, np.int32)
+        dd = np.zeros(S, bool)
+        win = np.ones(S, np.int32)
+        nmin = np.ones(S, np.int32)
+        nmax = np.ones(S, np.int32)
+        kmax = np.zeros(S, np.int32)
+        ring_src = None
+        for i, (seq, _toks, _pos0, _kv) in enumerate(rows):
+            if not device[i]:
+                continue
+            dd[i] = True
+            sc = seq.spec
+            win[i] = sc.window
+            nmin[i] = sc.ngram_min
+            nmax[i] = sc.ngram_max
+            kmax[i] = min(sc.k, R - 1)
+            take = 0
+            series = self._feed_series(seq)
+            if series is not None:
+                start, stride, cnt = series
+                take = min(cnt, H)
+                if ring_src is None:
+                    ring_src = np.full((S, H), -1, np.int32)
+                for j in range(take):
+                    ring_src[i, H - take + j] = start + (cnt - take + j) * stride
+            # Host-visible tail fills the remainder — the same context
+            # rule as _draft_for (prompt tail + out_tokens, newest at
+            # the right edge), so host and device drafters see the same
+            # history whenever nothing is in flight.
+            need = H - take
+            if need <= 0:
+                ctx: list[int] = []
+            elif len(seq.out_tokens) >= need:
+                ctx = seq.out_tokens[-need:]
+            else:
+                keep = need - len(seq.out_tokens)
+                ctx = (
+                    seq.prompt[max(0, len(seq.prompt) - keep):]
+                    + seq.out_tokens
+                )
+            L = len(ctx)
+            if L:
+                hist[i, H - take - L: H - take] = ctx
+            hlen[i] = min(L + take, H)
+        hist_in = jnp.asarray(hist)
+        if ring_src is not None:
+            hist_in = self._feed(
+                self._inflight.feed_tokens,
+                hist_in.reshape(-1),
+                jnp.asarray(ring_src.reshape(-1)),
+            ).reshape(S, H)
+        out, aux, lps, self.cache = self._drafted(
+            self.params,
+            self.cache,
+            tok_in,
+            jnp.asarray(b.positions),
+            jnp.asarray(b.write_pages),
+            jnp.asarray(b.write_offs),
+            jnp.asarray(b.kv_lens),
+            jnp.asarray(b.tables),
+            jnp.asarray(b.cu),
+            jnp.asarray(np.array([len(rows)], np.int32)),
+            jnp.asarray(b.gather.reshape(-1)),
+            jnp.asarray(np.repeat(b.seeds, R)),
+            jnp.asarray(b.counters.reshape(-1)),
+            jnp.asarray(np.repeat(b.temp, R)),
+            jnp.asarray(np.repeat(b.top_k, R)),
+            jnp.asarray(np.repeat(b.top_p, R)),
+            jnp.asarray(b.mm_embeds),
+            jnp.asarray(b.mm_mask),
+            jnp.asarray(draft),
+            jnp.asarray(draft_len),
+            jnp.asarray(cont_a),
+            jnp.asarray(base_pos),
+            jnp.asarray(b.seeds),
+            jnp.asarray(b.temp),
+            jnp.asarray(b.top_k),
+            jnp.asarray(b.top_p),
+            jnp.asarray(watch),
+            jnp.asarray(budgets),
+            jnp.asarray(min_left),
+            hist_in,
+            jnp.asarray(hlen),
+            jnp.asarray(dd),
+            jnp.asarray(win),
+            jnp.asarray(nmin),
+            jnp.asarray(nmax),
+            jnp.asarray(kmax),
+            n_steps=n_steps,
+            need_mask=b.need_mask and not b.all_greedy,
+            all_greedy=b.all_greedy,
+            want_logprobs=b.want_lp,
+            want_mm=b.want_mm,
+        )
+        self.exec_stats["megastep_dispatches"] += 1
+        if any(k != "d" for k in kinds):
+            self.exec_stats["fused_mixed_dispatches"] += 1
+        return _PendingFetch(self, out, lps, aux=aux)
+
     def _plan_prefill_wave(self, seqs: list[Sequence]) -> _PlannedStep | None:
         """Plan one ragged prefill wave: up to ``prefill_batch`` sequences
         under a shared token budget (largest prefill bucket) — different
@@ -2089,11 +2446,13 @@ class EngineCore:
         pend = self._dispatch_ragged(rows, S)
         adv: dict[str, tuple[int, int, int]] = {}
         feed_index: dict[str, int] = {}
+        feed_series: dict[str, tuple[int, int, int]] = {}
         for i, (seq, p0, chunk) in enumerate(chosen):
             done = p0 + chunk >= seq.prompt_len
             adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
             if done:
                 feed_index[seq.request_id] = i
+                feed_series[seq.request_id] = (i, 0, 1)
 
         # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
@@ -2127,6 +2486,7 @@ class EngineCore:
         return _PlannedStep(
             core=self, commit_fn=commit, adv=adv,
             feed_tokens=pend.toks, feed_index=feed_index,
+            feed_series=feed_series,
         )
 
     def _advance_prefill_chunk(
@@ -2752,6 +3112,11 @@ class EngineCore:
         feed_index = {
             s.request_id: (n_steps - 1) * B + i for i, s in enumerate(ready)
         }
+        # Full emission series (stream order, one token per inner step):
+        # lane i's tokens sit at flat i, B + i, ..., (n_steps-1)*B + i.
+        feed_series = {
+            s.request_id: (i, B, n_steps) for i, s in enumerate(ready)
+        }
 
         # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
@@ -2821,6 +3186,7 @@ class EngineCore:
         return _PlannedStep(
             core=self, commit_fn=commit, adv=adv,
             feed_tokens=pend.toks, feed_index=feed_index,
+            feed_series=feed_series,
         )
 
     # -- speculative decoding (draft + batched ragged verify) ---------------
@@ -2982,6 +3348,9 @@ class EngineCore:
             if deterministic
             else {}
         )
+        feed_series = {
+            rid: (i, 0, 1) for rid, i in feed_index.items()
+        }
 
         # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
@@ -3016,7 +3385,7 @@ class EngineCore:
         return _PlannedStep(
             core=self, commit_fn=commit, adv=adv,
             feed_tokens=pend.toks, feed_index=feed_index,
-            deterministic=deterministic,
+            deterministic=deterministic, feed_series=feed_series,
         )
 
     def _plan_mixed(self, prefills: list[Sequence]) -> _PlannedStep | None:
@@ -3138,17 +3507,20 @@ class EngineCore:
         deterministic = n_spec_rows == 0
         adv: dict[str, tuple[int, int, int]] = {}
         feed_index: dict[str, int] = {}
+        feed_series: dict[str, tuple[int, int, int]] = {}
         for i, ((seq, toks_list, p0, _kv), kind) in enumerate(zip(rows, kinds)):
             if kind in ("d", "v"):
                 adv[seq.request_id] = (0, 1, 1)
                 if deterministic:
                     feed_index[seq.request_id] = i  # R == 1: column 0
+                    feed_series[seq.request_id] = (i, 0, 1)
             else:
                 chunk = len(toks_list)
                 done = p0 + chunk >= seq.prompt_len
                 adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
                 if done and deterministic:
                     feed_index[seq.request_id] = i
+                    feed_series[seq.request_id] = (i, 0, 1)
 
         # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
@@ -3237,7 +3609,7 @@ class EngineCore:
         return _PlannedStep(
             core=self, commit_fn=commit, adv=adv,
             feed_tokens=pend.toks, feed_index=feed_index,
-            deterministic=deterministic,
+            deterministic=deterministic, feed_series=feed_series,
         )
 
     def _plan_fused(
@@ -3314,30 +3686,49 @@ class EngineCore:
         drafts: list[list[int]] = []
         feed_rows: list[int | None] = []
         cont: list[bool] = []
+        device: list[bool] = []
         total = 0
         # The one-block draft reserve exists so drafting can never starve
         # prefill admission (_plan_mixed's invariant); with no prefill
         # rows there is nothing to starve, and the legacy verify path
         # drafted against the full budget — keep that headroom.
         spec_budget = budget - bs if prefills else budget
+        # On-device drafting (ISSUE 18) compounds accepted depth: up to
+        # 1 + (n_steps - 1) * R tokens per dispatch per lane. Plan-time
+        # headroom reserves that worst case — blocks AND context room —
+        # or the lane degrades to the host-drafted verify row.
+        dd_room = 1 + (n_steps - 1) * self._spec_R
         for idx, seq in enumerate(ready):
             draft: list[int] = []
+            dev = False
             if seq.spec is not None:
-                lanes_after = len(ready) - idx - 1
-                draft = self._draft_for(
-                    seq, spec_budget - total - 1 - lanes_after,
-                    reserve=n_steps - 1,
-                )
-                if draft and not self._grow_blocks(seq, n_steps + len(draft)):
-                    draft = []  # block pressure: verify degrades to q_len=1
+                if (
+                    self._spec_device
+                    and seq.spec.device
+                    and self.engine.max_model_len - self._eff_processed(seq)
+                    >= dd_room
+                    and self._grow_blocks(seq, dd_room)
+                ):
+                    dev = True  # drafts on device; no host proposal
+                else:
+                    lanes_after = len(ready) - idx - 1
+                    draft = self._draft_for(
+                        seq, spec_budget - total - 1 - lanes_after,
+                        reserve=n_steps - 1,
+                    )
+                    if draft and not self._grow_blocks(
+                        seq, n_steps + len(draft)
+                    ):
+                        draft = []  # block pressure: verify degrades to q_len=1
             cursor = self._eff_processed(seq)
             src = self._feed_src(seq)
             row_toks = [0 if src is not None else seq.pending] + draft
             rows.append((seq, row_toks, cursor, cursor + len(row_toks)))
-            kinds.append("v" if seq.spec is not None else "d")
+            kinds.append("v" if seq.spec is not None and not dev else "d")
             drafts.append(draft)
             feed_rows.append(src)
             cont.append(True)
+            device.append(dev)
             total += len(row_toks)
         n_decode = len(rows)
         decode_row_tokens = total
@@ -3384,6 +3775,7 @@ class EngineCore:
             drafts.append([])
             feed_rows.append(None)
             cont.append(cont_ok)
+            device.append(False)
             total += chunk
         if not rows or not any(cont):
             return None  # nothing continues on device: plain step is exact
@@ -3394,44 +3786,55 @@ class EngineCore:
             for (_, tl, _, _), kind in zip(rows, kinds)
         ]
         S = self._decode_width(len(rows))
+        use_dd = any(device)
         pend = self._dispatch_fused(
-            rows, S, n_sample, feed_rows, kinds, drafts, cont, n_steps
+            rows, S, n_sample, feed_rows, kinds, drafts, cont, n_steps,
+            device=device,
         )
-        R = self._spec_R if any(n > 1 for n in n_sample) else 1
-        deterministic = n_spec_rows == 0
+        R = self._spec_R if use_dd or any(n > 1 for n in n_sample) else 1
+        deterministic = n_spec_rows == 0 and not use_dd
         adv: dict[str, tuple[int, int, int]] = {}
         feed_index: dict[str, int] = {}
+        feed_series: dict[str, tuple[int, int, int]] = {}
         last_flat = (n_steps - 1) * S * R
         for i, ((seq, toks_list, p0, _kv), kind) in enumerate(zip(rows, kinds)):
             if kind in ("d", "v"):
-                if drafts[i]:
-                    # Data-dependent advance (live draft): the async loop
-                    # commits before planning over it; the overlay only
-                    # needs the guaranteed lower bound.
+                if drafts[i] or device[i]:
+                    # Data-dependent advance (live draft — host or
+                    # device): the async loop commits before planning
+                    # over it; the overlay only needs the guaranteed
+                    # lower bound (iteration 0 always emits one token).
                     adv[seq.request_id] = (0, 1, 1)
                 else:
                     adv[seq.request_id] = (0, n_steps, n_steps)
                     if deterministic:
                         feed_index[seq.request_id] = last_flat + i * R
+                        feed_series[seq.request_id] = (i * R, S * R, n_steps)
             else:
                 chunk = len(toks_list)
                 if cont[i]:
                     adv[seq.request_id] = (chunk, chunk + n_steps - 1, n_steps)
                     if deterministic:
                         feed_index[seq.request_id] = last_flat + i * R
+                        feed_series[seq.request_id] = (i * R, S * R, n_steps)
                 else:
                     done = p0 + chunk >= seq.prompt_len
                     adv[seq.request_id] = (chunk, chunk, 1 if done else 0)
                     if done and deterministic:
                         feed_index[seq.request_id] = i * R
+                        feed_series[seq.request_id] = (i * R, 0, 1)
 
         # dynalint: holds-lock(_step_lock) — commits run inside the step
         def commit() -> list[tuple[Sequence, LLMEngineOutput]]:
             outputs: list[tuple[Sequence, LLMEngineOutput]] = []
             toks3, lps3 = pend.land()  # [n_steps, S, R]
+            # Device-draft round accounting ([3, n_steps, S]: emitted /
+            # drafted / accepted per round) rides its own landing copy.
+            aux3 = pend.land_aux() if use_dd else None
             now = time.time()
             drafted_total = accepted_total = spec_emitted = 0
             emitted_total = 0
+            dd_rounds = dd_hits = 0
             live = {id(s) for s in self.running}
             # Iteration-0 single-slot views: the k=1 commit shape the
             # prefill-chunk bookkeeping expects.
@@ -3480,6 +3883,64 @@ class EngineCore:
                         (seq, self._emit_chunk(seq, emitted, lp_entries, finish))
                     )
                     emitted_total += len(emitted)
+                    if finish is not None:
+                        seq.finish = finish
+                        self._finish(seq)
+                    else:
+                        seq.pending = emitted[-1]
+                    continue
+                if device[i]:
+                    # On-device-drafted lane (ISSUE 18): the emission is
+                    # data-dependent per ROUND, so the host replays the
+                    # device's own per-round accounting — emitted counts
+                    # say which [round, slot] cells carry real tokens;
+                    # the stop scan then truncates exactly like every
+                    # other path (host authority; the device only ever
+                    # under-stops, so E always covers the stop point).
+                    em = aux3[0, :, i]
+                    dl = aux3[1, :, i]
+                    ac = aux3[2, :, i]
+                    E: list[int] = []
+                    lp_at: list[tuple[int, int]] = []
+                    for r in range(n_steps):
+                        e_r = int(em[r])
+                        for j in range(e_r):
+                            E.append(int(toks3[r, i, j]))
+                            lp_at.append((r, j))
+                        if r:
+                            if e_r:
+                                dd_rounds += 1
+                            if int(dl[r]):
+                                dd_hits += 1
+                                self.spec_stats.observe_row(
+                                    int(dl[r]), int(ac[r])
+                                )
+                                drafted_total += int(dl[r])
+                                accepted_total += int(ac[r])
+                    k_take, finish = self._scan_stop(seq, np.asarray(E))
+                    written = [seq.pending] + E[: k_take - 1]
+                    completed = seq.hashed.extend(written)
+                    self._commit_completed(seq, completed)
+                    seq.processed += k_take
+                    seq.generated += k_take
+                    emitted = E[:k_take]
+                    lp_entries = None
+                    if lps3 is not None and seq.logprobs is not None:
+                        lp_entries = [
+                            _lp_entry(
+                                emitted[j],
+                                lps3[0][lp_at[j][0], i, lp_at[j][1]],
+                                lps3[1][lp_at[j][0], i, lp_at[j][1]],
+                                lps3[2][lp_at[j][0], i, lp_at[j][1]],
+                                seq.logprobs,
+                            )
+                            for j in range(k_take)
+                        ]
+                    outputs.append(
+                        (seq, self._emit_chunk(seq, emitted, lp_entries, finish))
+                    )
+                    emitted_total += len(emitted)
+                    spec_emitted += len(emitted)
                     if finish is not None:
                         seq.finish = finish
                         self._finish(seq)
@@ -3537,12 +3998,15 @@ class EngineCore:
                     seq.pending = emitted[-1]
 
             t_done = time.time()
-            if n_spec_rows:
+            if n_spec_rows or use_dd:
                 self.spec_stats.verify_steps += 1
+                self.spec_stats.device_rounds += dd_rounds
+                self.spec_stats.device_hits += dd_hits
                 self._tracer.record(
                     "spec_verify", t_drafted, t_done,
                     attrs={
-                        "seqs": n_spec_rows, "drafted": drafted_total,
+                        "seqs": n_spec_rows + sum(device),
+                        "drafted": drafted_total,
                         "accepted": accepted_total, "tokens": spec_emitted,
                     },
                     stat=True,
@@ -3581,10 +4045,12 @@ class EngineCore:
                 attrs={
                     "seqs": len(rows), "inner_steps": n_steps,
                     "tokens": emitted_total,
+                    "draft_rounds": dd_rounds,
                     "fused_shapes": {
-                        "decode": kinds.count("d"),
+                        "decode": kinds.count("d") - sum(device),
                         "chunk": kinds.count("p"),
                         "verify": kinds.count("v"),
+                        "device": sum(device),
                     },
                 },
                 stat=True,
@@ -3594,7 +4060,7 @@ class EngineCore:
         return _PlannedStep(
             core=self, commit_fn=commit, adv=adv,
             feed_tokens=pend.toks, feed_index=feed_index,
-            deterministic=deterministic,
+            deterministic=deterministic, feed_series=feed_series,
         )
 
     def _scan_stop(self, seq: Sequence, toks: np.ndarray) -> tuple[int, str | None]:

@@ -848,3 +848,53 @@ def decode_tokens(
         params, cache, tokens, positions, write_pages, write_offs,
         kv_lens, block_tables, cu, num_seqs, rows, cfg, engine, mesh,
     )
+
+
+def verify_tokens(
+    params: Params,
+    cache: jax.Array,
+    tokens: jax.Array,        # [S, R] i32 — pending + draft per lane, junk-padded
+    block_tables: jax.Array,  # [S, pages_per_seq] i32
+    positions: jax.Array,     # [S] i32 — position of slot 0
+    draft_len: jax.Array,     # [S] i32 — live draft slots (0 = plain decode row)
+    active: jax.Array,        # [S] bool
+    cfg: ModelConfig,
+    engine: EngineConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Verify-shaped step: every lane is a fixed-width R = spec_k + 1
+    ragged row (pending token + up to R-1 drafted tokens). The scanned
+    device-draft body calls this between inner iterations — the width is
+    static so the whole draft→verify→accept loop compiles once per
+    (S, R) shape. Returns ([S*R, vocab] logits, cache); slot logits for
+    lane s live at rows s*R .. s*R+R-1.
+
+    Slot j writes K/V at position ``positions + j`` only while live
+    (``active`` and ``j <= draft_len``); dead slots write the garbage
+    page, so a rejected draft's K/V simply never lands past the live
+    prefix and the lane's cursor algebra (num_computed_tokens rollback)
+    needs no device-side undo. The rows are width-R even when the draft
+    is shorter, so kv_lens is ``positions + R`` — the ragged attention
+    places query i of a q_len-R row at ``kv_lens - R + i``, which puts
+    every slot (live or dead) at its true position ``positions + j``.
+    A dead slot attends positions only dead slots wrote (garbage /
+    stale), producing junk logits that ``resolve_verify`` can never
+    select (``accepted <= draft_len``); live slots attend exactly the
+    one-token-at-a-time decode history."""
+    S, R = tokens.shape
+    bs = engine.block_size
+    j = jnp.arange(R, dtype=jnp.int32)[None, :]
+    pos = positions[:, None] + j                              # [S, R]
+    live = active[:, None] & (j <= draft_len[:, None])
+    page = jnp.take_along_axis(block_tables, pos // bs, axis=1)
+    write_pages = jnp.where(live, page, engine.garbage_block).reshape(-1)
+    write_offs = (pos % bs).reshape(-1)
+    kv_lens = jnp.where(active, positions + R, R).astype(jnp.int32)
+    cu = R * jnp.arange(S + 1, dtype=jnp.int32)
+    num_seqs = jnp.array([S], jnp.int32)
+    rows = jnp.arange(S * R, dtype=jnp.int32)
+    return forward_tokens(
+        params, cache, tokens.reshape(-1), pos.reshape(-1), write_pages,
+        write_offs, kv_lens, block_tables, cu, num_seqs, rows, cfg,
+        engine, mesh,
+    )

@@ -133,20 +133,114 @@ def stop_flags_prefix(
     watch: jax.Array,      # [S, W] int32 — per-lane stop ids, -1 padded
     budgets: jax.Array,    # [S] int32 — remaining max-tokens budget
     min_left: jax.Array,   # [S] int32 — tokens until min_tokens passes
-) -> jax.Array:            # [S] bool — True where the lane stops in iter 0
-    """Stop detection over a fused megastep's FIRST iteration, whose
-    emission count is data-dependent (a verify row emits accepted + 1
-    tokens): slot j — generation j+1 of this dispatch — stops the lane
-    if it is actually emitted (j <= accepted) and samples a watched id
-    past the min-tokens floor, or lands on the budget edge. Same
-    under-stop-never-over-stop contract as :func:`stop_flags`; the host
-    stop-scan stays the authority."""
+    gen_base: jax.Array | None = None,  # [S] int32 — tokens already
+                           # emitted by this dispatch before these slots
+) -> jax.Array:            # [S] bool — True where the lane stops HERE
+    """Stop detection over a fused iteration whose emission count is
+    data-dependent (a verify row emits accepted + 1 tokens): slot j —
+    dispatch-generation ``gen_base + j + 1`` — stops the lane if it is
+    actually emitted (j <= accepted) and samples a watched id past the
+    min-tokens floor, or lands on the budget edge. ``gen_base`` defaults
+    to 0 (the megastep's first iteration); device-draft rounds pass the
+    running per-lane emission count so budget/min-tokens arithmetic
+    stays exact across multiple verify-shaped rounds in one dispatch.
+    Same under-stop-never-over-stop contract as :func:`stop_flags`; the
+    host stop-scan stays the authority."""
     R = sampled.shape[1]
     gen = jnp.arange(1, R + 1, dtype=jnp.int32)[None, :]
-    emitted = (gen - 1) <= accepted[:, None]
+    if gen_base is not None:
+        gen = gen + gen_base[:, None]
+    emitted = (jnp.arange(R, dtype=jnp.int32)[None, :]) <= accepted[:, None]
     watch_hit = (sampled[:, :, None] == watch[:, None, :]).any(axis=2)
     hit = (watch_hit & (gen >= min_left[:, None])) | (gen >= budgets[:, None])
     return (hit & emitted).any(axis=1)
+
+
+def ring_append(
+    hist: jax.Array,      # [S, H] int32 — right-aligned history ring, -1 padded
+    hist_len: jax.Array,  # [S] int32 — valid tokens (right-aligned)
+    emitted: jax.Array,   # [S, E] int32 — row-packed fresh tokens
+    count: jax.Array,     # [S] int32 in [0, E] — valid prefix of `emitted`
+) -> tuple[jax.Array, jax.Array]:  # (hist' [S, H], hist_len' [S])
+    """Shift ``count`` fresh tokens into each lane's history ring. The
+    ring is right-aligned (newest token at column H-1), so the append is
+    a per-lane gather over ``concat([hist, emitted])`` at offset
+    ``count`` — count == 0 is the identity, which is how dead lanes and
+    non-drafting rows ride the same program. Slots of ``emitted`` past
+    ``count`` are never gathered (the read window ends at column
+    H - 1 + count), so junk samples from rejected draft slots cannot
+    leak into the history."""
+    H = hist.shape[1]
+    buf = jnp.concatenate([hist, emitted.astype(hist.dtype)], axis=1)
+    idx = jnp.arange(H, dtype=jnp.int32)[None, :] + count[:, None]
+    return (
+        jnp.take_along_axis(buf, idx, axis=1),
+        jnp.minimum(hist_len + count, H),
+    )
+
+
+def device_ngram_draft(
+    hist: jax.Array,       # [S, H] int32 — right-aligned history ring, -1 padded
+    hist_len: jax.Array,   # [S] int32 — valid tokens (min(true_len, H))
+    window: jax.Array,     # [S] int32 — per-lane lookback bound (<= H)
+    ngram_min: jax.Array,  # [S] int32
+    ngram_max: jax.Array,  # [S] int32 (<= ngram_max_static)
+    k_cap: jax.Array,      # [S] int32 — draft budget this round (<= slots;
+                           # <= 0 disables the lane)
+    *,
+    ngram_max_static: int,  # engine-wide suffix-length bound (unrolled loop)
+    slots: int,             # draft slot width of the verify row (spec_R - 1)
+) -> tuple[jax.Array, jax.Array]:  # (draft [S, slots] -1 padded, draft_len [S])
+    """Kernel-free on-device prompt-lookup drafter — the scanned-body
+    replay of :func:`dynamo_tpu.spec.ngram.propose_ngram`.
+
+    The ring holds each lane's last H = engine_window + engine_ngram_max
+    tokens right-aligned, which is exactly the tail the host drafter is
+    handed (`_draft_for` truncates to window + ngram_max), so ring
+    coordinates and host-context coordinates describe the same candidate
+    set. The match replays the host semantics bit-for-bit:
+
+    - longest suffix first: the n loop is unrolled from
+      ``ngram_max_static`` down to 1, lanes select via
+      ``ngram_min <= n <= min(ngram_max, hist_len - 1)`` and the FIRST
+      (largest) matching n wins;
+    - most recent occurrence: among candidate starts the LARGEST ring
+      index wins (``max`` over the match mask);
+    - window bound: candidate starts below ``H - min(hist_len, window)``
+      are masked (the ring analogue of ``lo = max(0, L - window)``);
+    - the follow-on run is truncated at the ring end (== sequence end)
+      and at ``k_cap``, matching the host's ``context[s+n : s+n+k]``.
+
+    A lane with no match (or ``k_cap <= 0``, or too little history)
+    drafts nothing — draft_len 0, slots -1 — which downstream resolves
+    as a plain decode row. Pure jnp slice-compares over [S, H]: no
+    kernel, O(S * H * ngram_max_static) VPU work per round."""
+    S, H = hist.shape
+    r_lo = H - jnp.minimum(hist_len, window)  # [S] first in-window start
+    found = jnp.zeros(S, bool)
+    best_r = jnp.zeros(S, jnp.int32)
+    best_n = jnp.zeros(S, jnp.int32)
+    for n in range(ngram_max_static, 0, -1):
+        if n >= H:
+            continue
+        width = H - n  # candidate starts r in [0, H-n-1]
+        m = jnp.ones((S, width), bool)
+        for t in range(n):
+            m = m & (hist[:, t:width + t] == hist[:, H - n + t][:, None])
+        cand = jnp.arange(width, dtype=jnp.int32)[None, :]
+        rn = jnp.max(jnp.where(m & (cand >= r_lo[:, None]), cand, -1), axis=1)
+        sel = (ngram_min <= n) & (n <= jnp.minimum(ngram_max, hist_len - 1))
+        upd = (~found) & sel & (rn >= 0)
+        best_r = jnp.where(upd, rn, best_r)
+        best_n = jnp.where(upd, jnp.int32(n), best_n)
+        found = found | upd
+    avail = H - (best_r + best_n)  # follow-run room to the ring end (>= 1)
+    d = jnp.maximum(jnp.where(found, jnp.minimum(k_cap, avail), 0), 0)
+    j = jnp.arange(slots, dtype=jnp.int32)[None, :]
+    src = jnp.clip(best_r[:, None] + best_n[:, None] + j, 0, H - 1)
+    draft = jnp.take_along_axis(hist, src, axis=1)
+    draft = jnp.where(j < d[:, None], draft, jnp.int32(-1))
+    return draft, d
 
 
 def token_logprobs(

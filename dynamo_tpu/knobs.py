@@ -118,6 +118,10 @@ KNOBS: dict[str, Knob] = _freeze(
     Knob("DYN_DISAGG_CHUNK_US_PER_BLOCK", 20.0, "float", "disagg",
          "mocker virtual-clock price per handoff block (chunk-pipelined "
          "transfer cost in the deterministic fleet A/B)"),
+    # -- speculative decoding -------------------------------------------
+    Knob("DYN_SPEC_DRAFT_ROUND_US", 10.0, "float", "spec",
+         "mocker virtual-clock price per on-device draft round (ring "
+         "match + gather between megastep inner iterations)"),
     # -- TPU kernels ----------------------------------------------------
     Knob("DYNAMO_TPU_PAGED_ATTN", "xla", "str", "kernels",
          "paged-attention backend: `xla` or `pallas`"),

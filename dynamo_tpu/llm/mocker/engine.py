@@ -19,7 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
-from dynamo_tpu import tracing
+from dynamo_tpu import knobs, tracing
 from dynamo_tpu.engine.fair_queue import FairQueue
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.mocker.kv_manager import InsufficientBlocksError, MockKvManager
@@ -79,6 +79,17 @@ class MockEngineArgs:
     spec_decode: str = "off"
     spec_k: int = 4
     spec_acceptance_rate: float = 0.6
+    # On-device n-gram drafting (mirrors EngineConfig.spec_device_draft,
+    # ISSUE 18): with megastep_k >= 2, a device-drafting lane's inner
+    # iterations become draft->verify->accept ROUNDS riding the same
+    # dispatch — round 0 emits one token, every later round drafts up to
+    # spec_k fresh tokens from the (simulated) history ring and emits
+    # accepted + 1. Drafted tokens price like prefill tokens (each is an
+    # extra target forward in the verify-shaped row) and every round
+    # adds DYN_SPEC_DRAFT_ROUND_US of match/gather cost to the clock.
+    # Token VALUES are unchanged — the stream stays bit-identical to
+    # spec off; only the chunking and the virtual clock move.
+    spec_device_draft: bool = False
     # UNIVERSAL megastep (mirrors EngineConfig.megastep_k, ISSUE 12):
     # every iteration with decode work fuses k device steps under ONE
     # per-dispatch host overhead (base_iter_us) — decode lanes run up to
@@ -135,6 +146,9 @@ class _Seq:
     # Speculation draft length for this request (0 = off); resolved at
     # submit from the engine default + the request's spec_decode dict.
     spec_k: int = 0
+    # Drafts on device between megastep inner iterations (ISSUE 18);
+    # resolved like spec_k (engine flag AND the request's choice).
+    spec_device: bool = False
     # Tokens a previous attempt already streamed to the client
     # (migration replay): offsets the synthetic token function so a
     # replayed stream continues bit-identically where the dead worker
@@ -201,6 +215,7 @@ class MockTpuEngine:
         # + f32 scales ~0.516x at the nominal head_dim 128).
         self._kv_byte_ratio = kv_byte_ratio(self.args.kv_dtype)
         self._last_kv_blocks_read = 0
+        self._last_device_rounds = 0
         # Cluster-pool peer-pull accounting (kv_pool_* gauges; same
         # counter shape as the jax worker's PeerKvClient).
         from dynamo_tpu.llm.kv_pool import PeerPullStats
@@ -213,7 +228,9 @@ class MockTpuEngine:
         # loop-affine state directly — no thread hop needed.
         self.on_chunk_commit = None
         self._spec_default = (
-            SpecConfig(k=self.args.spec_k)
+            SpecConfig(
+                k=self.args.spec_k, device=self.args.spec_device_draft
+            )
             if self.args.spec_decode != "off"
             else None
         )
@@ -365,6 +382,7 @@ class MockTpuEngine:
             self._spec_default, pre.spec_decode, self.args.spec_k
         )
         seq.spec_k = spec.k if spec is not None else 0
+        seq.spec_device = spec.device if spec is not None else False
         seq.t_submit = time.time()
         self._waiting.append(seq)
         self._ensure_loop()
@@ -574,7 +592,8 @@ class MockTpuEngine:
             self._loop_task = asyncio.create_task(self._sim_loop())
 
     def iter_time_s(
-        self, prefill_tokens: int, decode_seqs: int, kv_blocks_read: int = 0
+        self, prefill_tokens: int, decode_seqs: int, kv_blocks_read: int = 0,
+        device_rounds: int = 0,
     ) -> float:
         """Virtual-clock cost of one iteration under the overlap model:
         with async execution, the fixed host overhead runs one step ahead
@@ -597,6 +616,10 @@ class MockTpuEngine:
             + kv_blocks_read
             * self.args.kv_read_us_per_block
             * self._kv_byte_ratio
+            # On-device draft rounds: ring match + gather between inner
+            # iterations (ISSUE 18) — device-side work, so it hides
+            # nothing and overlaps with nothing extra.
+            + device_rounds * knobs.get_float("DYN_SPEC_DRAFT_ROUND_US")
         ) / 1e6
         if self.args.async_exec:
             total = max(host_s, device_s)
@@ -640,7 +663,8 @@ class MockTpuEngine:
             self._iterations += 1
             await asyncio.sleep(
                 self.iter_time_s(
-                    prefill_tokens, decode_seqs, self._last_kv_blocks_read
+                    prefill_tokens, decode_seqs, self._last_kv_blocks_read,
+                    self._last_device_rounds,
                 )
             )
 
@@ -772,6 +796,9 @@ class MockTpuEngine:
                 k_mega = min(self.args.megastep_k, max(remaining))
         mega_lanes = 0
         mega_verify_lanes = 0
+        mega_device_lanes = 0
+        device_draft_tokens = 0  # priced like prefill tokens, not budgeted
+        device_rounds_step = 0   # DYN_SPEC_DRAFT_ROUND_US each on the clock
         chunk_rows = 0
         tokens_emitted = 0
         prefill_tokens = 0
@@ -854,10 +881,120 @@ class MockTpuEngine:
                 -(-(seq.prefilled + seq.generated) // self.args.block_size)
             )
             kv_blocks_read += lane_blocks
+            dev_lane = bool(seq.spec_k and seq.spec_device and inner > 1)
             if inner > 1:
                 mega_lanes += 1
-                if seq.spec_k:
+                if dev_lane:
+                    mega_device_lanes += 1
+                elif seq.spec_k:
                     mega_verify_lanes += 1
+            if dev_lane:
+                # ON-DEVICE DRAFTING (ISSUE 18): round 0 emits one token;
+                # each later inner iteration drafts up to spec_k fresh
+                # tokens from the history ring (clamped by the remaining
+                # generation budget, like the device kc clamp) and emits
+                # accepted + 1 — accepted depth compounds INSIDE the one
+                # priced dispatch. Drafted tokens price like prefill
+                # tokens but do NOT consume max_num_batched_tokens (the
+                # ring lives on device; the plan charges one base token,
+                # like the real engine).
+                emitted = []
+                finish = None
+                stalled = False
+                lane_rounds = lane_hits = 0
+                lane_drafted = lane_accepted = 0
+                for r in range(inner):
+                    if r == 0:
+                        n_emit = 1
+                    else:
+                        d_j = min(
+                            seq.spec_k,
+                            max(0, seq.max_tokens - seq.generated - 1),
+                        )
+                        a_j = 0
+                        for _ in range(d_j):
+                            if (
+                                self._spec_rng.random()
+                                >= self.args.spec_acceptance_rate
+                            ):
+                                break
+                            a_j += 1
+                        n_emit = a_j + 1
+                        lane_rounds += 1
+                        if d_j:
+                            lane_hits += 1
+                            lane_drafted += d_j
+                            lane_accepted += a_j
+                            self.spec_stats.observe_row(d_j, a_j)
+                    for _ in range(n_emit):
+                        token = 97 + ((seq.replay_base + seq.generated) % 26)
+                        if len(self.seq_tail(seq)) == 0:
+                            try:
+                                self.kv.allocate_partial(1)
+                                seq.partials_held += 1
+                            except InsufficientBlocksError:
+                                stalled = not emitted
+                                break
+                        completed = seq.seq.append(token)
+                        if completed is not None:
+                            self.kv.commit_block(
+                                completed.block_hash, completed.parent_hash
+                            )
+                            seq.partials_held -= 1
+                            seq.pinned.append(completed.block_hash)
+                        seq.generated += 1
+                        emitted.append(token)
+                        finish = self._check_stop(seq, token)
+                        if finish is not None:
+                            break
+                    if stalled or finish is not None:
+                        break
+                if stalled:
+                    decode_seqs -= inner
+                    kv_blocks_read -= lane_blocks
+                    mega_lanes -= 1
+                    mega_device_lanes -= 1
+                    self.sched_stats["decode_stalls"] += 1
+                    continue
+                tokens_emitted += len(emitted)
+                lane_records.append(
+                    {
+                        "rid": seq.request_id, "kind": "device",
+                        "emitted": len(emitted), "generated": seq.generated,
+                        "inner": inner, "rounds": lane_rounds,
+                        "finish": finish or "",
+                    }
+                )
+                device_draft_tokens += lane_drafted
+                device_rounds_step += lane_rounds
+                self.spec_stats.device_rounds += lane_rounds
+                self.spec_stats.device_hits += lane_hits
+                spec_rows += 1
+                spec_drafted += lane_drafted
+                spec_accepted += lane_accepted
+                spec_emitted += len(emitted)
+                out = LLMEngineOutput(token_ids=emitted)
+                if seq.generated == len(emitted):
+                    out.meta = {
+                        "cached_tokens": (
+                            seq.cached_blocks * self.args.block_size
+                        ),
+                        "iteration": self._iterations,
+                    }
+                seq.t_last_token = time.time()
+                if finish is not None:
+                    out.finish_reason = finish
+                    out.prompt_tokens = len(seq.prompt)
+                    out.completion_tokens = seq.generated
+                    if seq.notify_chunks:
+                        out.kv_transfer_params = {
+                            "request_id": seq.request_id
+                        }
+                    seq.out.put_nowait(out.to_wire())
+                    finished.append(seq)
+                else:
+                    seq.out.put_nowait(out.to_wire())
+                continue
             drafted = min(
                 seq.spec_k, max(0, budget - prefill_tokens - spec_tokens)
             )
@@ -969,6 +1106,9 @@ class MockTpuEngine:
             if mega_lanes:
                 st["megastep_dispatches"] += 1
                 if chunk_rows or mega_verify_lanes:
+                    # (Pure device-draft dispatches stay plain fused
+                    # decode dispatches, like the real engine — the dd
+                    # lanes keep their decode row shape.)
                     # A fused MIXED dispatch (ISSUE 12): prefill chunks
                     # and/or verify rows rode the same priced megastep.
                     st["fused_mixed_dispatches"] += 1
@@ -981,10 +1121,15 @@ class MockTpuEngine:
                     attrs={
                         "seqs": mega_lanes, "inner_steps": k_mega,
                         "tokens": tokens_emitted,
+                        "draft_rounds": device_rounds_step,
                         "fused_shapes": {
-                            "decode": mega_lanes - mega_verify_lanes,
+                            "decode": (
+                                mega_lanes - mega_verify_lanes
+                                - mega_device_lanes
+                            ),
                             "chunk": chunk_rows,
                             "verify": mega_verify_lanes,
+                            "device": mega_device_lanes,
                         },
                     },
                     stat=True,
@@ -1001,6 +1146,7 @@ class MockTpuEngine:
             1 for s in self._running if not s.prefill_done and s.t_first_sched
         )
         self._last_kv_blocks_read = kv_blocks_read
+        self._last_device_rounds = device_rounds_step
         if self.flight.capacity and lane_records:
             # One flight-recorder record per iteration with work: step
             # shape + lane cursors (the chaos-kill artifact reconstructs
@@ -1017,6 +1163,9 @@ class MockTpuEngine:
                     "verify": sum(
                         1 for r in lane_records if r["kind"] == "verify"
                     ),
+                    "device": sum(
+                        1 for r in lane_records if r["kind"] == "device"
+                    ),
                 },
                 batched=batched,
                 emitted=tokens_emitted,
@@ -1025,7 +1174,10 @@ class MockTpuEngine:
                 shed_total=st["shed_total"],
                 deadline_expired_total=st["deadline_expired_total"],
             )
-        return prefill_tokens + spec_tokens, decode_seqs
+        # Device-drafted tokens ride the returned prefill-equivalent term
+        # (each is one extra target forward in the verify-shaped row) but
+        # never entered `batched` — they don't consume the host budget.
+        return prefill_tokens + spec_tokens + device_draft_tokens, decode_seqs
 
     def _check_stop(self, seq: _Seq, token: int) -> str | None:
         reason = seq.stop.check_token(token, seq.generated, self.eos_token_ids)

@@ -173,6 +173,22 @@ SPEC_GAUGES: dict[str, tuple[str, str]] = {
         "spec_decode_verify_steps_total",
         "Engine steps that carried at least one verify row",
     ),
+    # On-device drafting (ISSUE 18): draft->verify->accept rounds riding
+    # INSIDE megastep dispatches, and the amortization gauge they move.
+    "device_rounds": (
+        "spec_device_rounds_total",
+        "On-device draft rounds ridden inside megastep dispatches",
+    ),
+    "device_hits": (
+        "spec_device_draft_hits_total",
+        "On-device draft rounds whose history-ring match proposed at "
+        "least one token",
+    ),
+    "dispatches_per_accepted_token": (
+        "spec_decode_dispatches_per_accepted_token",
+        "Device dispatches per accepted draft token (lower is better; "
+        "on-device drafting compounds accepted depth per dispatch)",
+    ),
 }
 
 

@@ -36,6 +36,12 @@ class SpecConfig:
     ngram_min: int = 1
     ngram_max: int = 3
     window: int = 1024
+    #: Draft ON DEVICE between megastep inner iterations (ISSUE 18): the
+    #: lane carries a packed history ring through the scanned body and
+    #: redrafts after every accept/reject, so multiple draft rounds ride
+    #: one dispatch. Requires the engine flag — a request can only turn
+    #: it off (the ring buffers are sized at engine construction).
+    device: bool = False
 
     def __post_init__(self) -> None:
         if self.method not in SPEC_METHODS:
@@ -81,4 +87,8 @@ def resolve_spec_config(
         ngram_min=max(1, int(request.get("ngram_min", base.ngram_min))),
         ngram_max=min(int(request.get("ngram_max", base.ngram_max)), base.ngram_max),
         window=min(int(request.get("window", base.window)), base.window),
+        # Device drafting clamps like every other knob: the engine sized
+        # its ring buffers (window + ngram_max) at construction, so a
+        # request may opt out but never opt in past the engine baseline.
+        device=bool(request.get("device", base.device)) and base.device,
     )

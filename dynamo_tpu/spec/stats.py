@@ -18,6 +18,8 @@ class SpecStats:
     drafted_tokens: int = 0    # draft tokens proposed (and verified)
     accepted_tokens: int = 0   # draft tokens the target agreed with
     emitted_tokens: int = 0    # tokens emitted by verify rows (accept + 1)
+    device_rounds: int = 0     # on-device draft rounds ridden inside dispatches
+    device_hits: int = 0       # device rounds whose ring match proposed >= 1 token
 
     @property
     def wasted_tokens(self) -> int:
@@ -39,6 +41,17 @@ class SpecStats:
         dispatch-amortization factor speculation buys)."""
         return self.emitted_tokens / self.verify_rows if self.verify_rows else 0.0
 
+    @property
+    def dispatches_per_accepted_token(self) -> float:
+        """Device dispatches per accepted draft token — the amortization
+        gauge on-device drafting moves (lower is better; 0 when no draft
+        token has been accepted yet)."""
+        return (
+            self.verify_steps / self.accepted_tokens
+            if self.accepted_tokens
+            else 0.0
+        )
+
     def observe_row(self, drafted: int, accepted: int) -> None:
         """Account one verify row: ``drafted`` proposed, ``accepted``
         matched; the row emitted ``accepted + 1`` tokens (the bonus /
@@ -58,4 +71,7 @@ class SpecStats:
             "emitted_tokens": self.emitted_tokens,
             "acceptance_rate": self.acceptance_rate,
             "mean_accepted_len": self.mean_accepted_len,
+            "device_rounds": self.device_rounds,
+            "device_hits": self.device_hits,
+            "dispatches_per_accepted_token": self.dispatches_per_accepted_token,
         }

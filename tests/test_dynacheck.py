@@ -374,6 +374,46 @@ def test_cursor_model_catches_missing_rollback():
     assert res.violations, "missing rollback survived the cursor invariants"
 
 
+class _NoRingRollbackCursorModel(CursorModel):
+    """Deletes the history-ring rollback for ON-DEVICE drafting: a
+    device-draft commit lands the device's full optimistic emission even
+    when the host stop scan truncates it — the ring keeps the un-rolled
+    tail and the host believes the device's cursor."""
+
+    name = "cursor-no-ring-rollback"
+
+    def actions(self, state):
+        acts = [(n, fn) for n, fn in super().actions(state)]
+        if state.inflight is not None and state.inflight.kind == "device-draft":
+            acts.append(("commit_device_keep_ring", self._commit_keep_ring))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    @staticmethod
+    def _commit_keep_ring(state):
+        from dataclasses import replace
+        plan = state.inflight
+        if state.finished is not None:
+            return replace(state, inflight=None)
+        toks = plan.outputs  # NO truncation: the ring's tail all lands
+        n = len(toks)
+        return replace(
+            state, inflight=None,
+            processed=state.processed + n,
+            generated=state.generated + n,
+            emitted=state.emitted + toks,
+            pending=toks[-1],
+        )
+
+
+def test_cursor_model_catches_missing_ring_rollback():
+    m = _NoRingRollbackCursorModel()
+    m.max_depth = 6
+    res = explore(m)
+    assert res.violations, "missing ring rollback survived the cursor invariants"
+    assert any("diverged" in str(v) or "drift" in str(v) for v in res.violations)
+
+
 class _WedgingBreaker:
     """A breaker whose half-open probe never re-arms: a cancelled probe
     parks the address forever (the exact bug the stale-probe re-arm in

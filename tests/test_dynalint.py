@@ -61,10 +61,11 @@ PRAGMA_ALLOWLIST: dict[tuple[str, str, str], int] = {
     ("dynamo_tpu/engine/core.py", "holds-lock", "_step_lock"): 16,
     # Intentional syncs inside blocking-host-sync hot paths: the
     # double-buffered landing point (_PendingFetch.land — tokens +
-    # batched logprobs), np.asarray over host block-id lists (dispatch
+    # batched logprobs, and land_aux for the on-device draft round
+    # counters, ISSUE 18), np.asarray over host block-id lists (dispatch
     # assembly + ring prefill), and the host-tier page staging in
     # _stage_page (host buffer, not a device array).
-    ("dynamo_tpu/engine/core.py", "sync-ok", ""): 5,
+    ("dynamo_tpu/engine/core.py", "sync-ok", ""): 6,
     # Host-buffer asarray sites cleared by the dynacheck transitive-
     # blocking sweep: packed-page unpacking and pp microbatch planning
     # operate on host arrays only.

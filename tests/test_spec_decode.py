@@ -639,3 +639,129 @@ def test_mocker_per_request_spec_override():
 
     toks = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())
     assert toks == [97 + (i % 26) for i in range(12)]
+
+
+# -- on-device drafting (ISSUE 18) --------------------------------------------
+
+
+def test_device_matcher_replays_host_drafter_exactly():
+    """The replay-exactness contract: over randomized contexts, windows,
+    suffix bounds, vocab sizes and budgets, ``device_ngram_draft``
+    proposes exactly what ``propose_ngram`` would from the same tail —
+    or nothing. This is what makes the device drafter's hit-rate stats
+    mean the same thing the host drafter's would (bit-identity of the
+    STREAM never depended on it; the replay sampler guarantees that)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampler import device_ngram_draft
+
+    rng = np.random.RandomState(1234)
+    for _ in range(60):
+        window = int(rng.randint(4, 25))
+        nmax = int(rng.randint(1, 4))
+        vocab = int(rng.choice([3, 5, 50]))
+        k = int(rng.randint(1, 6))
+        H = window + nmax
+        L = int(rng.randint(0, H + 1))
+        ctx = [int(t) for t in rng.randint(0, vocab, size=L)]
+        want = propose_ngram(ctx, k, ngram_max=nmax, window=window)
+        hist = np.full((1, H), -1, np.int32)
+        if L:
+            hist[0, H - L:] = ctx
+        draft, dlen = device_ngram_draft(
+            jnp.asarray(hist), jnp.asarray([L], jnp.int32),
+            jnp.asarray([window], jnp.int32),
+            jnp.asarray([1], jnp.int32), jnp.asarray([nmax], jnp.int32),
+            jnp.asarray([k], jnp.int32),
+            ngram_max_static=nmax, slots=k,
+        )
+        got = [int(t) for t in np.asarray(draft)[0][: int(dlen[0])]]
+        assert got == want, (ctx, window, nmax, k, got, want)
+
+
+def test_device_draft_parity_matrix():
+    """Bit-identity of the device-drafted stream vs host-drafted spec vs
+    speculation OFF, across scheduler shapes — greedy and seeded
+    temperature lanes, an EOS-able lane, waves / chunked+async / block
+    pressure (where the dd reservation can't be met and lanes degrade to
+    host-drafted verify rows). The drafter placement must never move the
+    stream; only the stats may differ."""
+    reqs = lambda: [  # noqa: E731
+        _req(REPEAT_PROMPT, "rep", max_tokens=20, ignore_eos=True),
+        _req(RANDOM_PROMPT, "rnd", max_tokens=12),
+        _req(REPEAT_PROMPT, "tmp", max_tokens=16, temp=0.9, seed=42,
+             ignore_eos=True),
+    ]
+    matrix = [
+        dict(megastep_k=4),
+        dict(megastep_k=4, scheduling="chunked", prefill_chunk=32,
+             async_exec=True),
+        dict(megastep_k=4, num_kv_blocks=28, max_model_len=64),
+    ]
+    for shape in matrix:
+        _, base, fb = _run_all(dict(shape), reqs())
+        _, host, fh = _run_all(
+            dict(shape, spec_decode="ngram", spec_k=4), reqs()
+        )
+        core, dev, fd = _run_all(
+            dict(shape, spec_decode="ngram", spec_k=4,
+                 spec_device_draft=True),
+            reqs(),
+        )
+        assert base == host == dev, shape
+        assert fb == fh == fd, shape
+        if "num_kv_blocks" not in shape:
+            st = core.spec_decode_stats()
+            assert st["device_rounds"] > 0, shape
+            assert st["device_hits"] > 0, shape
+
+
+def test_device_draft_amortizes_dispatches():
+    """The perf mechanism, pinned structurally: at equal spec_k the
+    device drafter runs multiple draft->verify->accept rounds per
+    dispatch, so dispatches-per-accepted-token drops vs host drafting."""
+    reqs = lambda: [  # noqa: E731
+        _req(REPEAT_PROMPT, "rep", max_tokens=24, ignore_eos=True),
+    ]
+    host, _, _ = _run_all(
+        dict(megastep_k=8, spec_decode="ngram", spec_k=4), reqs()
+    )
+    dev, _, _ = _run_all(
+        dict(megastep_k=8, spec_decode="ngram", spec_k=4,
+             spec_device_draft=True),
+        reqs(),
+    )
+    sh = host.spec_decode_stats()
+    sd = dev.spec_decode_stats()
+    assert sd["device_rounds"] > 0
+    assert (
+        sd["dispatches_per_accepted_token"]
+        < sh["dispatches_per_accepted_token"]
+    ), (sd, sh)
+
+
+def test_mocker_device_draft_parity_and_amortization():
+    """Mocker mirror: the device-drafted stream is bit-identical to the
+    host-drafted and spec-off streams, in fewer dispatches, with device
+    rounds priced on the virtual clock (DYN_SPEC_DRAFT_ROUND_US)."""
+    def run(spec_rate, device):
+        kw = dict(megastep_k=4)
+        if spec_rate is not None:
+            kw.update(spec_device_draft=device)
+        eng = _mock_engine(spec_rate=spec_rate, **kw)
+        seq = _mock_seq([1] * 8, "a", 30, 4,
+                        spec_k=4 if spec_rate is not None else 0)
+        if spec_rate is not None:
+            seq.spec_device = device
+        toks, iters = _drain_mock(eng, seq)
+        return eng, toks, iters
+
+    _, t_base, i_base = run(None, False)
+    _, t_host, i_host = run(0.9, False)
+    eng, t_dev, i_dev = run(0.9, True)
+    assert t_base == t_host == t_dev
+    assert i_dev <= i_host <= i_base
+    st = eng.spec_decode_stats()
+    assert st["device_rounds"] > 0
+    assert st["device_hits"] > 0
+    assert st["dispatches_per_accepted_token"] > 0

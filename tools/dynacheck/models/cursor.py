@@ -23,13 +23,26 @@ the explorer drives it through every interleaving of:
                      iteration, emitting (accepted + 1) + 1 tokens in
                      one commit (still non-deterministic: the advance is
                      data-dependent),
+- ``step_device_draft`` ON-DEVICE n-gram drafting (ISSUE 18): the lane
+                     drafts from a device-resident history ring BETWEEN
+                     the megastep's inner iterations, so every round is
+                     draft -> verify -> accept without leaving the
+                     dispatch. A hit round lands accepted + 1 tokens, a
+                     miss round degenerates to the plain scanned decode
+                     token; a host-only stop inside the emission must
+                     truncate at commit AND the next plan must draft
+                     from the post-commit truth (the host-side ring
+                     repack IS the rollback),
 - ``drain``          commit the in-flight step with no new plan,
 - ``cancel``         client cancel mid-flight (zombie-lane discard).
 
 Initial-state variants place a device-watched EOS and a host-only stop at
 different stream positions, plus a draft-acceptance pattern for verify —
 including drafts rejected INSIDE a fused iteration, with and without an
-EOS landing in the fused continuation.
+EOS landing in the fused continuation — plus device-draft round-outcome
+patterns: hits compounding across rounds of one dispatch, a rejected
+draft redrafted inside the same dispatch, and a host stop landing inside
+a device-drafted emission (the ring-rollback world).
 
 Invariant: the emitted stream is ALWAYS a prefix of the synchronous
 reference stream, the cursor always equals prompt + written tokens, and
@@ -56,10 +69,14 @@ HOST_STOP = 5
 class _World:
     """Token oracle parameters: where the device-watched EOS and the
     host-only stop land in the generated stream (1-based generation
-    index), and which drafted positions a verify step gets right."""
+    index), which drafted positions a verify step gets right, and the
+    per-round outcomes of on-device ring drafting ("hit" = the ring
+    match replays the target, "miss" = no match or rejected draft —
+    both degenerate to the plain scanned decode token)."""
     eos_at: int | None
     host_at: int | None
     draft_hits: tuple[bool, ...] = (True, False)
+    dd_pattern: tuple[str, ...] = ()
 
     def token(self, n: int) -> int:
         # n = generation index of the token being sampled (1-based past
@@ -94,6 +111,7 @@ class _State:
     finished: str | None = None    # "eos" | "host" | "length" | "cancel"
     inflight: _Plan | None = None
     verify_round: int = 0          # which draft_hits entry the next verify uses
+    dd_round: int = 0              # which dd_pattern entry the next device round uses
 
     # Effective (overlay) cursors — what plan-time reads see.
     @property
@@ -187,6 +205,20 @@ class CursorModel(Model):
                                        draft_hits=(False,))),
             ("reject-then-host-stop", _World(eos_at=None, host_at=2,
                                              draft_hits=(False, True))),
+            # ISSUE 18 worlds: on-device ring drafting. No host verify
+            # rows (draft_hits=()) — the dd lane is its own drafter.
+            ("device-draft-extend", _World(eos_at=None, host_at=None,
+                                           draft_hits=(),
+                                           dd_pattern=("hit", "hit"))),
+            ("device-reject-then-redraft", _World(eos_at=None, host_at=None,
+                                                  draft_hits=(),
+                                                  dd_pattern=("miss", "hit"))),
+            ("device-ring-rollback-after-host-stop",
+             _World(eos_at=None, host_at=2, draft_hits=(),
+                    dd_pattern=("hit", "hit"))),
+            ("device-draft-into-eos", _World(eos_at=2, host_at=None,
+                                             draft_hits=(),
+                                             dd_pattern=("hit",))),
         ]
         for label, w in worlds:
             yield f"init:{label}", _initial(w)
@@ -207,6 +239,8 @@ class CursorModel(Model):
             if state.verify_round < len(state.world.draft_hits):
                 acts.append(("step_verify", self._step_verify))
                 acts.append(("step_fused_verify", self._step_fused_verify))
+            if state.dd_round < len(state.world.dd_pattern):
+                acts.append(("step_device_draft", self._step_device_draft))
         if state.inflight is not None:
             acts.append(("drain", lambda s: _commit(s)))
             acts.append(("cancel", self._cancel))
@@ -293,6 +327,41 @@ class CursorModel(Model):
             verify_round=state.verify_round + 1,
         )
 
+    def _step_device_draft(self, state: _State) -> _State:
+        """ON-DEVICE n-gram drafting (ISSUE 18): one dispatch runs inner
+        iteration 0 (the plain decode row, one token) then up to two
+        draft->verify->accept rounds drafted from the device-resident
+        history ring BETWEEN inner iterations. A "hit" round's ring
+        match replays the target's choice, so the round lands the
+        accepted draft plus the bonus choice (2 tokens); a "miss" round
+        (no ring match, or a rejected draft whose K/V write sits past
+        the cursor) degenerates to the plain scanned decode token (1).
+        The whole emission is a chain over the target's own
+        counter-keyed choices — bit-identity holds regardless of draft
+        quality — so the commit is exactly the megastep stop-scan. A
+        host-only stop inside the emission truncates it, and because the
+        next plan's outputs are computed from the POST-COMMIT cursor,
+        the model encodes the ring-rollback contract: after a host
+        truncation the ring is repacked from committed truth, never from
+        the device's optimistic tail. Data-dependent advance -> the
+        plan is non-deterministic and bars the next plan (the barrier).
+        """
+        remaining = len(state.world.dd_pattern) - state.dd_round
+        rounds = state.world.dd_pattern[
+            state.dd_round: state.dd_round + min(2, remaining)]
+        gen0 = state.eff_generated
+        n_out = 1 + sum(2 if r == "hit" else 1 for r in rounds)
+        outputs = _device_outputs(state.world, gen0, n_out)
+        new_plan = _Plan(
+            kind="device-draft", n_steps=1 + len(rounds), outputs=outputs,
+            adv_proc=1, adv_gen=1, deterministic=False,
+        )
+        committed = _commit(state)
+        return replace(
+            committed, inflight=new_plan,
+            dd_round=state.dd_round + len(rounds),
+        )
+
     @staticmethod
     def _cancel(state: _State) -> _State:
         if state.finished is not None:
@@ -344,7 +413,7 @@ class CursorModel(Model):
             state.world,
             state.processed, state.generated, state.pending,
             state.emitted, state.finished, state.inflight,
-            state.verify_round,
+            state.verify_round, state.dd_round,
         )
 
 

@@ -11,6 +11,15 @@ byte for byte. The worker's /metrics must then show
 ``spec_decode_acceptance_rate`` > 0 and ``spec_draft``/``spec_verify``
 spans in the trace collector.
 
+Phase 3 (ISSUE 18) drives ON-DEVICE drafting through the same real
+frontend: a ``--spec-device-draft`` worker under the universal megastep
+vs a host-drafting twin at equal spec_k, same greedy request to each —
+the streams must match byte for byte, and the device worker's /metrics
+must show ``spec_device_rounds_total`` > 0 (at least one dispatch
+actually ran multiple draft→verify→accept rounds inside the scan; a
+drafter that silently degrades to host rounds passes parity but fails
+this gauge).
+
 CI usage (`.github/workflows/ci.yml` spec-smoke step) and local:
 
     python tools/spec_smoke.py
@@ -41,6 +50,121 @@ async def stream_text(session, url: str, body: dict) -> str:
             for choice in chunk.get("choices", []):
                 parts.append((choice.get("delta") or {}).get("content") or "")
     return "".join(parts)
+
+
+async def _stack(engine_args):
+    """One full store + mocker-worker + frontend stack; returns the
+    chat-completions URL, the worker's /metrics port, and a teardown."""
+    import asyncio as aio
+
+    import aiohttp
+
+    from dynamo_tpu.backends.mocker import run_mocker
+    from dynamo_tpu.frontend.main import run_frontend
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.status_server import SystemStatusServer
+    from dynamo_tpu.runtime.store import StoreServer
+
+    store = StoreServer()
+    await store.start()
+    worker_rt = await DistributedRuntime.create(store.address)
+    worker_rt.status = SystemStatusServer(host="127.0.0.1", port=0)
+    await worker_rt.status.start()
+    served = aio.Event()
+    worker = aio.create_task(
+        run_mocker(
+            worker_rt, model_name="mock", engine_args=engine_args,
+            served_event=served,
+        )
+    )
+    await aio.wait_for(served.wait(), 30)
+    front_rt = await DistributedRuntime.create(store.address)
+    ready = aio.Event()
+    services: list = []
+    frontend = aio.create_task(
+        run_frontend(
+            front_rt, http_host="127.0.0.1", http_port=0,
+            router_mode="kv", ready_event=ready, service_out=services,
+        )
+    )
+    await aio.wait_for(ready.wait(), 30)
+    base = f"http://127.0.0.1:{services[0].port}"
+    async with aiohttp.ClientSession() as s:
+        for _ in range(200):
+            async with s.get(f"{base}/v1/models") as r:
+                if (await r.json())["data"]:
+                    break
+            await aio.sleep(0.05)
+        else:
+            raise TimeoutError("model never appeared on frontend")
+
+    async def teardown() -> None:
+        for task in (worker, frontend):
+            task.cancel()
+        await worker_rt.status.stop()
+        for rt in (worker_rt, front_rt):
+            await rt.shutdown()
+        await store.stop()
+
+    return base, worker_rt.status.port, teardown
+
+
+async def run_device_phase() -> None:
+    """Phase 3: device-drafting worker vs host-drafting twin through the
+    real frontend — byte-identical greedy streams, and the device worker
+    proves >= 1 multi-round dispatch via spec_device_rounds_total."""
+    import aiohttp
+
+    from dynamo_tpu.llm.mocker import MockEngineArgs
+
+    def args(device: bool) -> MockEngineArgs:
+        return MockEngineArgs(
+            num_kv_blocks=8192, block_size=8, spec_decode="ngram",
+            spec_k=4, spec_acceptance_rate=0.7, speedup_ratio=50.0,
+            megastep_k=4, spec_device_draft=device,
+        )
+
+    body = {
+        "model": "mock",
+        "messages": [{"role": "user", "content": "speculate this"}],
+        "max_tokens": 48,
+        "temperature": 0.0,
+        "stream": True,
+    }
+    texts: dict[bool, str] = {}
+    rounds = 0.0
+    for device in (False, True):
+        base, metrics_port, teardown = await _stack(args(device))
+        async with aiohttp.ClientSession() as s:
+            texts[device] = await stream_text(
+                s, f"{base}/v1/chat/completions", dict(body)
+            )
+            async with s.get(
+                f"http://127.0.0.1:{metrics_port}/metrics"
+            ) as r:
+                metrics = await r.text()
+        if device:
+            rounds = next(
+                (
+                    float(line.rsplit(" ", 1)[1])
+                    for line in metrics.splitlines()
+                    if line.startswith("dynamo_spec_device_rounds_total{")
+                ),
+                0.0,
+            )
+        await teardown()
+    assert texts[True] and texts[True] == texts[False], (
+        f"device-draft stream diverged from host-draft twin:\n"
+        f"  host:   {texts[False]!r}\n  device: {texts[True]!r}"
+    )
+    assert rounds > 0, (
+        "spec_device_rounds_total stayed 0 — no dispatch ran an on-device "
+        "draft round (device drafting silently degraded to host rounds)"
+    )
+    print(
+        "spec-smoke phase 3 OK: device-draft stream byte-identical to "
+        f"host-draft twin; device_rounds={rounds:.0f}", flush=True,
+    )
 
 
 async def run() -> None:
@@ -154,6 +278,7 @@ async def run() -> None:
 
 def main() -> int:
     asyncio.run(run())
+    asyncio.run(run_device_phase())
     return 0
 
 
