@@ -263,8 +263,6 @@ def build_engine(
         # holds the bf16 footprint (the 8B-on-one-16GB-chip mode).
         from dynamo_tpu.engine.loader import load_hf_llama
 
-        if quant == "int8" and pp > 1:
-            raise ValueError("int8 under pipeline parallelism: not wired yet")
         model_cfg, loaded_params = load_hf_llama(model_path, tp=tp, quant=quant)
         quant = None  # handled by the loader; skip the random-init path
     else:
@@ -344,8 +342,6 @@ def build_engine(
             engine_cfg = dataclasses.replace(engine_cfg, decode_buckets=buckets)
     params = loaded_params
     if quant == "int8":
-        if pp_mesh is not None:
-            raise ValueError("int8 under pipeline parallelism: not wired yet")
         import jax
 
         from dynamo_tpu.engine.model import init_params_quantized

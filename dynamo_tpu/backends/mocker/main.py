@@ -776,6 +776,13 @@ def main() -> None:
                          "identical to k=1). Prefill chunks ride the same "
                          "priced dispatch and spec verify lanes resolve "
                          "accept/reject inside the fused iteration")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="simulated pipeline-parallel stages (mirrors the "
+                         "jax worker's --pp): decode dispatches price "
+                         "k*pp + pp-1 stage hops at DYN_PP_HOP_US on the "
+                         "virtual clock and report scheduler_pp_* gauges; "
+                         "token values never change (stream bit-identical "
+                         "to pp=1)")
     ap.add_argument("--kv-dtype", default="bf16", choices=["bf16", "int8"],
                     help="simulated KV cache dtype (mirrors the jax "
                          "worker's --kv-dtype): int8 halves the priced "
@@ -842,6 +849,7 @@ def main() -> None:
         spec_device_draft=args.spec_device_draft,
         async_exec=args.async_exec == "on",
         megastep_k=args.megastep_k,
+        pp=args.pp,
         kv_dtype=args.kv_dtype,
         kv_read_us_per_block=args.kv_read_us_per_block,
         kv_pull_us_per_block=args.kv_pull_us_per_block,

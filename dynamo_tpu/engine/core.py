@@ -732,6 +732,7 @@ def _pp_prefill_and_sample(
 def _pp_decode_chain(
     params, cache, tokens, block_tables, positions, active,
     seeds, counters, temperature, top_k, top_p,
+    watch, budgets, min_left,
     *, n_steps, need_mask, all_greedy=False, want_logprobs=False,
     cfg, engine, pp_mesh, n_micro,
 ):
@@ -744,10 +745,22 @@ def _pp_decode_chain(
     stage ``pp-1`` at round ``g + t*M + pp - 1`` and re-enters stage 0 at
     round ``g + (t+1)*M`` — legal exactly when ``M >= pp`` (enforced by
     EngineCore). Same output contract as :func:`_megastep_body`: returns
-    sampled ``[n_steps, B]`` (+ logprobs) and the cache, though the
-    wavefront keeps every lane live for the whole chain (no on-device
-    stop flags yet — the host stop-scan discards overshoot, exactly the
-    pre-megastep rollback).
+    sampled ``[n_steps, B]`` (+ logprobs) and the cache, with the same
+    on-device stop flags — a lane that samples a watched stop id (or
+    exhausts its budget) at its drain round goes dead, and its remaining
+    wavefront visits run masked no-ops (K/V writes routed to the garbage
+    block, output padded with its last live token). The wavefront makes
+    that legal: group ``g``'s step-``t`` drain (round ``g + t*M + pp-1``)
+    strictly precedes EVERY stage's processing of its step ``t+1`` (first
+    at round ``g + (t+1)*M``) whenever ``M >= pp``, so the updated alive
+    mask is consistently visible pipe-wide before the dead lane would
+    compute again. One deliberate divergence from ``_megastep_body``:
+    dead-lane positions keep advancing (``pos0 + t`` stays in-table —
+    _plan_decode pre-grows k tokens of block headroom per lane) because
+    freezing them would need a second carried cursor; the writes are
+    garbage-routed either way, so the emitted stream is identical. The
+    host stop-scan stays the AUTHORITY (host-only stops / truncated
+    watch lists roll back via the cursor, exactly as on one chip).
 
     No GPU schedule looks like this — it exists because under jit the
     whole chain is ONE XLA program and ppermute edges are ICI
@@ -769,10 +782,14 @@ def _pp_decode_chain(
     temp_g = temperature.reshape(M, Bm)
     k_g = top_k.reshape(M, Bm)
     p_g = top_p.reshape(M, Bm)
+    watch_g = watch.reshape(M, Bm, -1)
+    bud_g = budgets.reshape(M, Bm)
+    ml_g = min_left.reshape(M, Bm)
 
     R = n_steps * M + pp - 1
     buf0 = jnp.zeros((pp, Bm, cfg.hidden_size), cfg.jax_dtype)
     out0 = jnp.zeros((n_steps, M, Bm), jnp.int32)
+    alive0 = jnp.ones((M, Bm), bool)
     if want_logprobs:
         lp0 = (
             jnp.zeros((n_steps, M, Bm), jnp.float32),
@@ -783,9 +800,9 @@ def _pp_decode_chain(
         lp0 = None
 
     def body(carry, r):
-        store, buf, cache, out, lps = carry
+        store, buf, cache, alive, out, lps = carry
         buf, cache, logits = pp_decode_round(
-            params, cache, buf, r, store, tab_g, pos_g, act_g,
+            params, cache, buf, r, store, tab_g, pos_g, act_g & alive,
             cfg=cfg, engine=engine, mesh=pp_mesh, n_micro=M, n_steps=n_steps,
         )
         # Work item draining the last stage this round.
@@ -798,20 +815,28 @@ def _pp_decode_chain(
             logits, seeds_g[ge], cnt_g[ge] + te, temp_g[ge], k_g[ge], p_g[ge],
             need_mask=need_mask, all_greedy=all_greedy,
         )
-        new_tok = jnp.where(ev, nxt, store[ge])
+        # Dead lanes pad with their last live token (same pinnable value
+        # as _megastep_body — the host stop-scan resolves the repeated
+        # stop id to the same stop position).
+        live = act_g[ge] & alive[ge]
+        new_tok = jnp.where(ev & live, nxt, store[ge])
         store = store.at[ge].set(new_tok)
-        out = out.at[te, ge].set(jnp.where(ev, nxt, out[te, ge]))
+        out = out.at[te, ge].set(jnp.where(ev, new_tok, out[te, ge]))
+        stop = stop_flags(nxt, watch_g[ge], bud_g[ge], ml_g[ge], te)
+        alive = alive.at[ge].set(
+            jnp.where(ev, alive[ge] & ~stop, alive[ge])
+        )
         if lps is not None:
-            chosen, ids, vals = token_logprobs(logits, nxt)
+            chosen, ids, vals = token_logprobs(logits, new_tok)
             lps = (
                 lps[0].at[te, ge].set(jnp.where(ev, chosen, lps[0][te, ge])),
                 lps[1].at[te, ge].set(jnp.where(ev, ids, lps[1][te, ge])),
                 lps[2].at[te, ge].set(jnp.where(ev, vals, lps[2][te, ge])),
             )
-        return (store, buf, cache, out, lps), None
+        return (store, buf, cache, alive, out, lps), None
 
-    (store, buf, cache, out, lps), _ = jax.lax.scan(
-        body, (tok_g, buf0, cache, out0, lp0), jnp.arange(R)
+    (store, buf, cache, alive, out, lps), _ = jax.lax.scan(
+        body, (tok_g, buf0, cache, alive0, out0, lp0), jnp.arange(R)
     )
     sampled = out.reshape(n_steps, B)
     if lps is not None:
@@ -884,9 +909,9 @@ class EngineCore:
                 f"{bs}-token prefill chunk; raise the budget or shrink "
                 "decode_buckets"
             )
-        if self._sched_chunked and (pp_mesh is not None or sp_mesh is not None):
+        if self._sched_chunked and sp_mesh is not None:
             raise ValueError(
-                "scheduling='chunked' is not wired for pp/sp meshes yet; "
+                "scheduling='chunked' is not wired for sp meshes yet; "
                 "those engines keep 'waves'"
             )
         if engine_cfg.spec_decode not in ("off", "ngram"):
@@ -907,12 +932,6 @@ class EngineCore:
             raise ValueError(
                 f"unknown kv_dtype {engine_cfg.kv_dtype!r} "
                 f"(expected one of {KV_DTYPES})"
-            )
-        if engine_cfg.kv_quantized and pp_mesh is not None:
-            raise ValueError(
-                "kv_dtype='int8' under pipeline parallelism is not wired "
-                "yet (the pp-stacked cache layout has no scale pages); "
-                "run quantized KV on a tp/dp, sp, or single-chip engine"
             )
         if (
             engine_cfg.kv_quantized
@@ -942,12 +961,11 @@ class EngineCore:
                 "wired yet (the pp microbatch planner samples one row per "
                 "sequence); run spec on a tp/dp or single-chip engine"
             )
-        if engine_cfg.async_exec and (pp_mesh is not None or sp_mesh is not None):
+        if engine_cfg.async_exec and sp_mesh is not None:
             raise ValueError(
-                "async_exec is not wired for pp/sp meshes yet (the pp "
-                "microbatch planner rearranges the token buffer on host, "
-                "which the device feedback gather bypasses); those engines "
-                "keep the synchronous loop"
+                "async_exec is not wired for sp meshes yet (the ring "
+                "prefill path runs synchronously); sp engines keep the "
+                "synchronous loop"
             )
         if engine_cfg.max_waiting < 0:
             raise ValueError(
@@ -1039,21 +1057,9 @@ class EngineCore:
                         f"count {self._pp_micro}"
                     )
             if params is not None:
-                # Mirror build_engine's CLI guard: int8 params are
-                # {'w','scale'} dict leaves, which pp_param_specs knows
-                # nothing about — shard_params_pp would die with an opaque
-                # pytree-structure mismatch deep in jax.tree.map.
-                quant_leaves = jax.tree.leaves(
-                    params,
-                    is_leaf=lambda x: isinstance(x, dict)
-                    and set(x) == {"w", "scale"},
-                )
-                if any(isinstance(l, dict) for l in quant_leaves):
-                    raise ValueError(
-                        "int8 under pipeline parallelism: not wired yet "
-                        "(quantized {'w','scale'} leaves cannot be sharded "
-                        "by pp_param_specs)"
-                    )
+                # int8 params ({'w','scale'} dict leaves) shard like any
+                # stacked layer array: both members carry the layer axis
+                # first, so shard_params_pp places the pair per stage.
                 _check_fuse_tp(params, 1)  # pp stages keep tp=1 layouts
                 params = shard_params_pp(params, model_cfg, pp_mesh)
             else:
@@ -1077,7 +1083,9 @@ class EngineCore:
 
             self.cache = jax.jit(
                 partial(init_cache_stacked, model_cfg, engine_cfg),
-                out_shardings=cache_sharding_pp(pp_mesh),
+                out_shardings=cache_sharding_pp(
+                    pp_mesh, quantized=engine_cfg.kv_quantized
+                ),
             )()
         elif mesh is not None:
             from dynamo_tpu.parallel.sharding import (
@@ -1181,8 +1189,10 @@ class EngineCore:
         # rewrite them — and landed host-side off the step path. The
         # host/wire layouts stay layer-major ([L, ...] / [n, L, ...]) so
         # descriptors, offload tiers, and cross-core transfers are
-        # byte-compatible across cache layouts (per-layer tuple vs the
-        # pp-stacked array).
+        # byte-compatible across cache layouts (per-layer tuple — plain
+        # or quantized — vs the pp-stacked array / pp-stacked quantized
+        # dict): a block sliced from any of them packs to the same
+        # canonical bytes.
         from dynamo_tpu.engine.kv_quant import is_quantized_cache
 
         def _slice_page_fn(cache, bid):
@@ -1193,6 +1203,8 @@ class EngineCore:
                         "scale": jnp.stack([c["scale"][bid] for c in cache]),
                     }
                 return jnp.stack([c[bid] for c in cache])        # [L, ps, 2kv, d]
+            if isinstance(cache, dict):  # pp-stacked int8: same host layout
+                return {k: v[:, bid] for k, v in cache.items()}
             return cache[:, bid]
 
         def _gather_pages_fn(cache, ids):
@@ -1205,6 +1217,10 @@ class EngineCore:
                         ),
                     }  # leaves [n, L, ...]
                 return jnp.stack([c[ids] for c in cache], axis=1)  # [n, L, ...]
+            if isinstance(cache, dict):
+                return {
+                    k: jnp.moveaxis(v[:, ids], 1, 0) for k, v in cache.items()
+                }  # leaves [n, L, ...]
             return jnp.moveaxis(cache[:, ids], 1, 0)
 
         def _scatter_pages_fn(cache, ids, pages):
@@ -1220,6 +1236,11 @@ class EngineCore:
                 return tuple(
                     c.at[ids].set(pages[:, l]) for l, c in enumerate(cache)
                 )
+            if isinstance(cache, dict):
+                return {
+                    k: v.at[:, ids].set(jnp.moveaxis(pages[k], 0, 1))
+                    for k, v in cache.items()
+                }
             return cache.at[:, ids].set(jnp.moveaxis(pages, 0, 1))
 
         def _copy_pages_fn(src, dst, sids, dids):
@@ -1232,6 +1253,10 @@ class EngineCore:
                 return tuple(
                     d.at[dids].set(s[sids]) for s, d in zip(src, dst)
                 )
+            if isinstance(dst, dict):
+                return {
+                    k: dst[k].at[:, dids].set(src[k][:, sids]) for k in dst
+                }
             return dst.at[:, dids].set(src[:, sids])
 
         self._slice_page = jax.jit(_slice_page_fn)
@@ -1343,6 +1368,12 @@ class EngineCore:
             # slots (the one documented un-fused path).
             "fused_mixed_dispatches": 0,
             "megastep_forced_single": 0,
+            # Pipeline parallelism (ISSUE 20): decode dispatches that
+            # fused k > 1 wavefront iterations across the pipe vs pp
+            # chains forced to k == 1 (watch overflow / budget edge —
+            # those pay the fill/drain bubble PER TOKEN).
+            "pp_fused_dispatches": 0,
+            "pp_forced_single": 0,
         }
         # Crash/stall flight recorder (ISSUE 13): one record per step
         # with outputs — step shape, lane cursors, cumulative dispatch
@@ -2098,10 +2129,23 @@ class EngineCore:
                 len(rows), last_rows, self._pp_micro,
                 self.engine.garbage_block,
             )
+            mb_tok = jnp.asarray(plan.tokens)
+            if feed_idx is not None:
+                # Device-resident feedback under pp: the microbatch plan
+                # only PADS the flat token buffer (row order is
+                # preserved), so the flat feed indices apply verbatim to
+                # the flattened [M, Tm] buffer — gather on device, then
+                # fold back to microbatch shape.
+                fi = np.full(plan.tokens.size, -1, np.int32)
+                fi[: feed_idx.shape[0]] = feed_idx
+                mb_tok = self._feed(
+                    self._inflight.feed_tokens, mb_tok.reshape(-1),
+                    jnp.asarray(fi),
+                ).reshape(plan.tokens.shape)
             toks, lps, self.cache = self._prefill_pp(
                 self.params,
                 self.cache,
-                jnp.asarray(plan.tokens),
+                mb_tok,
                 jnp.asarray(plan.positions),
                 jnp.asarray(plan.write_pages),
                 jnp.asarray(plan.write_offs),
@@ -2777,9 +2821,9 @@ class EngineCore:
                 self._inflight.feed_tokens, tok_in, jnp.asarray(feed_idx)
             )
         if self.pp_mesh is not None:
-            # The pp wavefront chain has no stop flags yet (the ring-fed
-            # schedule complicates per-lane masking); overshoot rolls
-            # back on the host exactly as before.
+            # The FUSED pp megastep: the whole wavefront chain — stage
+            # hops, sampling, stop flags — is one dispatch, armed with
+            # the same per-lane stop inputs as the single-chip body.
             out, lps, self.cache = self._decode_pp(
                 self.params,
                 self.cache,
@@ -2792,11 +2836,17 @@ class EngineCore:
                 self._put_batch(temp),
                 self._put_batch(top_k),
                 self._put_batch(top_p),
+                self._put_batch(watch),
+                self._put_batch(budgets),
+                self._put_batch(min_left),
                 n_steps=n_steps,
                 need_mask=need_mask and not all_greedy,
                 all_greedy=all_greedy,
                 want_logprobs=want_lp,
             )
+            self.exec_stats[
+                "pp_fused_dispatches" if n_steps > 1 else "pp_forced_single"
+            ] += 1
         else:
             out, lps, self.cache = self._decode(
                 self.params,
@@ -3175,6 +3225,7 @@ class EngineCore:
                     attrs={
                         "seqs": len(ready), "inner_steps": n_steps,
                         "tokens": emitted_total,
+                        "pp_stages": self._pp,
                         "fused_shapes": {
                             "decode": len(ready), "chunk": 0, "verify": 0,
                         },
@@ -4756,6 +4807,14 @@ class EngineCore:
         st["dispatches_per_token"] = (
             self.exec_stats["dispatches"] / toks if toks else 0.0
         )
+        # Pipeline parallelism (ISSUE 20): stage count and the steady-
+        # state pipe occupancy of a fused chain — k*M work items over
+        # k*M + pp - 1 wavefront rounds (1.0 on non-pp engines: the
+        # degenerate pp=1 pipe has no bubble).
+        st["pp_stages"] = self._pp
+        k = max(1, self.engine.megastep)
+        km = k * self._pp_micro
+        st["pp_pipe_occupancy"] = km / (km + self._pp - 1)
         return st
 
     def kv_cache_stats(self) -> dict:

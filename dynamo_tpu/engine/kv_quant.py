@@ -71,9 +71,13 @@ def dequantize_kv(q, scale):
 
 
 def is_quantized_cache(cache) -> bool:
-    """True when a per-layer cache tuple holds quantized {kv, scale}
-    entries (the pp-stacked array cache is never quantized — EngineCore
-    rejects the combination at construction)."""
+    """True when a cache holds quantized {kv, scale} storage — either the
+    per-layer tuple layout (each element a dict) or the pp-stacked layout
+    (ONE dict whose leaves carry the leading ``[L, ...]`` layer axis; the
+    layer axis is the pp stage sharding, and the scale pages shard the
+    same way the kv pages do)."""
+    if isinstance(cache, dict):
+        return True
     return (
         isinstance(cache, tuple)
         and len(cache) > 0

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.jax_compat import shard_map
 from dynamo_tpu.ops.ragged_attention import (
     ragged_paged_attention,
     sharded_ragged_attention,
@@ -290,9 +291,16 @@ def init_cache(cfg: ModelConfig, engine: EngineConfig, dtype=None) -> tuple:
 
 def init_cache_stacked(
     cfg: ModelConfig, engine: EngineConfig, dtype=None
-) -> jax.Array:
+):
     """Stacked ``[L, n_pages, page_size, 2*n_kv, d]`` cache — the
-    pipeline-parallel layout (layer axis shards over the pp mesh)."""
+    pipeline-parallel layout (layer axis shards over the pp mesh).
+
+    With ``engine.kv_dtype == "int8"`` the stacked cache is instead ONE
+    ``{"kv": int8 [L, ...], "scale": f32 [L, n_pages, ps, 2*n_kv]}``
+    dict — the same quantize-at-write storage as :func:`init_cache`'s
+    per-layer dicts, with the layer axis stacked so both members shard
+    over the pp mesh together (each stage holds only its own layers'
+    kv AND scale pages)."""
     dtype = dtype or cfg.jax_dtype
     shape = (
         cfg.num_layers,
@@ -301,6 +309,11 @@ def init_cache_stacked(
         2 * cfg.num_kv_heads,
         cfg.head_dim,
     )
+    if engine.kv_quantized:
+        return {
+            "kv": jnp.zeros(shape, jnp.int8),
+            "scale": jnp.zeros(shape[:-1], jnp.float32),
+        }
     return jnp.zeros(shape, dtype)
 
 
@@ -526,7 +539,7 @@ def _moe_mlp(x, lp, cfg: ModelConfig, mesh=None):
                 xr, w_router, w_gate, w_up, w_down, cfg, tp, E_local
             )
 
-        out = jax.shard_map(
+        out = shard_map(
             a2a_fn,
             mesh=mesh,
             in_specs=(P("tp"), P(), P("tp"), P("tp"), P("tp")),
@@ -540,7 +553,7 @@ def _moe_mlp(x, lp, cfg: ModelConfig, mesh=None):
         out = _moe_dispatch_local(xr, w_router, w_gate, w_up, w_down, cfg, off, E_local)
         return jax.lax.psum(out, "tp")
 
-    out = jax.shard_map(
+    out = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(P(), P(), P("tp"), P("tp"), P("tp")),

@@ -1,0 +1,26 @@
+"""Version shims over the jax API surface the engine uses.
+
+jax promoted ``jax.experimental.shard_map.shard_map`` to ``jax.shard_map``
+(and renamed its ``check_rep`` flag to ``check_vma``) across the
+0.4 -> 0.6 series. The engine is written against the NEW spelling; this
+module maps that one symbol onto whatever the installed jax provides, so
+every mesh program (tp ragged attention, sp ring prefill, MoE dispatch,
+the pp pipeline) imports ``shard_map`` from here instead of touching the
+moving attribute directly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, /, *, mesh, in_specs, out_specs, check_vma=True):
+        """Old-jax fallback accepting the new keyword names."""
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

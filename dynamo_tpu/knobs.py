@@ -122,6 +122,12 @@ KNOBS: dict[str, Knob] = _freeze(
     Knob("DYN_SPEC_DRAFT_ROUND_US", 10.0, "float", "spec",
          "mocker virtual-clock price per on-device draft round (ring "
          "match + gather between megastep inner iterations)"),
+    # -- pipeline parallelism -------------------------------------------
+    Knob("DYN_PP_HOP_US", 200.0, "float", "pp",
+         "mocker virtual-clock price per pipeline stage hop (one "
+         "lax.ppermute boundary crossing; the fused-megastep A/B prices "
+         "k*pp + pp-1 hops per dispatch against pp hops per token on the "
+         "host-rollback baseline)"),
     # -- TPU kernels ----------------------------------------------------
     Knob("DYNAMO_TPU_PAGED_ATTN", "xla", "str", "kernels",
          "paged-attention backend: `xla` or `pallas`"),

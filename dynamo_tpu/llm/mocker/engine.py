@@ -126,6 +126,16 @@ class MockEngineArgs:
     fair_scheduling: bool = False
     fair_quantum: int = 0
     max_waiting: int = 0
+    # Pipeline parallelism (mirrors EngineCore's pp_mesh, ISSUE 20): the
+    # virtual clock prices every decode dispatch's stage traffic as
+    # (k * pp + pp - 1) hops at DYN_PP_HOP_US each — k wavefront
+    # iterations over pp stages plus the pipe fill/drain bubble. With
+    # megastep_k=1 that is the host-rollback pp baseline (one priced
+    # dispatch + bubble PER TOKEN); with megastep_k=k the same bubble
+    # amortizes over k tokens under ONE base_iter_us — exactly the fused
+    # pp megastep A/B bench.py run_pp_megastep_ab asserts. Token VALUES
+    # are unchanged — pp streams stay bit-identical to pp=1.
+    pp: int = 1
 
 
 @dataclass
@@ -204,6 +214,8 @@ class MockTpuEngine:
             raise ValueError(
                 f"megastep_k must be >= 1, got {self.args.megastep_k}"
             )
+        if self.args.pp < 1:
+            raise ValueError(f"pp must be >= 1, got {self.args.pp}")
         from dynamo_tpu.engine.kv_quant import KV_DTYPES, kv_byte_ratio
 
         if self.args.kv_dtype not in KV_DTYPES:
@@ -216,6 +228,7 @@ class MockTpuEngine:
         self._kv_byte_ratio = kv_byte_ratio(self.args.kv_dtype)
         self._last_kv_blocks_read = 0
         self._last_device_rounds = 0
+        self._last_pp_rounds = 0
         # Cluster-pool peer-pull accounting (kv_pool_* gauges; same
         # counter shape as the jax worker's PeerKvClient).
         from dynamo_tpu.llm.kv_pool import PeerPullStats
@@ -312,6 +325,12 @@ class MockTpuEngine:
             # to k=1 by the device stop-watch overflow.
             "fused_mixed_dispatches": 0,
             "megastep_forced_single": 0,
+            # Pipeline parallelism (ISSUE 20), mirroring EngineCore:
+            # decode dispatches that fused k > 1 wavefront iterations
+            # across the pipe vs the single-iteration (bubble-per-token)
+            # fallback. Both 0 when pp == 1.
+            "pp_fused_dispatches": 0,
+            "pp_forced_single": 0,
             # Overload counters (ISSUE 10), mirroring EngineCore.
             "shed_total": 0,
             "deadline_expired_total": 0,
@@ -460,6 +479,12 @@ class MockTpuEngine:
         st["queue_limit"] = self.args.max_waiting
         st["fair_enabled"] = 1 if self.args.fair_scheduling else 0
         st["megastep_k"] = self.args.megastep_k
+        # Pipe occupancy, mirroring EngineCore.scheduler_stats: k*M
+        # wavefront work items over k*M + pp - 1 rounds (M = pp
+        # microbatch groups); 1.0 when pp is off.
+        st["pp_stages"] = self.args.pp
+        km = max(1, self.args.megastep_k) * self.args.pp
+        st["pp_pipe_occupancy"] = km / (km + self.args.pp - 1)
         toks = self.sched_stats["committed_tokens"]
         st["dispatches_per_token"] = (
             self.sched_stats["dispatches"] / toks if toks else 0.0
@@ -593,7 +618,7 @@ class MockTpuEngine:
 
     def iter_time_s(
         self, prefill_tokens: int, decode_seqs: int, kv_blocks_read: int = 0,
-        device_rounds: int = 0,
+        device_rounds: int = 0, pp_rounds: int = 0,
     ) -> float:
         """Virtual-clock cost of one iteration under the overlap model:
         with async execution, the fixed host overhead runs one step ahead
@@ -620,6 +645,11 @@ class MockTpuEngine:
             # iterations (ISSUE 18) — device-side work, so it hides
             # nothing and overlaps with nothing extra.
             + device_rounds * knobs.get_float("DYN_SPEC_DRAFT_ROUND_US")
+            # Pipeline stage hops (ISSUE 20): each ppermute boundary
+            # crossing a decode dispatch paid this iteration, bubble
+            # included — device-side collective time, same overlap
+            # behaviour as the draft rounds above.
+            + pp_rounds * knobs.get_float("DYN_PP_HOP_US")
         ) / 1e6
         if self.args.async_exec:
             total = max(host_s, device_s)
@@ -664,7 +694,7 @@ class MockTpuEngine:
             await asyncio.sleep(
                 self.iter_time_s(
                     prefill_tokens, decode_seqs, self._last_kv_blocks_read,
-                    self._last_device_rounds,
+                    self._last_device_rounds, self._last_pp_rounds,
                 )
             )
 
@@ -1122,6 +1152,7 @@ class MockTpuEngine:
                         "seqs": mega_lanes, "inner_steps": k_mega,
                         "tokens": tokens_emitted,
                         "draft_rounds": device_rounds_step,
+                        "pp_stages": self.args.pp,
                         "fused_shapes": {
                             "decode": (
                                 mega_lanes - mega_verify_lanes
@@ -1147,6 +1178,20 @@ class MockTpuEngine:
         )
         self._last_kv_blocks_read = kv_blocks_read
         self._last_device_rounds = device_rounds_step
+        # Pipeline stage traffic this iteration (ISSUE 20): a decode
+        # dispatch wavefronts k_mega iterations over pp stages and pays
+        # the fill/drain bubble once — k*pp + pp-1 ppermute hops; a
+        # prefill-only dispatch crosses the pipe once (pp + pp-1 hops).
+        # With megastep_k=1 the SAME formula is the host-rollback
+        # baseline: every token pays its own bubble + base_iter_us.
+        pp_rounds_step = 0
+        if self.args.pp > 1 and (prefill_tokens or decode_seqs):
+            k_pp = k_mega if decode_seqs else 1
+            pp_rounds_step = k_pp * self.args.pp + self.args.pp - 1
+            if decode_seqs:
+                key = "pp_fused_dispatches" if k_mega > 1 else "pp_forced_single"
+                st[key] += 1
+        self._last_pp_rounds = pp_rounds_step
         if self.flight.capacity and lane_records:
             # One flight-recorder record per iteration with work: step
             # shape + lane cursors (the chaos-kill artifact reconstructs

@@ -44,6 +44,7 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 # kernel's own defaults; tools/profile_decode.py + PERF.md). Long-context
 # calls use the kernel's tuned table instead. Env-overridable for on-chip
 # tuning sweeps; 0 = always use the kernel's defaults.
+from dynamo_tpu.jax_compat import shard_map
 from dynamo_tpu import knobs as _knobs
 
 _DECODE_KV_PAGES_PER_BLOCK = _knobs.get_int("DYNAMO_TPU_ATTN_PAGES_PER_BLOCK")
@@ -209,7 +210,7 @@ def sharded_ragged_attention(
                 kv_scales=kv_scales,
             )
 
-        return jax.shard_map(
+        return shard_map(
             quant_fn,
             mesh=mesh,
             in_specs=(
@@ -224,7 +225,7 @@ def sharded_ragged_attention(
     fn = functools.partial(
         ragged_paged_attention, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.jax_compat import shard_map
+
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -106,7 +108,7 @@ def ring_attention(
     if q.shape[0] % axis_size:
         raise ValueError(f"sequence {q.shape[0]} not divisible by {axis_size}-way sp")
     spec = P(axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, axis_size=axis_size
         ),

@@ -52,6 +52,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.jax_compat import shard_map
 from dynamo_tpu.engine.model import (
     Params,
     _dot,
@@ -104,20 +105,39 @@ def pp_param_specs(cfg: ModelConfig, pp: int) -> dict[str, Any]:
     return specs
 
 
+def _is_quant_leaf(x) -> bool:
+    """An int8 ``{"w", "scale"}`` projection (model.quantize_weight)."""
+    return isinstance(x, dict) and set(x) == {"w", "scale"}
+
+
 def shard_params_pp(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Place a params pytree per :func:`pp_param_specs`. int8 params keep
+    their ``{"w", "scale"}`` dict leaves: a stacked layer projection is
+    ``w [L, ...]`` + ``scale [L, 1, out]`` — BOTH carry the layer axis
+    first, so one ``P("pp")`` spec shards the pair onto its stage."""
     specs = pp_param_specs(cfg, int(mesh.shape["pp"]))
     if "fuse_tp" not in params:
         specs.pop("fuse_tp")
-    return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        params, specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+
+    def place(x, spec):
+        put = lambda a: jax.device_put(a, NamedSharding(mesh, spec))
+        if _is_quant_leaf(x):
+            return {k: put(v) for k, v in x.items()}
+        return put(x)
+
+    return jax.tree.map(place, params, specs, is_leaf=_is_quant_leaf)
 
 
-def cache_sharding_pp(mesh: Mesh) -> NamedSharding:
+def cache_sharding_pp(mesh: Mesh, quantized: bool = False):
     """[L, pages, page_size, 2kv, d] — layer axis on pp (each stage holds
-    only its own layers' KV)."""
+    only its own layers' KV). Quantized caches are a ``{"kv", "scale"}``
+    dict of stacked arrays; the scale pages shard their layer axis the
+    same way, so every stage owns matching (kv, scale) page pairs."""
+    if quantized:
+        return {
+            "kv": NamedSharding(mesh, P("pp", None, None, None, None)),
+            "scale": NamedSharding(mesh, P("pp", None, None, None)),
+        }
     return NamedSharding(mesh, P("pp", None, None, None, None))
 
 
@@ -212,17 +232,30 @@ def _stage_layers(
     stage-local stacked ``[Lp, ...]`` cache (pp keeps the stacked layout
     — the layer axis IS the stage sharding — and pays the slice
     roundtrip the engine's tuple cache avoids; pp is a capacity mode,
-    not the single-chip fast path)."""
+    not the single-chip fast path). A quantized cache is a
+    ``{"kv", "scale"}`` dict of stacked arrays: the per-layer slice
+    hands dense_layer exactly the per-layer dict it already handles, and
+    the write-back updates both members in place."""
     rope_cs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
-    Lp = cache_local.shape[0]
+    quant = isinstance(cache_local, dict)
+    Lp = (cache_local["kv"] if quant else cache_local).shape[0]
     for j in range(Lp):
         lp = jax.tree.map(lambda a: a[j], layers_local)
+        cache_j = (
+            {k: v[j] for k, v in cache_local.items()} if quant
+            else cache_local[j]
+        )
         x, cache_j = dense_layer(
-            x, lp, cache_local[j], positions, write_pages, write_offs,
+            x, lp, cache_j, positions, write_pages, write_offs,
             kv_lens, block_tables, cu_q_lens, num_seqs, cfg,
             rope_cs=rope_cs,
         )
-        cache_local = cache_local.at[j].set(cache_j)
+        if quant:
+            cache_local = {
+                k: cache_local[k].at[j].set(cache_j[k]) for k in cache_local
+            }
+        else:
+            cache_local = cache_local.at[j].set(cache_j)
     return x, cache_local
 
 
@@ -293,7 +326,7 @@ def pp_forward_impl(
             "dispatch inside each stage (parallel/sharding.py) — not yet built"
         )
     pp = int(mesh.shape["pp"])
-    hid, cache = jax.shard_map(
+    hid, cache = shard_map(
         partial(_pp_program, cfg=cfg, engine=engine, pp=pp, n_micro=n_micro),
         mesh=mesh,
         in_specs=(
@@ -415,7 +448,7 @@ def pp_decode_round(
     rotating activation buffer ``[pp, Bm, h]`` (stage-sharded); returns
     (buf', cache', logits ``[Bm, V]`` vocab-sharded over pp)."""
     pp = int(mesh.shape["pp"])
-    return jax.shard_map(
+    return shard_map(
         partial(
             _pp_decode_round_body, cfg=cfg, engine=engine, pp=pp,
             n_micro=n_micro, n_steps=n_steps,

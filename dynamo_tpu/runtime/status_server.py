@@ -101,6 +101,26 @@ SCHEDULER_GAUGES: dict[str, tuple[str, str]] = {
         "start — < 1.0 means multi-token dispatches are amortizing the "
         "fixed per-dispatch overhead",
     ),
+    # Pipeline parallelism (ISSUE 20): fused pp megasteps on the fast path.
+    "pp_stages": (
+        "scheduler_pp_stages",
+        "Pipeline-parallel stages this engine runs (1 = pp off)",
+    ),
+    "pp_pipe_occupancy": (
+        "scheduler_pp_pipe_occupancy",
+        "Steady-state pipe occupancy k*M / (k*M + pp - 1) for the "
+        "resolved megastep length and microbatch count (1.0 when pp off)",
+    ),
+    "pp_fused_dispatches": (
+        "scheduler_pp_fused_dispatches_total",
+        "Fused pp megastep dispatches (k > 1 decode iterations wavefront-"
+        "interleaved across the pipe in one device program)",
+    ),
+    "pp_forced_single": (
+        "scheduler_pp_forced_single_total",
+        "pp decode dispatches forced back to k=1 (stop-watch overflow — "
+        "same documented un-fused path as megastep_forced_single)",
+    ),
     # Overload robustness (ISSUE 10): bounded-queue + deadline shedding
     # and the fair-scheduler switch, on BOTH backends.
     "queue_limit": (

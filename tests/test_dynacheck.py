@@ -26,7 +26,10 @@ from tools.dynacheck.explore import explore                     # noqa: E402
 from tools.dynacheck.interproc import run_all                   # noqa: E402
 from tools.dynacheck.models.allocator import AllocatorModel     # noqa: E402
 from tools.dynacheck.models.breaker import BreakerModel         # noqa: E402
-from tools.dynacheck.models.cursor import CursorModel           # noqa: E402
+from tools.dynacheck.models.cursor import (                     # noqa: E402
+    CursorModel,
+    PPWavefrontModel,
+)
 from tools.dynacheck.models.keepalive import KeepaliveModel     # noqa: E402
 from tools.dynacheck.models.planner import PlannerModel         # noqa: E402
 from tools.dynacheck.models.quarantine import QuarantineModel   # noqa: E402
@@ -93,7 +96,7 @@ def test_pragma_inventory_is_pinned():
 # suspiciously small space usually means the action set silently shrank.
 # keepalive is a compact boolean protocol — its whole space IS small.
 MODEL_FLOORS = {
-    "allocator": 100, "cursor": 100, "breaker": 100,
+    "allocator": 100, "cursor": 100, "pp-wavefront": 100, "breaker": 100,
     "quarantine": 100, "keepalive": 5, "planner": 100,
 }
 
@@ -412,6 +415,25 @@ def test_cursor_model_catches_missing_ring_rollback():
     res = explore(m)
     assert res.violations, "missing ring rollback survived the cursor invariants"
     assert any("diverged" in str(v) or "drift" in str(v) for v in res.violations)
+
+
+class _NoWavefrontBarrierPPModel(PPWavefrontModel):
+    """Drops the pp wavefront barrier (ISSUE 20): the stage ring starts
+    a microbatch group's iteration t+1 BEFORE iteration t's drain is
+    visible, so stage 0 embeds a stale sampled token (and reads a stale
+    alive flag) — the exact interleaving the M >= pp wavefront schedule
+    makes impossible."""
+
+    name = "pp-wavefront-no-barrier"
+    barrier = False
+
+
+def test_pp_wavefront_model_catches_dropped_barrier():
+    m = _NoWavefrontBarrierPPModel()
+    m.max_depth = 8
+    res = explore(m)
+    assert res.violations, "stale-feedback entry survived the pp invariants"
+    assert any("diverged" in str(v) for v in res.violations)
 
 
 class _WedgingBreaker:

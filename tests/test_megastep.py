@@ -124,7 +124,9 @@ def test_megastep_resolution_and_validation():
 
 
 @pytest.mark.parametrize("scheduling", ["waves", "chunked"])
-@pytest.mark.parametrize("k", [2, 8])
+@pytest.mark.parametrize(
+    "k", [pytest.param(2, marks=pytest.mark.slow), 8]
+)  # k=2 rides the slow tier; k=8 keeps both scheduling modes in tier-1
 def test_parity_megastep_vs_single_step(scheduling, k):
     """The acceptance invariant: --megastep-k k vs 1, same tokens, same
     finish reasons, same logprob payloads — greedy and seeded lanes in
@@ -143,7 +145,9 @@ def test_parity_megastep_vs_single_step(scheduling, k):
     assert run(1) == run(k)
 
 
-@pytest.mark.parametrize("async_exec", [False, True])
+@pytest.mark.parametrize(
+    "async_exec", [pytest.param(False, marks=pytest.mark.slow), True]
+)  # async OFF re-runs the plain matrix above; tier-1 keeps the ON cell
 def test_parity_megastep_async_composition(async_exec):
     """Megastep x async-exec compose: one k-iteration dispatch in flight
     while the next is planned against the optimistic overlay; stream
@@ -458,7 +462,9 @@ def _spec_workload(core):
 
 
 @pytest.mark.parametrize("scheduling", ["waves", "chunked"])
-@pytest.mark.parametrize("k", [2, 8])
+@pytest.mark.parametrize(
+    "k", [pytest.param(2, marks=pytest.mark.slow), 8]
+)  # k=2 rides the slow tier; k=8 keeps both scheduling modes in tier-1
 def test_parity_fused_mixed_spec(scheduling, k):
     """The ISSUE 12 acceptance invariant: with spec decode ON and mixed
     traffic, --megastep-k k fuses verify rows (accept/reject resolved on
@@ -486,7 +492,9 @@ def test_parity_fused_mixed_spec(scheduling, k):
     assert core.spec_stats.verify_rows > 0
 
 
-@pytest.mark.parametrize("async_exec", [False, True])
+@pytest.mark.parametrize(
+    "async_exec", [pytest.param(False, marks=pytest.mark.slow), True]
+)  # async OFF re-runs the plain matrix above; tier-1 keeps the ON cell
 def test_parity_fused_async_composition(async_exec):
     """Universal megastep x async-exec: fused steps carrying live drafts
     are a pipeline barrier (data-dependent advance), draft-less fused

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dynamo_tpu.engine import EngineCore, tiny_engine
 from dynamo_tpu.engine.config import ModelConfig, tiny_moe
@@ -76,6 +77,7 @@ def test_moe_engine_generates_end_to_end():
     assert done2["moe2"] == done["moe1"]
 
 
+@pytest.mark.slow  # heaviest moe compile; tier-1 keeps the alltoall/e2e cells
 def test_moe_expert_parallel_matches_single_device():
     eng = tiny_engine()
     prompt = list(np.arange(1, 21))

@@ -70,6 +70,7 @@ def test_fused_layouts_describe_same_model():
     np.testing.assert_allclose(np.asarray(u1), np.asarray(u4), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # heaviest tp compile; tier-1 keeps the other mesh cells
 def test_sharded_prefill_decode_matches_single_device():
     prompt = list(np.random.RandomState(1).randint(1, 500, size=20))
     blocks = [0, 1, 2, 3]

@@ -371,6 +371,7 @@ EXCLUDE_PARTS = L.EXCLUDE_PARTS
 MODEL_DEPTHS = {
     "allocator": 18,
     "cursor": 12,
+    "pp-wavefront": 12,
     "breaker": 18,
     "quarantine": 20,
     "keepalive": 12,

@@ -6,6 +6,9 @@ machines, explored exhaustively by :mod:`tools.dynacheck.explore`.
   over a shared-prefix two-sequence world.
 - ``cursor`` models the async-exec + megastep plan/dispatch/commit
   cursor protocol against a synchronous reference trace.
+- ``pp-wavefront`` models the pipeline-parallel megastep's cross-group
+  commit ordering (drain-before-next-entry) against per-group
+  synchronous traces.
 - ``breaker`` drives the REAL :class:`CircuitBreaker` under a virtual
   clock, including the cancelled-probe re-arm.
 - ``quarantine`` models EndpointClient's lease-expiry quarantine machine
@@ -20,12 +23,12 @@ from __future__ import annotations
 
 from tools.dynacheck.models.allocator import AllocatorModel
 from tools.dynacheck.models.breaker import BreakerModel
-from tools.dynacheck.models.cursor import CursorModel
+from tools.dynacheck.models.cursor import CursorModel, PPWavefrontModel
 from tools.dynacheck.models.keepalive import KeepaliveModel
 from tools.dynacheck.models.planner import PlannerModel
 from tools.dynacheck.models.quarantine import QuarantineModel
 
 ALL_MODELS = (
-    AllocatorModel, CursorModel, BreakerModel,
+    AllocatorModel, CursorModel, PPWavefrontModel, BreakerModel,
     QuarantineModel, KeepaliveModel, PlannerModel,
 )

@@ -428,3 +428,222 @@ def _reference(world: _World) -> tuple[tuple[int, ...], int, str]:
             adv_proc=1, adv_gen=1,
         )))
     return state.emitted, state.processed, state.finished
+
+
+# ---------------------------------------------------------------------------
+# pp wavefront model (ISSUE 20): commit ordering across in-flight
+# microbatch groups inside ONE fused pipeline-parallel dispatch.
+# ---------------------------------------------------------------------------
+
+PP_STAGES = 2
+PP_GROUPS = 2          # M microbatch groups riding the stage ring
+PP_MAX_TOKENS = 4
+
+
+@dataclass(frozen=True)
+class _PPWorld:
+    """Per-group token oracles for the wavefront world. Each group's
+    next token CHAINS from the previous sampled token (feedback) — the
+    value stage 0 embeds for iteration t+1 is only correct if iteration
+    t's drain (sampling on the last stage) is already visible. That
+    visibility is exactly what the wavefront barrier guarantees: with M
+    groups interleaved over pp stages and M >= pp, the drain of (t, g)
+    at round t*M + g + pp - 1 strictly precedes the entry of (t+1, g)
+    at round (t+1)*M + g."""
+    eos_at: tuple[int | None, ...]
+    host_at: tuple[int | None, ...]
+
+    def token(self, g: int, prev: int, n: int) -> int:
+        if self.eos_at[g] is not None and n == self.eos_at[g]:
+            return EOS
+        if self.host_at[g] is not None and n == self.host_at[g]:
+            return HOST_STOP
+        return 20 + ((prev * 7 + n + g) % 5)
+
+
+@dataclass(frozen=True)
+class _PPState:
+    world: _PPWorld
+    pending: tuple[int, ...] = ()      # last committed token per group
+    generated: tuple[int, ...] = ()    # committed generation count
+    emitted: tuple[tuple[int, ...], ...] = ()
+    finished: tuple[str | None, ...] = ()
+
+
+def _pp_initial(world: _PPWorld) -> _PPState:
+    return _PPState(
+        world=world,
+        pending=tuple(10 + g for g in range(PP_GROUPS)),
+        generated=(1,) * PP_GROUPS,
+        emitted=((),) * PP_GROUPS,
+        finished=(None,) * PP_GROUPS,
+    )
+
+
+def _pp_dispatch_outputs(
+    state: _PPState, k: int, *, barrier: bool
+) -> list[tuple[int, ...]]:
+    """Simulate one fused pp dispatch: k inner iterations over M groups
+    wavefronting through PP_STAGES stages. Work item (t, g) enters stage
+    0 at round t*M + g and drains at round t*M + g + pp - 1; a stage-0
+    entry reads the LATEST drained token (and the latest drained stop
+    flag) whose drain round strictly precedes its entry round.
+
+    ``barrier=True`` is the real schedule (M >= pp, so the previous
+    iteration has always drained). ``barrier=False`` is the
+    drop-the-barrier mutant: every iteration enters pp - 1 rounds early,
+    BEFORE the previous drain is visible — stage 0 embeds a STALE token
+    and reads a stale alive flag, exactly the bug the wavefront
+    interleave exists to make impossible."""
+    early = 0 if barrier else PP_STAGES - 1
+    outs: list[tuple[int, ...]] = []
+    for g in range(PP_GROUPS):
+        # The committed pending token drained BEFORE this dispatch: it
+        # is visible to any entry round, mutant or not.
+        drained: list[tuple[int, int, bool]] = [
+            (-(PP_STAGES + 1), state.pending[g], False)
+        ]
+        toks: list[int] = []
+        for t in range(k):
+            entry = t * PP_GROUPS + g - early
+            drain = t * PP_GROUPS + g + PP_STAGES - 1
+            vis = max(i for i, (dr, _, _) in enumerate(drained)
+                      if dr < entry)
+            _, feed, dead = drained[vis]
+            if dead or state.finished[g] is not None:
+                toks.append(drained[-1][1])      # dead pad
+                drained.append((drain, drained[-1][1], True))
+                continue
+            tok = state.world.token(g, feed, state.generated[g] + t)
+            toks.append(tok)
+            drained.append((drain, tok, drained[-1][2] or tok == EOS))
+        outs.append(tuple(toks))
+    return outs
+
+
+def _pp_commit(state: _PPState, outs: list[tuple[int, ...]]) -> _PPState:
+    """Host commit after the dispatch: per-group stop scan (the
+    authority), cursor advance, emission — the same algebra as the
+    single-lane _commit, applied per microbatch group."""
+    pending = list(state.pending)
+    generated = list(state.generated)
+    emitted = list(state.emitted)
+    finished = list(state.finished)
+    for g in range(PP_GROUPS):
+        if finished[g] is not None:
+            continue
+        k, fin = 0, None
+        for j, t in enumerate(outs[g]):
+            if t == EOS:
+                k, fin = j + 1, "eos"
+                break
+            if t == HOST_STOP:
+                k, fin = j + 1, "host"
+                break
+            if generated[g] + j + 1 >= PP_MAX_TOKENS:
+                k, fin = j + 1, "length"
+                break
+        else:
+            k = len(outs[g])
+        accepted = outs[g][:k]
+        generated[g] += k
+        emitted[g] = emitted[g] + accepted
+        pending[g] = accepted[-1] if fin is None and accepted else pending[g]
+        finished[g] = fin
+    return replace(
+        state, pending=tuple(pending), generated=tuple(generated),
+        emitted=tuple(emitted), finished=tuple(finished),
+    )
+
+
+def _pp_reference(world: _PPWorld, g: int) -> tuple[tuple[int, ...], str]:
+    """Group g's synchronous single-lane trace: the baseline every
+    wavefront interleaving must reproduce token for token."""
+    prev, n, out = 10 + g, 1, []
+    while True:
+        t = world.token(g, prev, n)
+        out.append(t)
+        if t == EOS:
+            return tuple(out), "eos"
+        if t == HOST_STOP:
+            return tuple(out), "host"
+        if n + 1 >= PP_MAX_TOKENS:
+            return tuple(out), "length"
+        prev, n = t, n + 1
+
+
+class PPWavefrontModel(Model):
+    """The pp megastep's cross-group commit ordering: M microbatch
+    groups share one fused dispatch, and a group's iteration t+1 may
+    only embed what iteration t drained. The model explores every
+    k-choice / cancel interleaving of two groups with EOS and host-only
+    stops at varied positions; the drop-the-barrier mutant (entering
+    iterations before the previous drain is visible) feeds stale tokens
+    and provably diverges from the synchronous reference."""
+
+    name = "pp-wavefront"
+    max_depth = C.MODEL_DEPTHS["pp-wavefront"]
+    barrier = True      # the mutant subclass in tests flips this
+
+    def initial_states(self):
+        worlds = [
+            ("plain", _PPWorld(eos_at=(None, None), host_at=(None, None))),
+            ("eos-g0-mid", _PPWorld(eos_at=(2, None), host_at=(None, None))),
+            ("host-g1-early", _PPWorld(eos_at=(None, None),
+                                       host_at=(None, 2))),
+            ("staggered-stops", _PPWorld(eos_at=(3, None), host_at=(None, 2))),
+            ("both-eos", _PPWorld(eos_at=(2, 3), host_at=(None, None))),
+        ]
+        for label, w in worlds:
+            yield f"init:{label}", _pp_initial(w)
+
+    def actions(self, state: _PPState):
+        acts: list[tuple[str, Callable[[Any], Any]]] = []
+        active = [g for g in range(PP_GROUPS) if state.finished[g] is None]
+        if active:
+            acts.append(("megastep_k1", lambda s: self._megastep(s, 1)))
+            acts.append(("megastep_k2", lambda s: self._megastep(s, 2)))
+            for g in active:
+                acts.append((f"cancel_g{g}",
+                             lambda s, g=g: self._cancel(s, g)))
+        acts.sort(key=lambda kv: kv[0])
+        return acts
+
+    def _megastep(self, state: _PPState, k: int) -> _PPState:
+        outs = _pp_dispatch_outputs(state, k, barrier=self.barrier)
+        return _pp_commit(state, outs)
+
+    @staticmethod
+    def _cancel(state: _PPState, g: int) -> _PPState:
+        finished = list(state.finished)
+        finished[g] = "cancel"
+        return replace(state, finished=tuple(finished))
+
+    def invariants(self, state: _PPState) -> list[str]:
+        out: list[str] = []
+        for g in range(PP_GROUPS):
+            ref, ref_fin = _pp_reference(state.world, g)
+            n = len(state.emitted[g])
+            if state.emitted[g] != ref[:n]:
+                out.append(
+                    f"group {g} stream diverged from the synchronous "
+                    f"trace: emitted {state.emitted[g]}, reference {ref[:n]}"
+                )
+            if state.generated[g] != 1 + n:
+                out.append(
+                    f"group {g} cursor drift: generated="
+                    f"{state.generated[g]} != 1 + emitted {n}"
+                )
+            fin = state.finished[g]
+            if fin is not None and fin != "cancel":
+                if state.emitted[g] != ref or fin != ref_fin:
+                    out.append(
+                        f"group {g} finished state diverges: emitted="
+                        f"{state.emitted[g]} vs {ref}, finish={fin} vs "
+                        f"{ref_fin}"
+                    )
+        return out
+
+    def fingerprint(self, state: _PPState) -> Any:
+        return (state.world, state.pending, state.generated,
+                state.emitted, state.finished)

@@ -175,7 +175,15 @@ HOT_STEP_FUNCS: dict[str, set[str]] = {
         "_merge_plans", "_dispatch_ragged", "_dispatch_megastep",
         "_dispatch_fused", "_assemble_ragged", "_grow_or_preempt",
         "_admit", "land",
+        # pp fast path (ISSUE 20): the fused pipeline device bodies — a
+        # host sync inside either would land INSIDE the traced wavefront
+        # scan and serialize every stage hop.
+        "_pp_prefill_and_sample", "_pp_decode_chain",
     },
+    # pp microbatch planning (ISSUE 20): runs on the plan side of every
+    # pipelined step — a device sync here stalls the stage ring exactly
+    # like one in _plan_megastep would.
+    "dynamo_tpu/parallel/pipeline.py": {"plan_microbatches"},
     # Detector fixtures (linted directly by tests; excluded from the tree).
     "tests/fixtures/dynalint/host_sync_bad.py": {"plan_step", "dispatch"},
     "tests/fixtures/dynalint/host_sync_ok.py": {"plan_step", "dispatch"},
